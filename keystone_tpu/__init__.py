@@ -1,0 +1,23 @@
+"""keystone_tpu — a TPU-native ML-pipeline framework.
+
+A ground-up JAX/XLA/pjit rebuild of the capabilities of KeystoneML
+(AMPLab's Scala/Spark pipeline framework): typed Transformer/Estimator
+combinators lowered to a lazy memoized dataflow DAG, a rule-based pipeline
+optimizer, distributed linear-algebra solvers whose Spark treeReduce /
+broadcast communication becomes XLA collectives over a device mesh, image
+and NLP featurizers as XLA programs, evaluators, loaders, and CLI
+pipelines. See SURVEY.md for the structural map of the reference.
+"""
+
+__version__ = "0.1.0"
+
+from .workflow import (  # noqa: F401
+    Estimator,
+    FittedPipeline,
+    LabelEstimator,
+    Pipeline,
+    PipelineEnv,
+    Transformer,
+)
+from .data.dataset import Dataset, HostDataset  # noqa: F401
+from .parallel import mesh  # noqa: F401

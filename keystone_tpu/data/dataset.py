@@ -1,0 +1,225 @@
+"""Distributed dataset handles — the TPU-native replacement for RDDs.
+
+Two containers:
+
+  - `Dataset` — a pytree of arrays with a leading example axis, padded to a
+    multiple of the mesh's ``data`` axis and sharded over it. This is the
+    analog of an `RDD[DenseVector]`/`RDD[Image]` with one shard per chip
+    (SURVEY.md §2.7 'Data parallelism'). Zero-padding is deliberate: padded
+    rows contribute nothing to Gram matrices, moment sums, or one-hot label
+    sums, so reductions only need the true ``count`` for normalization.
+
+  - `HostDataset` — a plain list of host objects (variable-size images,
+    strings, token lists). The NLP stack and variable-shape image loaders
+    run host-side, mirroring the reference's JVM-side per-item code, and
+    convert to `Dataset` at the dense boundary via ``stack()``.
+
+`Transformer.apply_batch`'s default path maps a per-item function over a
+`Dataset` via ``jit(vmap(f))`` — the analog of `RDD.map` lowering to one
+fused XLA program per shard (reference Transformer.scala:46).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as meshlib
+
+
+def _pad_to(x, target: int):
+    n = x.shape[0]
+    if n == target:
+        return x
+    pad_widths = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
+    if isinstance(x, np.ndarray):
+        return np.pad(x, pad_widths)
+    return jnp.pad(x, pad_widths)
+
+
+class Dataset:
+    """Sharded device-resident dataset (leading axis = examples)."""
+
+    is_dataset = True
+
+    def __init__(self, data: Any, count: Optional[int] = None, mesh=None, _placed=False):
+        self.mesh = mesh or meshlib.current_mesh()
+        leaves = jax.tree_util.tree_leaves(data)
+        if not leaves:
+            raise ValueError("Dataset requires at least one array")
+        n = leaves[0].shape[0]
+        for leaf in leaves:
+            if leaf.shape[0] != n:
+                raise ValueError("all leaves must share the leading axis length")
+        self.count = int(count) if count is not None else n
+        shards = self.mesh.shape.get(meshlib.DATA_AXIS, 1)
+        padded = -(-self.count // shards) * shards if self.count else shards
+        if _placed and n == padded:
+            self.data = data
+        else:
+            if n < self.count:
+                raise ValueError("count exceeds data length")
+            data = jax.tree_util.tree_map(lambda x: _pad_to(x[: self.count], padded), data)
+            sharding = NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
+            self.data = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), data
+            )
+
+    # ------------------------------------------------------------- factories
+
+    @staticmethod
+    def from_numpy(x, count: Optional[int] = None, mesh=None) -> "Dataset":
+        return Dataset(np.asarray(x), count=count, mesh=mesh)
+
+    # ---------------------------------------------------------------- views
+
+    @property
+    def array(self):
+        """The padded, sharded pytree (single array in the common case)."""
+        return self.data
+
+    @property
+    def padded_count(self) -> int:
+        return jax.tree_util.tree_leaves(self.data)[0].shape[0]
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape.get(meshlib.DATA_AXIS, 1)
+
+    @property
+    def per_shard_count(self) -> int:
+        """Max examples per shard (≈ reference `numPerPartition`,
+        WorkflowUtils.scala:12-17)."""
+        return self.padded_count // self.n_shards
+
+    @property
+    def mask(self):
+        """Boolean validity mask over the padded leading axis."""
+        return (jnp.arange(self.padded_count) < self.count)
+
+    def numpy(self):
+        """Unpadded host copy (≈ `collect`)."""
+        return jax.tree_util.tree_map(lambda x: np.asarray(x)[: self.count], self.data)
+
+    def __len__(self) -> int:
+        return self.count
+
+    # ------------------------------------------------------------ operations
+
+    def map(self, fn: Callable, jitted: bool = True) -> "Dataset":
+        """Apply a per-item function via vmap (≈ `RDD.map`). ``fn`` must be
+        traceable; use `map_batches` for whole-batch functions."""
+        batched = jax.vmap(fn)
+        return self.map_batches(batched, jitted=jitted)
+
+    def map_batches(self, fn: Callable, jitted: bool = True, count: Optional[int] = None) -> "Dataset":
+        """Apply a whole-batch function to the padded sharded pytree. The
+        result keeps the leading axis and sharding."""
+        if jitted:
+            fn = jax.jit(fn)
+        out = fn(self.data)
+        return Dataset(out, count=count if count is not None else self.count,
+                       mesh=self.mesh, _placed=True)
+
+    def with_data(self, data: Any, count: Optional[int] = None) -> "Dataset":
+        """New Dataset sharing this one's mesh/count, for already-sharded
+        results of jitted computations."""
+        return Dataset(data, count=count if count is not None else self.count,
+                       mesh=self.mesh, _placed=True)
+
+    def cache(self) -> "Dataset":
+        """Device arrays are already materialized; block until compute
+        finishes so downstream timing is honest (≈ `.cache()` + action)."""
+        jax.block_until_ready(self.data)
+        return self
+
+    def sample_per_shard(self, k: int, seed: int = 0) -> "Dataset":
+        """Deterministic sample of ≤ k·n_shards valid examples, resharded
+        (≈ SampleCollector's per-partition samples,
+        NodeOptimizationRule.scala:145-197)."""
+        m = min(self.count, k * self.n_shards)
+        idx = np.linspace(0, self.count - 1, num=m, dtype=np.int64)
+        host = jax.tree_util.tree_map(lambda x: np.asarray(x)[idx], self.data)
+        return Dataset(host, count=m, mesh=self.mesh)
+
+    def take(self, k: int):
+        k = min(k, self.count)
+        return jax.tree_util.tree_map(lambda x: np.asarray(x[:k]), self.data)
+
+    def __repr__(self) -> str:
+        shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), self.data)
+        return f"Dataset(count={self.count}, shapes={shapes}, shards={self.n_shards})"
+
+
+class HostDataset:
+    """List-backed dataset of host objects (≈ RDD of JVM objects for the
+    non-dense stages: strings, token lists, variable-size images)."""
+
+    is_dataset = True
+
+    def __init__(self, items: Sequence[Any]):
+        self.items = list(items)
+
+    @property
+    def count(self) -> int:
+        return len(self.items)
+
+    @property
+    def per_shard_count(self) -> int:
+        return -(-len(self.items) // max(1, len(jax.devices())))
+
+    def map(self, fn: Callable) -> "HostDataset":
+        return HostDataset([fn(x) for x in self.items])
+
+    def cache(self) -> "HostDataset":
+        return self
+
+    def sample_per_shard(self, k: int, seed: int = 0) -> "HostDataset":
+        m = min(len(self.items), k * max(1, len(jax.devices())))
+        if m == 0:
+            return HostDataset([])
+        idx = np.linspace(0, len(self.items) - 1, num=m, dtype=np.int64)
+        return HostDataset([self.items[i] for i in idx])
+
+    def stack(self, dtype=None, mesh=None) -> Dataset:
+        """Stack fixed-shape items into a device `Dataset`."""
+        arr = np.stack([np.asarray(x, dtype=dtype) for x in self.items])
+        return Dataset(arr, mesh=mesh)
+
+    def numpy(self):
+        return self.items
+
+    def take(self, k: int):
+        return self.items[:k]
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def __iter__(self):
+        return iter(self.items)
+
+    def __repr__(self) -> str:
+        return f"HostDataset(count={len(self.items)})"
+
+
+def zip_datasets(datasets: List[Any]):
+    """Elementwise zip of N aligned datasets into one dataset of tuples
+    (≈ `RDD.zip`; used by the gather operator,
+    GatherTransformerOperator.scala:9-18)."""
+    if all(isinstance(d, HostDataset) for d in datasets):
+        return HostDataset([list(t) for t in zip(*(d.items for d in datasets))])
+    if all(isinstance(d, Dataset) for d in datasets):
+        counts = {d.count for d in datasets}
+        if len(counts) != 1:
+            raise ValueError(f"zip of misaligned datasets: counts {counts}")
+        return Dataset(
+            tuple(d.data for d in datasets),
+            count=datasets[0].count,
+            mesh=datasets[0].mesh,
+            _placed=True,
+        )
+    raise TypeError("zip_datasets requires all-device or all-host datasets")

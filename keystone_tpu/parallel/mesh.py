@@ -1,0 +1,113 @@
+"""Device-mesh management: the TPU-native replacement for the reference's
+Spark cluster topology.
+
+The reference's unit of distribution is the RDD partition; ours is the
+per-chip shard of a `jax.Array` laid out over a `jax.sharding.Mesh`
+(SURVEY.md §2.7). Conventions:
+
+  - axis ``"data"`` — batch/example axis (≈ RDD partitioning). Every
+    `Dataset` is sharded over it by default.
+  - axis ``"model"`` — feature/model axis used by the block solvers when a
+    model dimension is sharded (≈ `VectorSplitter`'s feature blocking,
+    reference nodes/util/VectorSplitter.scala:10-36).
+
+Spark's driver⇄executor split maps to host Python ⇄ SPMD XLA programs:
+`treeReduce` becomes `lax.psum`/GSPMD all-reduce over ICI, `broadcast`
+becomes replicated sharding (SURVEY.md §2.7 'Distributed communication
+backend').
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_mesh_stack: list = []
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Tuple[str, ...] = (DATA_AXIS,),
+) -> Mesh:
+    """Build a mesh. Default: all local devices on a 1-D ``data`` axis."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if shape is not None:
+        devices = devices.reshape(shape)
+    elif len(axis_names) > 1:
+        raise ValueError("shape is required for multi-axis meshes")
+    return Mesh(devices, axis_names)
+
+
+def current_mesh() -> Mesh:
+    """The active mesh: innermost `use_mesh` context, else a process-wide
+    default over all local devices."""
+    if _mesh_stack:
+        return _mesh_stack[-1]
+    global _default_mesh
+    if _default_mesh is None or set(np.ravel(_default_mesh.devices)) != set(jax.devices()):
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+@contextmanager
+def use_mesh(mesh: Mesh):
+    _mesh_stack.append(mesh)
+    try:
+        yield mesh
+    finally:
+        _mesh_stack.pop()
+
+
+def reset_default_mesh() -> None:
+    global _default_mesh
+    _default_mesh = None
+    _mesh_stack.clear()
+
+
+def n_data_shards(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or current_mesh()
+    return mesh.shape.get(DATA_AXIS, 1)
+
+
+def n_model_shards(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or current_mesh()
+    return mesh.shape.get(MODEL_AXIS, 1)
+
+
+def data_spec(extra_axes: int = 0) -> P:
+    """PartitionSpec sharding the leading (example) axis over ``data``."""
+    return P(DATA_AXIS, *([None] * extra_axes))
+
+
+def data_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or current_mesh(), P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or current_mesh(), P())
+
+
+def shard_leading_axis(x, mesh: Optional[Mesh] = None):
+    """Place an array on the mesh, sharded over the leading axis.
+
+    The leading dim must already be padded to a multiple of the data-axis
+    size (see `Dataset`)."""
+    mesh = mesh or current_mesh()
+    return jax.device_put(x, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+def replicate(x, mesh: Optional[Mesh] = None):
+    """Replicate a value across the mesh (≈ `sc.broadcast`)."""
+    mesh = mesh or current_mesh()
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -1,0 +1,36 @@
+"""Persistence that converts device arrays to host numpy.
+
+Used by `FittedPipeline.save/load` (reference FittedPipeline.scala:18-48
+uses Java serialization; here cloudpickle handles closures and
+locally-defined transformer classes — the common pattern of estimators
+returning transformers built inside ``fit`` — and device-resident
+`jax.Array` leaves are rewritten to numpy so artifacts are portable across
+hosts/topologies; `jnp` ops accept numpy inputs transparently on load).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import cloudpickle
+import jax
+import numpy as np
+
+
+class _DeviceAwarePickler(cloudpickle.CloudPickler):
+    def reducer_override(self, obj):
+        if isinstance(obj, jax.Array):
+            return (np.asarray, (np.asarray(obj),))
+        return super().reducer_override(obj)
+
+
+def save_pytree_pickle(obj: Any, path: str) -> None:
+    with open(path, "wb") as f:
+        _DeviceAwarePickler(f, protocol=5).dump(obj)
+
+
+def load_pytree_pickle(path: str) -> Any:
+    import pickle
+
+    with open(path, "rb") as f:
+        return pickle.load(f)

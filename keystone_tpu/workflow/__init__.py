@@ -1,0 +1,54 @@
+"""Workflow core: the lazy memoized DAG runtime + typed combinator API."""
+
+from .graph import Graph, NodeId, NodeOrSourceId, SinkId, SourceId
+from .expressions import (
+    DatasetExpression,
+    DatumExpression,
+    Expression,
+    TransformerExpression,
+)
+from .operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    ExpressionOperator,
+    GatherTransformerOperator,
+    Operator,
+    TransformerOperator,
+)
+from .env import PipelineEnv, Prefix, compute_prefix
+from .executor import GraphExecutor
+from .optimizer import (
+    AutoCachingOptimizer,
+    Batch,
+    DefaultOptimizer,
+    EquivalentNodeMergeRule,
+    ExtractSaveablePrefixes,
+    NodeOptimizationRule,
+    Optimizer,
+    Rule,
+    RuleExecutor,
+    SavedStateLoadRule,
+    UnusedBranchRemovalRule,
+)
+from .pipeline import (
+    Chainable,
+    Estimator,
+    EstimatorChain,
+    FittedPipeline,
+    LabelEstimator,
+    LabelEstimatorChain,
+    OptimizableEstimator,
+    OptimizableLabelEstimator,
+    OptimizableTransformer,
+    Pipeline,
+    PipelineDataset,
+    PipelineDatum,
+    PipelineResult,
+    Transformer,
+    TransformerChain,
+)
+from . import analysis
+
+__all__ = [n for n in dir() if not n.startswith("_")]

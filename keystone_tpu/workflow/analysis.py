@@ -1,0 +1,84 @@
+"""Graph topology queries (reference workflow/AnalysisUtils.scala:15-122)."""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from .graph import Graph, GraphId, NodeId, SinkId, SourceId
+
+
+def parents(graph: Graph, vid: GraphId) -> List[GraphId]:
+    """Direct dependencies of a vertex, in order."""
+    if isinstance(vid, SinkId):
+        return [graph.get_sink_dependency(vid)]
+    if isinstance(vid, NodeId):
+        return list(graph.get_dependencies(vid))
+    return []
+
+
+def children(graph: Graph, vid: GraphId) -> Set[GraphId]:
+    """Vertices that directly depend on ``vid``."""
+    out: Set[GraphId] = set()
+    if isinstance(vid, SinkId):
+        return out
+    for n, deps in graph.dependencies.items():
+        if vid in deps:
+            out.add(n)
+    for s, d in graph.sink_dependencies.items():
+        if d == vid:
+            out.add(s)
+    return out
+
+
+def ancestors(graph: Graph, vid: GraphId) -> Set[GraphId]:
+    """All transitive dependencies (excluding ``vid``)."""
+    seen: Set[GraphId] = set()
+    stack = list(parents(graph, vid))
+    while stack:
+        v = stack.pop()
+        if v in seen:
+            continue
+        seen.add(v)
+        stack.extend(parents(graph, v))
+    return seen
+
+
+def descendants(graph: Graph, vid: GraphId) -> Set[GraphId]:
+    """All transitive dependents (excluding ``vid``)."""
+    seen: Set[GraphId] = set()
+    stack = list(children(graph, vid))
+    while stack:
+        v = stack.pop()
+        if v in seen:
+            continue
+        seen.add(v)
+        stack.extend(children(graph, v))
+    return seen
+
+
+def linearize(graph: Graph, vid: GraphId = None) -> List[GraphId]:
+    """Deterministic topological order of (the ancestors of) ``vid``, or of
+    the whole graph when ``vid`` is None (AnalysisUtils.scala:87-122).
+
+    Dependencies appear before dependents; ties broken by id ordering for
+    determinism.
+    """
+    order: List[GraphId] = []
+    visited: Set[GraphId] = set()
+
+    def visit(v: GraphId) -> None:
+        if v in visited:
+            return
+        visited.add(v)
+        for p in parents(graph, v):
+            visit(p)
+        order.append(v)
+
+    if vid is not None:
+        visit(vid)
+    else:
+        roots: List[GraphId] = sorted(graph.sink_dependencies, key=lambda s: s.id)
+        roots += sorted(graph.operators, key=lambda n: n.id)
+        for r in roots:
+            visit(r)
+    return order

@@ -1,0 +1,124 @@
+"""Process-global pipeline environment and structural prefixes.
+
+`Prefix` (reference workflow/Prefix.scala:4-30) is a structural hash of a
+node's full ancestry — operator identity plus the prefixes of its
+dependencies. It is the key for cross-pipeline fitted-state reuse: every
+Cacher/Estimator output is memoized in `PipelineEnv.state` under its prefix
+and swapped back in by `SavedStateLoadRule` on later optimizations, so
+re-applying or extending a pipeline never refits
+(reference PipelineEnv.scala:7-45, ExtractSaveablePrefixes.scala:9-22).
+
+Like the reference, none of this is thread-safe; safety comes from a
+single-threaded host orchestrator and immutable graphs
+(Pipeline.scala:14, PipelineEnv.scala:11).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from .expressions import Expression
+from .graph import Graph, NodeId, SourceId
+
+
+@dataclass(frozen=True)
+class Prefix:
+    """Structural identity of a node's ancestry (Prefix.scala:4-30)."""
+
+    operator_key: Tuple
+    dep_prefixes: Tuple["Prefix", ...]
+
+
+def compute_prefix(graph: Graph, node: NodeId, _memo=None) -> Optional[Prefix]:
+    """Prefix of ``node``, or None if any ancestor is an unbound source
+    (unbound ancestry has no stable identity — Prefix.scala:13-27)."""
+    if _memo is None:
+        _memo = {}
+    if node in _memo:
+        return _memo[node]
+    dep_prefixes = []
+    for d in graph.get_dependencies(node):
+        if isinstance(d, SourceId):
+            _memo[node] = None
+            return None
+        dp = compute_prefix(graph, d, _memo)
+        if dp is None:
+            _memo[node] = None
+            return None
+        dep_prefixes.append(dp)
+    p = Prefix(graph.get_operator(node).prefix_key(), tuple(dep_prefixes))
+    _memo[node] = p
+    return p
+
+
+class PipelineEnv:
+    """Process-global state: prefix→Expression memo table + current
+    optimizer (PipelineEnv.scala:7-45). ``reset()`` exists for tests."""
+
+    _instance: Optional["PipelineEnv"] = None
+
+    def __init__(self):
+        self.state: Dict[Prefix, Expression] = {}
+        self._optimizer = None
+
+    @classmethod
+    def get(cls) -> "PipelineEnv":
+        if cls._instance is None:
+            cls._instance = PipelineEnv()
+        return cls._instance
+
+    def get_optimizer(self):
+        if self._optimizer is None:
+            from .optimizer import DefaultOptimizer
+
+            self._optimizer = DefaultOptimizer()
+        return self._optimizer
+
+    def set_optimizer(self, optimizer) -> None:
+        self._optimizer = optimizer
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+
+class IdentityKey:
+    """Hashable wrapper keying on *object identity* while holding a strong
+    reference, so a garbage-collected object's address can never be reused
+    by a different object and silently collide in the prefix table."""
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __hash__(self) -> int:
+        return id(self.obj)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, IdentityKey) and other.obj is self.obj
+
+    def __repr__(self) -> str:
+        return f"IdentityKey({type(self.obj).__name__}@{id(self.obj):#x})"
+
+
+def _operator_prefix_key(self) -> Tuple:
+    """Default operator identity for prefix/CSE purposes: object identity.
+
+    The reference relies on Scala case-class equality of operators; here
+    operators carrying fitted state or closures are only equal to
+    themselves, which is exactly the sharing pattern the reference exploits
+    (the same node object reused across pipeline graphs). Operators with
+    meaningful structural identity (e.g. DatasetOperator keyed on its
+    dataset) override this.
+    """
+    return (type(self).__qualname__, IdentityKey(self))
+
+
+# Attach default prefix_key to Operator without circular imports.
+from .operators import DatasetOperator, DatumOperator, Operator  # noqa: E402
+
+Operator.prefix_key = _operator_prefix_key
+DatasetOperator.prefix_key = lambda self: ("Dataset", IdentityKey(self.dataset))
+DatumOperator.prefix_key = lambda self: ("Datum", IdentityKey(self.datum))

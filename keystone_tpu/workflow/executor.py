@@ -1,0 +1,76 @@
+"""Lazy memoized graph execution.
+
+Mirrors reference workflow/GraphExecutor.scala:14-81: execution of a graph
+up to a `GraphId` optimizes the graph once (lazily, via the globally
+configured optimizer), then recursively evaluates dependencies with
+per-vertex memoization. Results of nodes whose prefixes were marked
+saveable are written into the global prefix table so later executors can
+reuse them (fit-once guarantee, GraphExecutor.scala:65-71).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .env import PipelineEnv, Prefix
+from .expressions import Expression
+from .graph import Graph, GraphId, NodeId, SinkId, SourceId
+
+
+class GraphExecutor:
+    def __init__(
+        self,
+        graph: Graph,
+        optimize: bool = True,
+        plan: Optional[Tuple[Graph, Dict[NodeId, Prefix]]] = None,
+    ):
+        """``plan`` supplies an already-optimized (graph, prefixes) pair,
+        bypassing the optimizer (used by `Pipeline.fit`)."""
+        self._raw_graph = graph
+        self._optimize = optimize
+        self._optimized: Optional[Tuple[Graph, Dict[NodeId, Prefix]]] = plan
+        self._memo: Dict[GraphId, Expression] = {}
+
+    @property
+    def graph(self) -> Graph:
+        """The unoptimized graph (used for graph splicing)."""
+        return self._raw_graph
+
+    @property
+    def optimized_graph(self) -> Graph:
+        return self._optimized_plan()[0]
+
+    def _optimized_plan(self) -> Tuple[Graph, Dict[NodeId, Prefix]]:
+        if self._optimized is None:
+            if self._optimize:
+                optimizer = PipelineEnv.get().get_optimizer()
+                self._optimized = optimizer.execute(self._raw_graph)
+            else:
+                self._optimized = (self._raw_graph, {})
+        return self._optimized
+
+    def execute(self, graph_id: GraphId) -> Expression:
+        """Execute up to ``graph_id``, returning its lazy Expression
+        (GraphExecutor.scala:53-80)."""
+        graph, prefixes = self._optimized_plan()
+        env = PipelineEnv.get()
+
+        def go(vid: GraphId) -> Expression:
+            if vid in self._memo:
+                return self._memo[vid]
+            if isinstance(vid, SourceId):
+                raise ValueError(
+                    f"{vid} is an unbound source; bind data by applying the pipeline"
+                )
+            if isinstance(vid, SinkId):
+                expr = go(graph.get_sink_dependency(vid))
+            else:
+                dep_exprs = [go(d) for d in graph.get_dependencies(vid)]
+                expr = graph.get_operator(vid).execute(dep_exprs)
+                prefix = prefixes.get(vid)
+                if prefix is not None and prefix not in env.state:
+                    env.state[prefix] = expr
+            self._memo[vid] = expr
+            return expr
+
+        return go(graph_id)

@@ -1,0 +1,155 @@
+"""Untyped execution units stored in graph nodes.
+
+Mirrors reference workflow/Operator.scala:10-176 and
+GatherTransformerOperator.scala:9-18. Each operator consumes a list of
+`Expression`s (one per dependency, in order) and produces an `Expression`;
+everything stays lazy until a sink is forced.
+
+The dual batch/single dispatch (`batch_transform` vs `single_transform`,
+chosen by inspecting the dependency expression types, reference
+Operator.scala:77-100) is preserved: the same pipeline graph serves both a
+whole dataset and a single datum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+from .expressions import (
+    DatasetExpression,
+    DatumExpression,
+    Expression,
+    TransformerExpression,
+)
+
+
+class Operator:
+    """Base class. Subclasses implement ``execute``."""
+
+    @property
+    def label(self) -> str:
+        return type(self).__name__
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.label
+
+
+class DatasetOperator(Operator):
+    """Zero-dep operator wrapping an already-materialized dataset
+    (Operator.scala:19-26)."""
+
+    def __init__(self, dataset: Any, name: str = "dataset"):
+        self.dataset = dataset
+        self.name = name
+
+    @property
+    def label(self) -> str:
+        return f"Dataset[{self.name}]"
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        assert not deps
+        return DatasetExpression.of(self.dataset)
+
+
+class DatumOperator(Operator):
+    """Zero-dep operator wrapping a single datum (Operator.scala:28-35)."""
+
+    def __init__(self, datum: Any):
+        self.datum = datum
+
+    @property
+    def label(self) -> str:
+        return "Datum"
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        assert not deps
+        return DatumExpression.of(self.datum)
+
+
+class TransformerOperator(Operator):
+    """An operator with both per-item and bulk execution paths
+    (Operator.scala:37-100).
+
+    Subclasses (i.e. every `Transformer` node) implement
+    ``single_transform`` and ``batch_transform``. Dispatch: if any
+    dependency is a `DatumExpression` the single-item path runs, else the
+    batch path (Operator.scala:77-100).
+    """
+
+    def single_transform(self, inputs: List[Any]) -> Any:
+        raise NotImplementedError
+
+    def batch_transform(self, inputs: List[Any]) -> Any:
+        raise NotImplementedError
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        deps = list(deps)
+        if any(isinstance(d, DatumExpression) for d in deps):
+            return DatumExpression(lambda: self.single_transform([d.get for d in deps]))
+        return DatasetExpression(lambda: self.batch_transform([d.get for d in deps]))
+
+
+class EstimatorOperator(Operator):
+    """Fits on datasets, lazily producing a TransformerOperator
+    (Operator.scala:102-116)."""
+
+    def fit_datasets(self, inputs: List[Any]) -> TransformerOperator:
+        raise NotImplementedError
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        deps = list(deps)
+        return TransformerExpression(lambda: self.fit_datasets([d.get for d in deps]))
+
+
+class DelegatingOperator(Operator):
+    """Applies the transformer produced by its first dependency to the rest
+    (Operator.scala:136-163). Forcing the transformer expression is the
+    moment an estimator's fit actually happens."""
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        deps = list(deps)
+        assert deps, "DelegatingOperator requires a transformer dependency"
+        transformer_expr, data_deps = deps[0], deps[1:]
+        assert isinstance(transformer_expr, TransformerExpression)
+        if any(isinstance(d, DatumExpression) for d in data_deps):
+            return DatumExpression(
+                lambda: transformer_expr.get.single_transform([d.get for d in data_deps])
+            )
+        return DatasetExpression(
+            lambda: transformer_expr.get.batch_transform([d.get for d in data_deps])
+        )
+
+
+class ExpressionOperator(Operator):
+    """Wraps an already-computed Expression — used by the saved-state rule to
+    splice memoized results into a plan (Operator.scala:118-134)."""
+
+    def __init__(self, expression: Expression, name: str = "saved"):
+        self.expression = expression
+        self.name = name
+
+    @property
+    def label(self) -> str:
+        return f"Saved[{self.name}]"
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        return self.expression
+
+
+class GatherTransformerOperator(TransformerOperator):
+    """Zips N branches into a list per item (GatherTransformerOperator.scala:9-18).
+
+    For the batch path the branch datasets are combined elementwise via the
+    dataset zip utility; for the single path the inputs are simply collected.
+    """
+
+    def single_transform(self, inputs: List[Any]) -> Any:
+        return list(inputs)
+
+    def batch_transform(self, inputs: List[Any]) -> Any:
+        from ..data.dataset import zip_datasets
+
+        return zip_datasets(inputs)

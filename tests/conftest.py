@@ -1,0 +1,34 @@
+"""Test harness.
+
+Mirrors the reference's `PipelineContext` trait
+(src/test/scala/workflow/PipelineContext.scala:9-26): where the reference
+runs every "distributed" test on local-mode Spark, we run on a virtual
+8-device CPU mesh (XLA host-platform device-count override), exercising
+the full shard/collective code path in one process. Each test resets the
+process-global `PipelineEnv` so prefix-memoized fitted state cannot leak
+between tests.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_pipeline_env():
+    from keystone_tpu.workflow.env import PipelineEnv
+    from keystone_tpu.parallel.mesh import reset_default_mesh
+
+    PipelineEnv.reset()
+    reset_default_mesh()
+    yield
+    PipelineEnv.reset()
+    reset_default_mesh()

@@ -1,0 +1,154 @@
+"""Graph data-structure tests (model: reference GraphSuite.scala:41-711)."""
+
+import pytest
+
+from keystone_tpu.workflow import (
+    DatasetOperator,
+    Graph,
+    NodeId,
+    SinkId,
+    SourceId,
+    analysis,
+)
+from keystone_tpu.workflow.pipeline import Transformer
+
+
+def op(name="op"):
+    return Transformer.from_function(lambda x: x, name=name)
+
+
+def build_chain():
+    """source -> a -> b -> sink"""
+    g = Graph()
+    g, s = g.add_source()
+    g, a = g.add_node(op("a"), [s])
+    g, b = g.add_node(op("b"), [a])
+    g, k = g.add_sink(b)
+    return g, s, a, b, k
+
+
+def test_add_node_and_views():
+    g, s, a, b, k = build_chain()
+    assert g.sources == {s}
+    assert g.nodes == {a, b}
+    assert g.sink_ids == {k}
+    assert g.get_dependencies(b) == (a,)
+    assert g.get_sink_dependency(k) == b
+
+
+def test_add_node_rejects_missing_dep():
+    g = Graph()
+    with pytest.raises(ValueError):
+        g.add_node(op(), [NodeId(42)])
+    with pytest.raises(ValueError):
+        g.add_node(op(), [SourceId(7)])
+
+
+def test_add_sink_rejects_missing_dep():
+    g = Graph()
+    with pytest.raises(ValueError):
+        g.add_sink(NodeId(0))
+
+
+def test_remove_node_with_users_fails():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.remove_node(a)  # b depends on a
+    with pytest.raises(ValueError):
+        g.remove_node(b)  # sink depends on b
+
+
+def test_remove_leaf_node():
+    g, s, a, b, k = build_chain()
+    g = g.remove_sink(k)
+    g = g.remove_node(b)
+    assert g.nodes == {a}
+
+
+def test_remove_source_with_users_fails():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.remove_source(s)
+
+
+def test_set_operator_and_dependencies():
+    g, s, a, b, k = build_chain()
+    new_op = op("c")
+    g2 = g.set_operator(b, new_op)
+    assert g2.get_operator(b) is new_op
+    assert g.get_operator(b) is not new_op  # immutability
+    g3 = g2.set_dependencies(b, [s])
+    assert g3.get_dependencies(b) == (s,)
+    with pytest.raises(ValueError):
+        g.set_operator(NodeId(99), new_op)
+
+
+def test_replace_dependency():
+    g, s, a, b, k = build_chain()
+    g2 = g.replace_dependency(b, a)  # sink now points at a
+    assert g2.get_sink_dependency(k) == a
+
+
+def test_immutability_of_mutators():
+    g, s, a, b, k = build_chain()
+    g.add_node(op(), [a])
+    assert g.nodes == {a, b}  # original untouched
+
+
+def test_add_graph_remaps_ids():
+    g1, s1, a1, b1, k1 = build_chain()
+    g2, s2, a2, b2, k2 = build_chain()
+    merged, smap, kmap = g1.add_graph(g2)
+    assert len(merged.nodes) == 4
+    assert len(merged.sources) == 2
+    assert len(merged.sink_ids) == 2
+    assert smap[s2] != s1
+    # remapped deps preserved
+    new_b = kmap[k2]
+    dep = merged.get_sink_dependency(new_b)
+    assert merged.get_dependencies(dep)[0] in merged.nodes
+
+
+def test_connect_graph_splices_source():
+    g1, s1, a1, b1, k1 = build_chain()
+    g2, s2, a2, b2, k2 = build_chain()
+    merged, kmap = g1.connect_graph(g2, {s2: b1})
+    # g2's source is gone; its first node now depends on g1's b
+    assert len(merged.sources) == 1
+    spliced_tail = merged.get_sink_dependency(kmap[k2])
+    head = merged.get_dependencies(spliced_tail)[0]
+    assert merged.get_dependencies(head) == (b1,)
+
+
+def test_replace_nodes():
+    g, s, a, b, k = build_chain()
+    # replacement: one node consuming one source
+    r = Graph()
+    r, rs = r.add_source()
+    r, rn = r.add_node(op("r"), [rs])
+    r, rk = r.add_sink(rn)
+    g2 = g.replace_nodes([b], r, {rs: a}, {b: rk})
+    assert b not in g2.nodes
+    tail = g2.get_sink_dependency(k)
+    assert g2.get_operator(tail).label == "r"
+    assert g2.get_dependencies(tail) == (a,)
+
+
+def test_linearize_deterministic_topo_order():
+    g, s, a, b, k = build_chain()
+    order = analysis.linearize(g, k)
+    assert order.index(s) < order.index(a) < order.index(b) < order.index(k)
+
+
+def test_ancestors_descendants_children_parents():
+    g, s, a, b, k = build_chain()
+    assert analysis.ancestors(g, k) == {s, a, b}
+    assert analysis.descendants(g, s) == {a, b, k}
+    assert analysis.children(g, a) == {b}
+    assert analysis.parents(g, b) == [a]
+
+
+def test_to_dot_contains_all_vertices():
+    g, s, a, b, k = build_chain()
+    dot = g.to_dot()
+    assert f"source_{s.id}" in dot and f"sink_{k.id}" in dot

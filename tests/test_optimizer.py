@@ -1,0 +1,182 @@
+"""Optimizer-rule tests (model: reference NodeOptimizationRuleSuite.scala,
+AutocCacheRuleSuite.scala:74-181) plus regression tests for review
+findings (HostDataset routing, stale prefix identity)."""
+
+import gc
+
+import numpy as np
+import pytest
+
+from keystone_tpu import Dataset, HostDataset, Pipeline, PipelineEnv, Transformer
+from keystone_tpu.workflow import Estimator
+from keystone_tpu.workflow.autocache import (
+    AutoCacheRule,
+    CacheMarker,
+    Profile,
+    estimate_cached_run_time,
+    get_runs,
+)
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.optimizer import AutoCachingOptimizer
+from keystone_tpu.workflow.pipeline import OptimizableEstimator
+
+
+class Upper(Transformer):
+    def apply(self, x):
+        return x.upper()
+
+
+def test_host_dataset_routed_to_batch_path():
+    out = Upper()(HostDataset(["a", "b"])).get()
+    assert isinstance(out, HostDataset)
+    assert out.items == ["A", "B"]
+
+
+def test_host_dataset_through_gather():
+    p = Pipeline.gather([Upper(), Upper()])
+    out = p(HostDataset(["x"])).get()
+    assert out.items == [["X", "X"]]
+
+
+def test_autocaching_optimizer_instantiates_and_runs():
+    PipelineEnv.get().set_optimizer(AutoCachingOptimizer(strategy="aggressive"))
+    ds = Dataset.from_numpy(np.ones((8, 2), np.float32))
+    p = Transformer.from_function(lambda x: x + 1).to_pipeline()
+    out = p(ds).get()
+    np.testing.assert_allclose(out.numpy(), 2 * np.ones((8, 2)))
+
+
+class MeanEstimator(Estimator):
+    n_fits = 0
+
+    def fit(self, data):
+        MeanEstimator.n_fits += 1
+        mu = float(np.mean(data.numpy()))
+        return Transformer.from_function(lambda x: x - mu)
+
+
+def test_prefix_identity_survives_gc_address_reuse():
+    """Stale-state regression: freed estimators/datasets must never collide
+    with new objects reusing the same address (review finding)."""
+    start_fits = MeanEstimator.n_fits
+    outs = []
+    for i in range(4):
+        est = MeanEstimator()
+        train = Dataset.from_numpy(np.full((4, 1), float(i), np.float32))
+        p = Transformer.from_function(lambda x: x).to_pipeline().and_then(est, train)
+        outs.append(float(p(np.float32(10.0)).get()))
+        del est, train, p
+        gc.collect()
+    assert outs == [10.0, 9.0, 8.0, 7.0]
+    assert MeanEstimator.n_fits - start_fits == 4
+
+
+# ---------------------------------------------------------------- autocache
+
+
+def _diamond_graph():
+    """source-free diamond: data -> f -> {g, h} -> (both weighted users)."""
+    ident = lambda name: Transformer.from_function(lambda x: x, name=name)
+    g = Graph()
+    g, data = g.add_node(
+        __import__("keystone_tpu.workflow.operators", fromlist=["DatasetOperator"]).DatasetOperator(
+            Dataset.from_numpy(np.ones((8, 2), np.float32))
+        ),
+        [],
+    )
+    g, f = g.add_node(ident("f"), [data])
+    g, a = g.add_node(ident("a"), [f])
+    g, b = g.add_node(ident("b"), [f])
+    g, s1 = g.add_sink(a)
+    g, s2 = g.add_sink(b)
+    return g, data, f, a, b
+
+
+def test_get_runs_counts_weighted_demand():
+    g, data, f, a, b = _diamond_graph()
+    runs = get_runs(g, cached=set())
+    assert runs[a] == 1 and runs[b] == 1
+    assert runs[f] == 2  # two consumers
+    # weight on a consumer multiplies demand
+    g2 = g.set_operator(a, WeightedIdentity(3))
+    runs2 = get_runs(g2, cached=set())
+    assert runs2[f] == 4  # 3 (weighted a) + 1 (b)
+    # caching f collapses its runs
+    assert get_runs(g2, cached={f})[f] == 1
+
+
+class WeightedIdentity(Transformer):
+    def __init__(self, weight):
+        self.weight = weight
+
+    def apply(self, x):
+        return x
+
+
+def test_aggressive_cache_inserts_marker_on_shared_node():
+    g, data, f, a, b = _diamond_graph()
+    rule = AutoCacheRule(strategy="aggressive")
+    g2, _ = rule.apply((g, {}))
+    cache_nodes = [
+        n for n in g2.nodes if isinstance(g2.get_operator(n), CacheMarker)
+    ]
+    assert len(cache_nodes) == 1
+    (c,) = cache_nodes
+    assert g2.get_dependencies(c) == (f,)
+    # both consumers rewired through the cache
+    assert g2.get_dependencies(a) == (c,)
+    assert g2.get_dependencies(b) == (c,)
+
+
+def test_greedy_cache_respects_memory_budget():
+    g, data, f, a, b = _diamond_graph()
+    profiles = {f: Profile(ns=1e9, mem_bytes=100.0)}
+    # budget too small: no caching
+    rule = AutoCacheRule(strategy="greedy", mem_budget_bytes=10)
+    rule_profiles = lambda *args, **kw: profiles
+    import keystone_tpu.workflow.autocache as ac
+
+    orig = ac.profile_nodes
+    ac.profile_nodes = lambda *a, **k: profiles
+    try:
+        g_small, _ = rule.apply((g, {}))
+        assert not any(isinstance(g_small.get_operator(n), CacheMarker) for n in g_small.nodes)
+        # ample budget: caches f
+        rule2 = AutoCacheRule(strategy="greedy", mem_budget_bytes=10_000)
+        g_big, _ = rule2.apply((g, {}))
+        assert any(isinstance(g_big.get_operator(n), CacheMarker) for n in g_big.nodes)
+    finally:
+        ac.profile_nodes = orig
+
+
+def test_estimate_cached_run_time():
+    g, data, f, a, b = _diamond_graph()
+    profiles = {f: Profile(1000.0, 1.0), a: Profile(10.0, 1.0), b: Profile(10.0, 1.0)}
+    uncached = estimate_cached_run_time(g, set(), profiles)
+    cached = estimate_cached_run_time(g, {f}, profiles)
+    assert uncached == 2 * 1000 + 10 + 10
+    assert cached == 1000 + 10 + 10
+
+
+class RoutingEstimator(OptimizableEstimator):
+    """Picks an implementation from the sample size (cost-model routing
+    pattern, LeastSquaresEstimatorSuite analog)."""
+
+    def __init__(self):
+        self.chosen = None
+
+    @property
+    def default(self):
+        return MeanEstimator()
+
+    def optimize(self, sample, num_per_shard):
+        self.chosen = "big" if num_per_shard > 10 else "small"
+        return MeanEstimator()
+
+
+def test_node_optimization_rule_consults_sample():
+    est = RoutingEstimator()
+    train = Dataset.from_numpy(np.arange(800, dtype=np.float32).reshape(100, 8))
+    p = Transformer.from_function(lambda x: x).to_pipeline().and_then(est, train)
+    _ = p(train).get()
+    assert est.chosen == "big"  # 100 rows over 8 shards -> 13/shard > 10

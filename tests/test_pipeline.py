@@ -1,0 +1,217 @@
+"""Pipeline-semantics tests (model: reference PipelineSuite.scala).
+
+Covers chaining, laziness, single-vs-batch parity, the fit-once guarantee
+(mutable fit counters, PipelineSuite.scala:28-52), incremental state reuse
+across applies (:115-240), gather, and fit() → FittedPipeline (:389-520).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu import Dataset, Pipeline, PipelineEnv, Transformer
+from keystone_tpu.workflow import Estimator, FittedPipeline, LabelEstimator
+
+
+class Add(Transformer):
+    def __init__(self, c):
+        self.c = c
+
+    def apply(self, x):
+        return x + self.c
+
+
+class Scale(Transformer):
+    def __init__(self, c):
+        self.c = c
+
+    def apply(self, x):
+        return x * self.c
+
+
+class CountingMeanEstimator(Estimator):
+    """Fits a transformer subtracting the dataset mean; counts fits."""
+
+    def __init__(self):
+        self.n_fits = 0
+
+    def fit(self, data):
+        self.n_fits += 1
+        mu = float(np.mean(data.numpy()))
+        return Add(-mu)
+
+
+class CountingLinearLabelEstimator(LabelEstimator):
+    def __init__(self):
+        self.n_fits = 0
+
+    def fit(self, data, labels):
+        self.n_fits += 1
+        X = data.numpy()
+        y = labels.numpy()
+        w, *_ = np.linalg.lstsq(X, y, rcond=None)
+        W = w
+
+        class Lin(Transformer):
+            def apply(self, x):
+                return jnp.dot(x, W)
+
+        return Lin()
+
+
+def dvec(values):
+    return Dataset.from_numpy(np.asarray(values, dtype=np.float32))
+
+
+def test_transformer_batch_and_single_parity():
+    t = Add(2.0)
+    ds = dvec([[1.0], [2.0], [3.0]])
+    out = t(ds).get()
+    np.testing.assert_allclose(out.numpy(), [[3.0], [4.0], [5.0]])
+    single = t(np.float32(1.0)).get()
+    assert float(single) == 3.0
+
+
+def test_and_then_composition_order():
+    p = Add(1.0).and_then(Scale(10.0))
+    out = p(np.float32(2.0)).get()
+    assert float(out) == 30.0
+    # >> operator sugar
+    p2 = Add(1.0) >> Scale(10.0) >> Add(5.0)
+    assert float(p2(np.float32(0.0)).get()) == 15.0
+
+
+def test_laziness_no_execution_until_get():
+    calls = []
+
+    class Tracker(Transformer):
+        def apply(self, x):
+            calls.append(1)
+            return x
+
+    result = Tracker()(np.float32(1.0))
+    assert calls == []
+    result.get()
+    assert calls == [1]
+
+
+def test_estimator_fit_once_across_applies():
+    """Do not fit estimators multiple times (PipelineSuite.scala:28-52)."""
+    est = CountingMeanEstimator()
+    train = dvec([[0.0], [2.0], [4.0]])
+    p = Add(0.0).and_then(est, train)
+    test1 = dvec([[1.0]])
+    test2 = dvec([[5.0]])
+    out1 = p(test1).get()
+    out2 = p(test2).get()
+    assert est.n_fits == 1
+    np.testing.assert_allclose(out1.numpy(), [[-1.0]])
+    np.testing.assert_allclose(out2.numpy(), [[3.0]])
+
+
+def test_single_item_apply_reuses_fit():
+    est = CountingMeanEstimator()
+    train = dvec([[0.0], [2.0], [4.0]])
+    p = Add(0.0).and_then(est, train)
+    assert float(p(np.float32(3.0)).get()) == 1.0
+    assert float(p(np.float32(5.0)).get()) == 3.0
+    assert est.n_fits == 1
+
+
+def test_label_estimator_and_prediction():
+    est = CountingLinearLabelEstimator()
+    X = dvec([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+    y = dvec([[2.0], [3.0], [5.0]])
+    p = Add(0.0).and_then(est, X, y)
+    preds = p(X).get().numpy()
+    np.testing.assert_allclose(preds, [[2.0], [3.0], [5.0]], atol=1e-4)
+    assert est.n_fits == 1
+
+
+def test_extending_pipeline_reuses_fitted_state():
+    """Adding stages after a fit does not refit (PipelineSuite.scala:115-240)."""
+    est = CountingMeanEstimator()
+    train = dvec([[2.0], [4.0]])
+    base = Add(0.0).and_then(est, train)
+    _ = base(dvec([[1.0]])).get()
+    assert est.n_fits == 1
+    extended = base.and_then(Scale(2.0))
+    out = extended(dvec([[1.0]])).get()
+    assert est.n_fits == 1  # reused via prefix state
+    np.testing.assert_allclose(out.numpy(), [[-4.0]])
+
+
+def test_gather_merges_branches():
+    branches = [Add(float(i)) for i in range(3)]
+    p = Pipeline.gather(branches)
+    out = p(np.float32(10.0)).get()
+    assert [float(v) for v in out] == [10.0, 11.0, 12.0]
+    # batch path: produces tuple-structured dataset
+    ds_out = p(dvec([[1.0], [2.0]])).get()
+    parts = ds_out.numpy()
+    np.testing.assert_allclose(parts[0], [[1.0], [2.0]])
+    np.testing.assert_allclose(parts[2], [[3.0], [4.0]])
+
+
+def test_fit_produces_serializable_fitted_pipeline(tmp_path):
+    est = CountingMeanEstimator()
+    train = dvec([[2.0], [4.0]])
+    p = Add(1.0).and_then(est, train).and_then(Scale(3.0))
+    fitted = p.fit()
+    assert isinstance(fitted, FittedPipeline)
+    assert est.n_fits == 1
+    # fitted pipeline applies eagerly, without refit
+    assert float(fitted(np.float32(3.0))) == 0.0  # ((3+1)-4)*3
+    assert est.n_fits == 1
+    path = str(tmp_path / "fitted.pkl")
+    fitted.save(path)
+    loaded = FittedPipeline.load(path)
+    assert float(loaded(np.float32(5.0))) == 6.0
+
+
+def test_fit_prunes_training_branches():
+    est = CountingMeanEstimator()
+    train = dvec([[2.0], [4.0]])
+    p = Add(0.0).and_then(est, train)
+    fitted = p.fit()
+    # no DatasetOperator (training data) should survive in the fitted graph
+    from keystone_tpu.workflow import DatasetOperator
+
+    assert not any(
+        isinstance(fitted.graph.get_operator(n), DatasetOperator)
+        for n in fitted.graph.nodes
+    )
+
+
+def test_cse_merges_shared_featurization():
+    """The same transformer instance feeding estimator training and the
+    serving path executes once per dataset (EquivalentNodeMergeRule)."""
+    calls = []
+
+    class Tracker(Transformer):
+        def apply_batch(self, data):
+            calls.append(1)
+            return data
+
+        def apply(self, x):
+            return x
+
+    t = Tracker()
+    est = CountingMeanEstimator()
+    train = dvec([[1.0], [3.0]])
+    p = t.to_pipeline().and_then(est, train)
+    out = p(train).get()  # train and serve on the same dataset
+    assert est.n_fits == 1
+    # featurization ran once for the shared (transformer, dataset) node
+    assert len(calls) == 1
+
+
+def test_pipeline_env_reset_isolates_state():
+    est = CountingMeanEstimator()
+    train = dvec([[2.0]])
+    p = Add(0.0).and_then(est, train)
+    _ = p(train).get()
+    assert est.n_fits == 1
+    PipelineEnv.reset()
+    _ = p(train).get()
+    assert est.n_fits == 2  # state gone after reset
