@@ -210,6 +210,8 @@ def zip_datasets(datasets: List[Any]):
     """Elementwise zip of N aligned datasets into one dataset of tuples
     (≈ `RDD.zip`; used by the gather operator,
     GatherTransformerOperator.scala:9-18)."""
+    if not datasets:
+        raise ValueError("zip_datasets requires at least one dataset")
     if all(isinstance(d, HostDataset) for d in datasets):
         return HostDataset([list(t) for t in zip(*(d.items for d in datasets))])
     if all(isinstance(d, Dataset) for d in datasets):

@@ -1,0 +1,76 @@
+"""Host-side sparse dataset.
+
+TPUs have no efficient native sparse GEMM path, so sparsity lives on the
+host as scipy CSR (the reference keeps Breeze SparseVectors on the JVM,
+nodes/util/Sparsify.scala) and crosses to the device as dense blocks.
+`CommonSparseFeatures`-style top-K vocabulary selection (reference
+nodes/util/CommonSparseFeatures.scala:19-64) is the intended path for
+making NLP features dense enough to densify wholesale.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from .dataset import Dataset
+
+
+class SparseDataset:
+    """CSR-matrix-backed dataset (rows = examples)."""
+
+    is_dataset = True
+
+    def __init__(self, matrix: sp.spmatrix, mesh=None):
+        self.matrix = sp.csr_matrix(matrix)
+        self.mesh = mesh
+
+    @property
+    def count(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.matrix.shape[1]
+
+    @property
+    def sparsity(self) -> float:
+        """Fraction of nonzeros."""
+        r, c = self.matrix.shape
+        return self.matrix.nnz / max(r * c, 1)
+
+    @property
+    def per_shard_count(self) -> int:
+        import jax
+
+        return -(-self.count // max(1, len(jax.devices())))
+
+    def map_rows(self, fn) -> "SparseDataset":
+        return SparseDataset(fn(self.matrix), mesh=self.mesh)
+
+    def densify(self, dtype=np.float32) -> Dataset:
+        return Dataset(np.asarray(self.matrix.todense(), dtype=dtype), mesh=self.mesh)
+
+    def sample_per_shard(self, k: int, seed: int = 0) -> "SparseDataset":
+        import jax
+
+        m = min(self.count, k * max(1, len(jax.devices())))
+        idx = np.linspace(0, self.count - 1, num=m, dtype=np.int64)
+        return SparseDataset(self.matrix[idx], mesh=self.mesh)
+
+    def cache(self) -> "SparseDataset":
+        return self
+
+    def numpy(self):
+        return self.matrix
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __repr__(self) -> str:
+        return (
+            f"SparseDataset(count={self.count}, dim={self.dim}, "
+            f"nnz={self.matrix.nnz})"
+        )

@@ -1,0 +1,68 @@
+"""Binary-classifier evaluation (reference
+evaluation/BinaryClassifierEvaluator.scala:17-79): contingency-table
+metrics from boolean predictions vs actuals."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class BinaryClassifierMetrics:
+    tp: float
+    fp: float
+    tn: float
+    fn: float
+
+    @property
+    def accuracy(self) -> float:
+        total = self.tp + self.fp + self.tn + self.fn
+        return (self.tp + self.tn) / max(total, 1.0)
+
+    @property
+    def precision(self) -> float:
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 1.0
+
+    @property
+    def recall(self) -> float:
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 1.0
+
+    @property
+    def specificity(self) -> float:
+        denom = self.tn + self.fp
+        return self.tn / denom if denom else 1.0
+
+    @property
+    def f1(self) -> float:
+        p, r = self.precision, self.recall
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+class BinaryClassifierEvaluator:
+    def evaluate(self, predictions, actuals) -> BinaryClassifierMetrics:
+        from ..data.dataset import Dataset, HostDataset
+        from ..workflow.pipeline import PipelineResult
+
+        def to_np(x):
+            if isinstance(x, PipelineResult):
+                x = x.get()
+            if isinstance(x, Dataset):
+                return np.asarray(x.numpy()).astype(bool).ravel()
+            if isinstance(x, HostDataset):
+                return np.asarray(x.items).astype(bool).ravel()
+            return np.asarray(x).astype(bool).ravel()
+
+        p, a = to_np(predictions), to_np(actuals)
+        return BinaryClassifierMetrics(
+            tp=float(np.sum(p & a)),
+            fp=float(np.sum(p & ~a)),
+            tn=float(np.sum(~p & ~a)),
+            fn=float(np.sum(~p & a)),
+        )
+
+    def __call__(self, predictions, actuals) -> BinaryClassifierMetrics:
+        return self.evaluate(predictions, actuals)

@@ -1,0 +1,1 @@
+from .csv_loader import LabeledData, csv_data_loader

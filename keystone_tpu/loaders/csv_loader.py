@@ -1,0 +1,46 @@
+"""CSV loading (reference loaders/CsvDataLoader.scala:10-31) and the
+`LabeledData` convenience wrapper (loaders/LabeledData.scala:12-15)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+
+
+def csv_data_loader(path: str, delimiter: str = ",", dtype=np.float32, mesh=None) -> Dataset:
+    """Load a dense CSV of floats into a data-sharded Dataset."""
+    arr = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
+    return Dataset(arr, mesh=mesh)
+
+
+@dataclass
+class LabeledData:
+    """Aligned (labels, data) pair of datasets (LabeledData.scala:12-15).
+    ``labels`` are int class ids; ``data`` is the feature dataset."""
+
+    labels: Dataset
+    data: Dataset
+
+    @staticmethod
+    def from_arrays(labels, features, mesh=None) -> "LabeledData":
+        labels = np.asarray(labels)
+        features = np.asarray(features)
+        if labels.shape[0] != features.shape[0]:
+            raise ValueError("labels and features must align")
+        return LabeledData(
+            labels=Dataset(labels.astype(np.int32), mesh=mesh),
+            data=Dataset(features, mesh=mesh),
+        )
+
+    @staticmethod
+    def label_featured_csv(path: str, label_col: int = 0, mesh=None) -> "LabeledData":
+        """CSV whose ``label_col`` holds the integer label and the rest are
+        features (the reference's MNIST format, MnistRandomFFT.scala:30-38)."""
+        arr = np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
+        labels = arr[:, label_col].astype(np.int32)
+        features = np.delete(arr, label_col, axis=1)
+        return LabeledData.from_arrays(labels, features, mesh=mesh)

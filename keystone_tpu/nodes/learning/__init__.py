@@ -1,0 +1,11 @@
+from .linear import LinearMapEstimator, LinearMapper, LocalLeastSquaresEstimator
+from .block_ls import BlockLeastSquaresEstimator, BlockLinearMapper
+from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
+from .least_squares import LeastSquaresEstimator
+from .cost_model import (
+    BlockSolverCostModel,
+    CostModel,
+    CostProfile,
+    ExactSolverCostModel,
+    LBFGSCostModel,
+)

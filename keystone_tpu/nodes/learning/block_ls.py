@@ -1,0 +1,172 @@
+"""Block coordinate descent least squares — the workhorse solver.
+
+Reference: nodes/learning/BlockLinearMapper.scala:22-283 (estimator at
+:199-283) + mlmatrix `BlockCoordinateDescent.solveLeastSquaresWithL2`.
+
+The reference splits the d-dim feature space into blocks
+(`VectorSplitter` → Seq[RDD]), then per block: broadcast the model,
+per-partition GEMMs, treeReduce of the block Gram/correlation to the
+driver, local (B×B) solve, and a distributed residual update.
+
+TPU-native redesign: the entire BCD sweep is ONE jitted program. X stays
+a single (n, d_padded) array sharded over the mesh ``data`` axis, the
+model W lives as (num_blocks, B, k) replicated, and the residual R is a
+persistent data-sharded (n, k) array. A `lax.scan` over block indices
+does `dynamic_slice` on the feature axis (static block size → one
+compile reused for every block, the reference's 'pad the last block'
+trick), with XLA inserting the Gram all-reduce where the reference had
+treeReduce. Epochs are an outer `lax.scan`. Mean-centering (the
+reference's per-block StandardScaler) is applied once up front with
+masking so padded rows stay zero.
+
+The estimator declares optimizer weight 3·numIter+1 — the number of
+passes over the input — feeding auto-caching (BlockLinearMapper.scala:205-210).
+"""
+
+from __future__ import annotations
+
+from functools import cached_property, partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import LabelEstimator, Transformer
+
+
+@partial(jax.jit, static_argnames=("block_size", "num_blocks", "num_iter", "center"))
+def _bcd_fit(
+    X, Y, mask, lam, block_size: int, num_blocks: int, num_iter: int, center: bool
+):
+    # Solver numerics need true f32 Gram matrices: on TPU the default
+    # matmul precision is bf16, which caps BCD's convergence floor.
+    with jax.default_matmul_precision("highest"):
+        return _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center)
+
+
+def _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center):
+    n_pad, d_pad = X.shape
+    k = Y.shape[1]
+    dtype = X.dtype
+    count = jnp.sum(mask)
+
+    if center:
+        xm = jnp.sum(X, axis=0) / count
+        ym = jnp.sum(Y, axis=0) / count
+        Xc = (X - xm) * mask[:, None]
+        Yc = (Y - ym) * mask[:, None]
+    else:
+        xm = jnp.zeros((d_pad,), dtype)
+        ym = jnp.zeros((k,), dtype)
+        Xc = X * mask[:, None]
+        Yc = Y * mask[:, None]
+
+    eye = lam * jnp.eye(block_size, dtype=dtype)
+
+    def block_step(carry, b_idx):
+        W, R = carry
+        Xb = jax.lax.dynamic_slice_in_dim(Xc, b_idx * block_size, block_size, axis=1)
+        Wb = W[b_idx]
+        # add back this block's contribution, then re-solve it exactly
+        R1 = R + Xb @ Wb
+        G = Xb.T @ Xb + eye          # all-reduce over the data axis
+        C = Xb.T @ R1                # all-reduce over the data axis
+        Wb_new = jax.scipy.linalg.solve(G, C, assume_a="pos")
+        R2 = R1 - Xb @ Wb_new
+        return (W.at[b_idx].set(Wb_new), R2), None
+
+    def epoch(carry, _):
+        carry, _ = jax.lax.scan(block_step, carry, jnp.arange(num_blocks))
+        return carry, None
+
+    W0 = jnp.zeros((num_blocks, block_size, k), dtype)
+    R0 = Yc
+    (W, _), _ = jax.lax.scan(epoch, (W0, R0), None, length=num_iter)
+
+    W_full = W.reshape(d_pad, k)  # block b occupies rows [b*B, (b+1)*B)
+    b = ym - xm @ W_full
+    return W_full, b
+
+
+class BlockLinearMapper(Transformer):
+    """Apply a blocked linear model. The model is stored full-width; for
+    very large d the apply GEMM itself can be sharded over the ``model``
+    mesh axis by XLA (BlockLinearMapper.scala:22-137)."""
+
+    def __init__(self, W, b=None, block_size: Optional[int] = None):
+        self.W = W
+        self.b = b if b is not None else jnp.zeros(W.shape[1], dtype=W.dtype)
+        self.block_size = block_size
+
+    def apply(self, x):
+        x = jnp.asarray(x)
+        d = self.W.shape[0]
+        if x.shape[-1] < d:  # pad features like training did
+            x = jnp.pad(x, [(0, d - x.shape[-1])])
+        return x @ self.W + self.b
+
+    @cached_property
+    def _batch_fn(self):
+        W, b = self.W, self.b
+
+        def fn(X):
+            d = W.shape[0]
+            if X.shape[1] < d:
+                X = jnp.pad(X, [(0, 0), (0, d - X.shape[1])])
+            return X @ W + b
+
+        return jax.jit(fn)
+
+    def apply_batch(self, data: Dataset):
+        return data.map_batches(self._batch_fn, jitted=False)
+
+    def apply_and_evaluate(self, data: Dataset, eval_fn):
+        """Incremental per-block evaluation (BlockLinearMapper.scala:96-137):
+        yields eval_fn(partial prediction) after each feature block."""
+        bs = self.block_size or self.W.shape[0]
+        X = data.array
+        acc = jnp.zeros((X.shape[0], self.W.shape[1]), dtype=self.W.dtype)
+        for start in range(0, self.W.shape[0], bs):
+            end = min(start + bs, self.W.shape[0])
+            acc = acc + X[:, start:end] @ self.W[start:end]
+            yield eval_fn(data.with_data(acc + self.b))
+
+
+class BlockLeastSquaresEstimator(LabelEstimator):
+    """BCD least squares with L2 (BlockLinearMapper.scala:199-283)."""
+
+    def __init__(
+        self,
+        block_size: int,
+        num_iter: int,
+        lam: float = 0.0,
+        fit_intercept: bool = True,
+    ):
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.lam = lam
+        self.fit_intercept = fit_intercept
+        # passes over the input: weight for auto-caching
+        self.weight = 3 * num_iter + 1
+
+    def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
+        X, Y = data.array, labels.array
+        d = X.shape[1]
+        bs = min(self.block_size, d)
+        num_blocks = -(-d // bs)
+        d_pad = num_blocks * bs
+        if d_pad != d:
+            X = jnp.pad(X, [(0, 0), (0, d_pad - d)])
+        W, b = _bcd_fit(
+            X,
+            Y,
+            data.mask.astype(X.dtype),
+            jnp.asarray(self.lam, X.dtype),
+            bs,
+            num_blocks,
+            self.num_iter,
+            self.fit_intercept,
+        )
+        return BlockLinearMapper(W, b if self.fit_intercept else None, self.block_size)

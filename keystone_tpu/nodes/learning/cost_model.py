@@ -1,0 +1,100 @@
+"""Solver cost models for node-level auto-selection.
+
+Reference: nodes/learning/CostModel.scala:6-16 and the per-solver models
+embedded in LeastSquaresEstimator.scala / LinearMapper.scala / LBFGS.scala
+/ BlockLinearMapper.scala. The reference's cost is
+cpuWeight·flops + memWeight·bytes + networkWeight·bytes-moved, with
+weights fit on a 16× r3.4xlarge cluster (cpu 3.8e-4, mem 2.9e-1, net
+1.32 — LeastSquaresEstimator.scala:190-192).
+
+TPU translation: "machines" becomes mesh chips; compute cost is MXU
+FLOPs, memory cost is HBM-resident bytes, and network cost is ICI
+collective bytes (Gram all-reduces, model replication). The default
+weights below are normalized per-chip rates for a v5e-class chip
+(~2e14 bf16 FLOP/s MXU, ~8e11 B/s HBM, ~1e11 B/s ICI all-reduce
+effective) so costs come out in seconds — re-fit them with
+`scripts/fit_cost_model.py`-style sweeps when hardware changes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class CostProfile:
+    """Workload statistics measured from a sample (n, d, k, sparsity) plus
+    the mesh size (≈ numMachines, a plain parameter so tests can simulate
+    a 16-chip pod without one — LeastSquaresEstimatorSuite.scala:18-37)."""
+
+    n: int
+    d: int
+    k: int
+    sparsity: float
+    num_chips: int
+
+
+# seconds per unit, v5e-ish defaults
+CPU_WEIGHT = 1.0 / 2.0e14   # per FLOP (MXU bf16)
+MEM_WEIGHT = 1.0 / 8.0e11   # per HBM byte touched
+NETWORK_WEIGHT = 1.0 / 1.0e11  # per ICI all-reduced byte
+
+
+class CostModel:
+    """cost(profile) -> estimated seconds (CostModel.scala:6-16)."""
+
+    def cost(
+        self,
+        p: CostProfile,
+        cpu_weight: float = CPU_WEIGHT,
+        mem_weight: float = MEM_WEIGHT,
+        network_weight: float = NETWORK_WEIGHT,
+    ) -> float:
+        raise NotImplementedError
+
+
+class ExactSolverCostModel(CostModel):
+    """Normal equations: XᵀX flops n·d²/chips + d³ solve (replicated) +
+    d² all-reduce (LinearMapper.scala cost model)."""
+
+    def cost(self, p, cpu_weight=CPU_WEIGHT, mem_weight=MEM_WEIGHT, network_weight=NETWORK_WEIGHT):
+        flops = 2.0 * p.n * p.d * p.d / p.num_chips + 2.0 * p.d**3
+        mem = 4.0 * (p.n * p.d / p.num_chips + p.d * p.d)
+        net = 4.0 * p.d * p.d
+        return cpu_weight * flops + mem_weight * mem + network_weight * net
+
+
+class BlockSolverCostModel(CostModel):
+    """BCD: numIter sweeps of per-block Gram (n·B·(B+k)/chips) + B³ solves
+    + B·(B+k) all-reduces (BlockLinearMapper.scala cost model)."""
+
+    def __init__(self, block_size: int = 4096, num_iter: int = 1):
+        self.block_size = block_size
+        self.num_iter = num_iter
+
+    def cost(self, p, cpu_weight=CPU_WEIGHT, mem_weight=MEM_WEIGHT, network_weight=NETWORK_WEIGHT):
+        B = min(self.block_size, p.d)
+        nb = -(-p.d // B)
+        per_sweep_flops = nb * (
+            2.0 * p.n * B * (B + 2 * p.k) / p.num_chips + (2.0 / 3.0) * B**3
+        )
+        mem = 4.0 * self.num_iter * nb * (p.n * (B + p.k) / p.num_chips)
+        net = 4.0 * self.num_iter * nb * B * (B + p.k)
+        return cpu_weight * self.num_iter * per_sweep_flops + mem_weight * mem + network_weight * net
+
+
+class LBFGSCostModel(CostModel):
+    """numIters gradient passes: 2·n·d·k flops each /chips + d·k model
+    all-reduce per iter (LBFGS.scala cost model). Sparse variant scales
+    flops by sparsity."""
+
+    def __init__(self, num_iters: int = 20, sparse: bool = False):
+        self.num_iters = num_iters
+        self.sparse = sparse
+
+    def cost(self, p, cpu_weight=CPU_WEIGHT, mem_weight=MEM_WEIGHT, network_weight=NETWORK_WEIGHT):
+        density = p.sparsity if self.sparse else 1.0
+        flops = self.num_iters * 4.0 * p.n * p.d * p.k * density / p.num_chips
+        mem = 4.0 * self.num_iters * (p.n * p.d * density / p.num_chips + p.d * p.k)
+        net = 4.0 * self.num_iters * p.d * p.k
+        return cpu_weight * flops + mem_weight * mem + network_weight * net

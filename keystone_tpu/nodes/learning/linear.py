@@ -1,0 +1,144 @@
+"""Linear models and the exact least-squares solver.
+
+Reference: nodes/learning/LinearMapper.scala:18-161 and
+LocalLeastSquaresEstimator.scala:16-61.
+
+The reference computes distributed normal equations with mlmatrix
+(`NormalEquations`: per-partition AᵀA/Aᵀb GEMMs + treeReduce + local
+solve on the driver). Here the whole thing is one jitted program over the
+data-sharded X/Y: XLA turns `X.T @ X` into per-shard partial Grams plus an
+all-reduce over the mesh ``data`` axis, and the (replicated) Cholesky
+solve runs identically on every chip — the driver/executor split
+disappears.
+
+Intercepts are fit via the Gram-correction identity rather than
+materializing centered copies: Xcᵀ Xc = XᵀX − n·x̄x̄ᵀ, which also
+sidesteps the padded-zero-rows problem (raw sums are exact under
+padding).
+"""
+
+from __future__ import annotations
+
+from functools import cached_property, partial
+
+import jax
+import jax.numpy as jnp
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import LabelEstimator, Transformer
+
+
+class LinearMapper(Transformer):
+    """y = xW (+ b). The model is replicated over the mesh; the batch path
+    is a single sharded GEMM (LinearMapper.scala:18-63)."""
+
+    def __init__(self, W, b=None, feature_scaler=None):
+        self.W = W
+        self.b = b
+        self.feature_scaler = feature_scaler
+
+    def apply(self, x):
+        if self.feature_scaler is not None:
+            x = self.feature_scaler.apply(x)
+        out = jnp.asarray(x) @ self.W
+        if self.b is not None:
+            out = out + self.b
+        return out
+
+    @cached_property
+    def _batch_fn(self):
+        # One jitted GEMM per model instance: repeated prediction calls hit
+        # the jit cache instead of retracing (cf. CosineRandomFeatures).
+        W = self.W
+        b = self.b if self.b is not None else jnp.zeros(self.W.shape[1], self.W.dtype)
+        return jax.jit(lambda X: X @ W + b)
+
+    def apply_batch(self, data: Dataset):
+        if self.feature_scaler is not None:
+            data = self.feature_scaler.apply_batch(data)
+        return data.map_batches(self._batch_fn, jitted=False)
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def _normal_equations(X, Y, count, lam, fit_intercept: bool):
+    with jax.default_matmul_precision("highest"):
+        return _normal_equations_impl(X, Y, count, lam, fit_intercept)
+
+
+def _normal_equations_impl(X, Y, count, lam, fit_intercept):
+    # Raw sums are exact under zero-padding.
+    A = X.T @ X
+    B = X.T @ Y
+    d = X.shape[1]
+    if fit_intercept:
+        xm = jnp.sum(X, axis=0) / count
+        ym = jnp.sum(Y, axis=0) / count
+        A = A - count * jnp.outer(xm, xm)
+        B = B - count * jnp.outer(xm, ym)
+    A = A + lam * jnp.eye(d, dtype=X.dtype)
+    W = jax.scipy.linalg.solve(A, B, assume_a="pos")
+    if fit_intercept:
+        b = ym - xm @ W
+    else:
+        b = jnp.zeros(Y.shape[1], dtype=X.dtype)
+    return W, b
+
+
+class LinearMapEstimator(LabelEstimator):
+    """Exact OLS/ridge via distributed normal equations
+    (LinearMapper.scala:69-161)."""
+
+    def __init__(self, lam: float = 0.0, fit_intercept: bool = True):
+        self.lam = lam
+        self.fit_intercept = fit_intercept
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        W, b = _normal_equations(
+            data.array,
+            labels.array,
+            jnp.float32(data.count),
+            jnp.float32(self.lam),
+            self.fit_intercept,
+        )
+        return LinearMapper(W, b if self.fit_intercept else None)
+
+    @staticmethod
+    def compute_cost(data: Dataset, labels: Dataset, lam: float, W, b=None) -> float:
+        """Ridge objective value (LinearMapper.scala:129-161)."""
+        X, Y = data.array, labels.array
+        pred = X @ W + (0.0 if b is None else b)
+        resid = (pred - Y) * data.mask[:, None]
+        return float(0.5 * jnp.sum(resid**2) + 0.5 * lam * jnp.sum(W**2))
+
+
+@jax.jit
+def _dual_solve(X, Y, mask, lam):
+    with jax.default_matmul_precision("highest"):
+        return _dual_solve_impl(X, Y, mask, lam)
+
+
+def _dual_solve_impl(X, Y, mask, lam):
+    # K = X Xᵀ on masked rows; solve (K + λI)α = Y; W = Xᵀα.
+    Xm = X * mask[:, None]
+    K = Xm @ Xm.T
+    n = X.shape[0]
+    # Padded rows have zero K-rows and zero targets -> alpha = 0 for them.
+    alpha = jax.scipy.linalg.solve(
+        K + lam * jnp.eye(n, dtype=X.dtype), Y * mask[:, None], assume_a="pos"
+    )
+    return Xm.T @ alpha
+
+
+class LocalLeastSquaresEstimator(LabelEstimator):
+    """Dual-form ridge for d ≫ n: collect to one replica, solve the n×n
+    kernelized system (LocalLeastSquaresEstimator.scala:16-61)."""
+
+    def __init__(self, lam: float = 0.0):
+        self.lam = lam
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        W = _dual_solve(
+            data.array, labels.array, data.mask.astype(data.array.dtype),
+            jnp.float32(self.lam),
+        )
+        return LinearMapper(W)

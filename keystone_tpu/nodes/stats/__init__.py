@@ -1,0 +1,8 @@
+from .scalers import StandardScaler, StandardScalerModel
+from .random_features import (
+    CosineRandomFeatures,
+    LinearRectifier,
+    PaddedFFT,
+    RandomSignNode,
+)
+from .normalization import ColumnSampler, NormalizeRows, Sampler, SignedHellingerMapper

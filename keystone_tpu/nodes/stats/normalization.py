@@ -1,0 +1,78 @@
+"""Row-wise normalization nodes (reference nodes/stats/*).
+
+- `NormalizeRows` — L2 row normalization (NormalizeRows.scala:10).
+- `SignedHellingerMapper` — sign(x)·sqrt(|x|) (SignedHellingerMapper.scala:12-22).
+- `Sampler` / `ColumnSampler` — deterministic down-sampling
+  (Sampling.scala:12-32).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset, HostDataset
+from ...workflow.pipeline import Transformer
+
+
+class NormalizeRows(Transformer):
+    def __init__(self, eps: float = 2.2e-16):
+        self.eps = eps
+
+    def apply(self, x):
+        norm = jnp.linalg.norm(x)
+        return x / jnp.maximum(norm, self.eps)
+
+
+class SignedHellingerMapper(Transformer):
+    def apply(self, x):
+        return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+class Sampler(Transformer):
+    """Deterministic dataset down-sample to ≤ size items (a FunctionNode in
+    the reference: takes the whole dataset, returns a smaller one)."""
+
+    def __init__(self, size: int, seed: int = 0):
+        self.size = size
+        self.seed = seed
+
+    def apply(self, x):
+        return x  # single items pass through
+
+    def apply_batch(self, data):
+        if isinstance(data, HostDataset):
+            n = len(data)
+            if n <= self.size:
+                return data
+            idx = np.random.default_rng(self.seed).choice(n, self.size, replace=False)
+            idx.sort()
+            return HostDataset([data.items[i] for i in idx])
+        n = data.count
+        if n <= self.size:
+            return data
+        idx = np.random.default_rng(self.seed).choice(n, self.size, replace=False)
+        idx.sort()
+        host = data.numpy()
+        import jax
+
+        picked = jax.tree_util.tree_map(lambda x: x[idx], host)
+        return Dataset(picked, mesh=data.mesh)
+
+
+class ColumnSampler(Transformer):
+    """Sample ≤ num_cols columns from each item's (cols × dim) matrix —
+    used to subsample descriptors per image (Sampling.scala:12-25)."""
+
+    def __init__(self, num_cols: int, seed: int = 0):
+        self.num_cols = num_cols
+        self.seed = seed
+
+    def apply(self, x):
+        x = np.asarray(x)
+        n = x.shape[0]
+        if n <= self.num_cols:
+            return x
+        idx = np.random.default_rng(self.seed).choice(n, self.num_cols, replace=False)
+        idx.sort()
+        return x[idx]

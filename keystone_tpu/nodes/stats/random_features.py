@@ -1,0 +1,92 @@
+"""Randomized featurization nodes.
+
+- `CosineRandomFeatures` — random Fourier features cos(xWᵀ + b)
+  (reference nodes/stats/CosineRandomFeatures.scala:20-61: broadcast W,
+  per-partition GEMM → here one sharded GEMM on the MXU with W
+  replicated over the mesh).
+- `RandomSignNode` — x ∘ random ±1 (RandomSignNode.scala:11-24).
+- `PaddedFFT` — zero-pad to a power of two, FFT, return the real half
+  (PaddedFFT.scala:13-21).
+- `LinearRectifier` — max(maxVal, x − α) (LinearRectifier.scala:12-17).
+"""
+
+from __future__ import annotations
+
+from functools import cached_property
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import Transformer
+
+
+class CosineRandomFeatures(Transformer):
+    """cos(x Wᵀ + b) with W ~ gamma·N(0,1) (gaussian) or gamma·Cauchy,
+    b ~ U[0, 2π]."""
+
+    def __init__(
+        self,
+        input_dim: int,
+        num_features: int,
+        gamma: float = 1.0,
+        distribution: str = "gaussian",
+        seed: int = 0,
+    ):
+        rng = np.random.default_rng(seed)
+        if distribution == "gaussian":
+            W = rng.standard_normal((input_dim, num_features))
+        elif distribution == "cauchy":
+            W = rng.standard_cauchy((input_dim, num_features))
+        else:
+            raise ValueError(f"unknown distribution {distribution!r}")
+        self.W = jnp.asarray(gamma * W, dtype=jnp.float32)
+        self.b = jnp.asarray(
+            rng.uniform(0, 2 * np.pi, size=(num_features,)), dtype=jnp.float32
+        )
+
+    @cached_property
+    def _batch_fn(self):
+        W, b = self.W, self.b
+        return jax.jit(lambda X: jnp.cos(X @ W + b))
+
+    def apply(self, x):
+        return jnp.cos(x @ self.W + self.b)
+
+    def apply_batch(self, data: Dataset):
+        return data.with_data(self._batch_fn(data.array))
+
+
+class RandomSignNode(Transformer):
+    """Elementwise multiply by a fixed random ±1 vector."""
+
+    def __init__(self, dim: int, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.signs = jnp.asarray(
+            rng.integers(0, 2, size=(dim,)) * 2 - 1, dtype=jnp.float32
+        )
+
+    def apply(self, x):
+        return x * self.signs
+
+
+class PaddedFFT(Transformer):
+    """Zero-pad to the next power of two and return the real part of the
+    positive-frequency half of the FFT."""
+
+    def apply(self, x):
+        n = x.shape[-1]
+        padded = 1 << max(int(np.ceil(np.log2(n))), 0)
+        return jnp.fft.rfft(x, n=padded).real[..., : padded // 2]
+
+
+class LinearRectifier(Transformer):
+    """max(maxVal, x - alpha)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = max_val
+        self.alpha = alpha
+
+    def apply(self, x):
+        return jnp.maximum(self.max_val, x - self.alpha)

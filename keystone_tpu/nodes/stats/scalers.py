@@ -1,0 +1,70 @@
+"""Feature standardization (reference nodes/stats/StandardScaler.scala:36-60).
+
+The reference computes per-feature mean/std with a
+`treeAggregate(MultivariateOnlineSummarizer)` over partitions; here the
+moments are one jitted reduction over the data-sharded array — XLA GSPMD
+lowers the sums to an all-reduce over the mesh's ``data`` axis. Padded
+rows are zero so raw sums are exact; only ``count`` matters for
+normalization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import Estimator, Transformer
+
+
+@partial(jax.jit, static_argnames=("normalize_std",))
+def _moments(X, count, normalize_std: bool):
+    s = jnp.sum(X, axis=0)
+    s2 = jnp.sum(X * X, axis=0)
+    mean = s / count
+    if normalize_std:
+        # unbiased variance, matching MLlib's summarizer
+        var = (s2 - count * mean * mean) / jnp.maximum(count - 1.0, 1.0)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        std = jnp.where(std == 0.0, 1.0, std)
+    else:
+        std = jnp.ones_like(mean)
+    return mean, std
+
+
+@jax.jit
+def _scale(X, mean, std, mask):
+    return (X - mean) / std * mask[:, None]
+
+
+class StandardScalerModel(Transformer):
+    """(x - mean) / std. Masked so padded rows stay zero."""
+
+    def __init__(self, mean, std=None):
+        self.mean = mean
+        self.std = std
+
+    def apply(self, x):
+        if self.std is None:
+            return x - self.mean
+        return (x - self.mean) / self.std
+
+    def apply_batch(self, data: Dataset):
+        std = self.std if self.std is not None else jnp.ones_like(self.mean)
+        return data.with_data(_scale(data.array, self.mean, std, data.mask))
+
+
+class StandardScaler(Estimator):
+    """Fit per-feature mean/std (StandardScaler.scala:36-60)."""
+
+    def __init__(self, normalize_std_dev: bool = True, eps: float = 1e-12):
+        self.normalize_std_dev = normalize_std_dev
+        self.eps = eps
+
+    def fit(self, data: Dataset) -> StandardScalerModel:
+        mean, std = _moments(
+            data.array, jnp.float32(data.count), self.normalize_std_dev
+        )
+        return StandardScalerModel(mean, std if self.normalize_std_dev else None)

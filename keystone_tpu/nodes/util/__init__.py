@@ -1,0 +1,12 @@
+from .basic import (
+    Cacher,
+    ClassLabelIndicatorsFromInt,
+    ClassLabelIndicatorsFromIntArray,
+    FloatToDouble,
+    Identity,
+    MatrixVectorizer,
+    MaxClassifier,
+    Shuffler,
+    TopKClassifier,
+    VectorCombiner,
+)

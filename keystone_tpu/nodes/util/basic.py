@@ -1,0 +1,187 @@
+"""Small utility nodes (reference nodes/util/*.scala).
+
+- `ClassLabelIndicatorsFromInt[Array]` — label(s) → ±1 one-hot
+  (ClassLabelIndicators.scala:14-55). Batch path masks padded rows to
+  zero so label sums stay exact under padding.
+- `MaxClassifier` — argmax (MaxClassifier.scala).
+- `TopKClassifier` — indices of the k largest scores.
+- `VectorCombiner` — concatenate gathered branch outputs.
+- `Cacher` — materialize + prefix-memoize (Cacher.scala:15-25).
+- `FloatToDouble`, `MatrixVectorizer`, `Identity`, `Shuffler`.
+"""
+
+from __future__ import annotations
+
+from functools import cached_property
+
+import jax
+import jax.numpy as jnp
+
+from ...data.dataset import Dataset, HostDataset, zip_datasets
+from ...workflow.pipeline import Transformer
+
+
+class ClassLabelIndicatorsFromInt(Transformer):
+    """int label → length-k vector of -1/+1."""
+
+    def __init__(self, num_classes: int):
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+
+    def apply(self, y):
+        return 2.0 * jax.nn.one_hot(y, self.num_classes) - 1.0
+
+    @cached_property
+    def _batch_fn(self):
+        k = self.num_classes
+        return jax.jit(
+            lambda y, mask: (2.0 * jax.nn.one_hot(y, k) - 1.0) * mask[:, None]
+        )
+
+    def apply_batch(self, data: Dataset):
+        return data.with_data(self._batch_fn(data.array, data.mask))
+
+
+class ClassLabelIndicatorsFromIntArray(Transformer):
+    """multi-label int array → ±1 indicator (ClassLabelIndicators.scala:38-55).
+    Expects per-item fixed-size padded label arrays with -1 as padding."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+
+    def apply(self, ys):
+        onehots = jax.nn.one_hot(ys, self.num_classes)  # (L, k); -1 rows are 0
+        return 2.0 * jnp.clip(jnp.sum(onehots, axis=0), 0.0, 1.0) - 1.0
+
+    @cached_property
+    def _batch_fn(self):
+        return jax.jit(
+            lambda Y, mask: jax.vmap(self.apply)(Y) * mask[:, None]
+        )
+
+    def apply_batch(self, data: Dataset):
+        return data.with_data(self._batch_fn(data.array, data.mask))
+
+
+class MaxClassifier(Transformer):
+    """argmax over scores → int label (MaxClassifier.scala)."""
+
+    def apply(self, x):
+        return jnp.argmax(x, axis=-1)
+
+
+class TopKClassifier(Transformer):
+    def __init__(self, k: int):
+        self.k = k
+
+    def apply(self, x):
+        return jnp.argsort(-x)[: self.k]
+
+
+class VectorCombiner(Transformer):
+    """Concatenate the tuple of branch outputs produced by gather
+    (VectorCombiner.scala)."""
+
+    def apply(self, xs):
+        return jnp.concatenate([jnp.asarray(x) for x in xs], axis=-1)
+
+    @cached_property
+    def _batch_fn(self):
+        return jax.jit(lambda parts: jnp.concatenate(parts, axis=-1))
+
+    def apply_batch(self, data):
+        if isinstance(data, Dataset) and isinstance(data.data, tuple):
+            return data.with_data(self._batch_fn(data.data))
+        return super().apply_batch(data)
+
+
+class Cacher(Transformer):
+    """Materialize the dataset and mark the prefix saveable, enabling
+    cross-pipeline reuse (Cacher.scala:15-25 + ExtractSaveablePrefixes)."""
+
+    saveable = True
+
+    def __init__(self, name: str = ""):
+        self.name = name
+
+    @property
+    def label(self) -> str:
+        return f"Cacher[{self.name}]"
+
+    def apply(self, x):
+        return x
+
+    def apply_batch(self, data):
+        return data.cache() if hasattr(data, "cache") else data
+
+
+class Densify(Transformer):
+    """SparseDataset → device Dataset (reference nodes/util/Densify.scala)."""
+
+    def apply(self, x):
+        import numpy as np
+
+        return np.asarray(x.todense()).ravel() if hasattr(x, "todense") else x
+
+    def apply_batch(self, data):
+        from ...data.sparse import SparseDataset
+
+        return data.densify() if isinstance(data, SparseDataset) else data
+
+
+class Sparsify(Transformer):
+    """Device Dataset → host SparseDataset (reference nodes/util/Sparsify.scala)."""
+
+    def apply(self, x):
+        import scipy.sparse as sp
+
+        return sp.csr_matrix(x)
+
+    def apply_batch(self, data):
+        import scipy.sparse as sp
+
+        from ...data.sparse import SparseDataset
+
+        if isinstance(data, SparseDataset):
+            return data
+        return SparseDataset(sp.csr_matrix(data.numpy()), mesh=getattr(data, "mesh", None))
+
+
+class FloatToDouble(Transformer):
+    def apply(self, x):
+        return jnp.asarray(x, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+
+
+class MatrixVectorizer(Transformer):
+    """Flatten a per-item matrix to a vector (MatrixVectorizer.scala)."""
+
+    def apply(self, x):
+        return jnp.ravel(x)
+
+
+class Identity(Transformer):
+    def apply(self, x):
+        return x
+
+
+class Shuffler(Transformer):
+    """Random permutation of the dataset (Shuffler.scala:16-19 —
+    a repartition+shuffle in the reference; here a host-side gather)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def apply(self, x):
+        return x
+
+    def apply_batch(self, data):
+        import numpy as np
+
+        if isinstance(data, HostDataset):
+            idx = np.random.default_rng(self.seed).permutation(len(data))
+            return HostDataset([data.items[i] for i in idx])
+        idx = np.random.default_rng(self.seed).permutation(data.count)
+        host = data.numpy()
+        picked = jax.tree_util.tree_map(lambda x: x[idx], host)
+        return Dataset(picked, mesh=data.mesh)

@@ -3,23 +3,37 @@
 Mirrors the reference's `PipelineContext` trait
 (src/test/scala/workflow/PipelineContext.scala:9-26): where the reference
 runs every "distributed" test on local-mode Spark, we run on a virtual
-8-device CPU mesh (XLA host-platform device-count override), exercising
-the full shard/collective code path in one process. Each test resets the
-process-global `PipelineEnv` so prefix-memoized fitted state cannot leak
-between tests.
+8-device CPU mesh, exercising the full shard/collective code path in one
+process. Each test resets the process-global `PipelineEnv` so
+prefix-memoized fitted state cannot leak between tests.
+
+Platform forcing uses `jax.config` (not env vars): pytest plugins may
+import jax before this conftest runs, at which point XLA_FLAGS /
+JAX_PLATFORMS are ignored — config updates still work until a backend is
+actually initialized.
 """
 
 import os
 
-# Must be set before jax initializes its backends.
+# Harmless belt-and-braces for subprocesses spawned by tests.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def assert_cpu_mesh():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu" and len(devs) == 8, (
+        f"tests require the 8-device CPU mesh, got {devs}; "
+        "a plugin initialized a jax backend before conftest could configure it"
+    )
+    yield
 
 
 @pytest.fixture(autouse=True)

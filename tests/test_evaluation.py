@@ -1,0 +1,56 @@
+"""Evaluator tests with hand-computed confusion matrices (model:
+reference MulticlassClassifierEvaluatorSuite / BinaryClassifierEvaluatorSuite)."""
+
+import numpy as np
+
+from keystone_tpu import Dataset
+from keystone_tpu.evaluation import (
+    BinaryClassifierEvaluator,
+    MulticlassClassifierEvaluator,
+)
+
+
+def test_multiclass_confusion_and_metrics():
+    preds = [0, 1, 2, 1, 0, 2, 2]
+    actual = [0, 1, 1, 1, 0, 2, 0]
+    m = MulticlassClassifierEvaluator(3)(
+        Dataset(np.asarray(preds, np.int32)), Dataset(np.asarray(actual, np.int32))
+    )
+    expected = np.array(
+        [
+            [2, 0, 1],  # actual 0: predicted 0 twice, 2 once
+            [0, 2, 1],  # actual 1
+            [0, 0, 1],  # actual 2
+        ],
+        dtype=float,
+    )
+    np.testing.assert_array_equal(m.confusion, expected)
+    assert abs(m.accuracy - 5 / 7) < 1e-6
+    assert abs(m.class_precision(2) - 1 / 3) < 1e-6
+    assert abs(m.class_recall(0) - 2 / 3) < 1e-6
+    assert "Accuracy" in m.summary()
+
+
+def test_multiclass_padding_excluded():
+    """Padded rows (7 items over 8 shards -> pads to 8) must not count."""
+    preds = Dataset(np.asarray([0, 0, 0, 0, 0, 0, 0], np.int32))
+    actual = Dataset(np.asarray([0, 0, 0, 0, 0, 0, 0], np.int32))
+    m = MulticlassClassifierEvaluator(2)(preds, actual)
+    assert m.total == 7.0
+    assert m.accuracy == 1.0
+
+
+def test_multiclass_host_lists():
+    m = MulticlassClassifierEvaluator(2)([0, 1, 1], [0, 1, 0])
+    assert m.total == 3.0
+    assert abs(m.accuracy - 2 / 3) < 1e-6
+
+
+def test_binary_contingency():
+    m = BinaryClassifierEvaluator()(
+        [True, True, False, False, True], [True, False, False, True, True]
+    )
+    assert (m.tp, m.fp, m.tn, m.fn) == (2.0, 1.0, 1.0, 1.0)
+    assert abs(m.precision - 2 / 3) < 1e-6
+    assert abs(m.recall - 2 / 3) < 1e-6
+    assert abs(m.accuracy - 3 / 5) < 1e-6

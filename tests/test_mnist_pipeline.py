@@ -1,0 +1,12 @@
+"""End-to-end MnistRandomFFT slice on real data (sklearn digits), the
+build plan's minimum end-to-end milestone (SURVEY.md §7.4)."""
+
+from keystone_tpu.pipelines.mnist_random_fft import MnistRandomFFTConfig, run
+
+
+def test_mnist_random_fft_end_to_end():
+    result = run(MnistRandomFFTConfig(num_ffts=4, block_size=512, lam=1e-3))
+    # digits with random-FFT features solves well above chance; the
+    # reference quality bar for this config is a few percent error.
+    assert result["test_accuracy"] > 0.90, result["summary"]
+    assert result["train_error"] < 0.05

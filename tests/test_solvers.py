@@ -1,0 +1,226 @@
+"""Numerical solver tests (model: reference BlockWeightedLeastSquaresSuite
+zero-gradient checks, LBFGSSuite dense ± intercept, PCASuite patterns).
+
+All run on the 8-virtual-device CPU mesh so Gram reductions exercise the
+cross-shard all-reduce path.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import Dataset
+from keystone_tpu.nodes.learning import (
+    BlockLeastSquaresEstimator,
+    DenseLBFGSwithL2,
+    LeastSquaresEstimator,
+    LinearMapEstimator,
+    LocalLeastSquaresEstimator,
+)
+from keystone_tpu.nodes.stats import StandardScaler
+
+
+def ridge_closed_form(X, Y, lam, intercept=True):
+    if intercept:
+        xm, ym = X.mean(0), Y.mean(0)
+        Xc, Yc = X - xm, Y - ym
+    else:
+        Xc, Yc = X, Y
+    W = np.linalg.solve(Xc.T @ Xc + lam * np.eye(X.shape[1]), Xc.T @ Yc)
+    b = (ym - xm @ W) if intercept else np.zeros(Y.shape[1])
+    return W, b
+
+
+@pytest.fixture
+def problem():
+    rng = np.random.default_rng(42)
+    n, d, k = 200, 24, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Wtrue = rng.normal(size=(d, k)).astype(np.float32)
+    Y = (X @ Wtrue + 0.01 * rng.normal(size=(n, k)) + 1.5).astype(np.float32)
+    return X, Y
+
+
+def test_linear_map_estimator_matches_closed_form(problem):
+    X, Y = problem
+    lam = 2.0
+    est = LinearMapEstimator(lam=lam, fit_intercept=True)
+    model = est.fit(Dataset(X), Dataset(Y))
+    Wref, bref = ridge_closed_form(X, Y, lam)
+    np.testing.assert_allclose(np.asarray(model.W), Wref, atol=2e-2, rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(model.b), bref, atol=2e-2, rtol=1e-2)
+
+
+def test_linear_map_estimator_padding_invariance(problem):
+    """197 rows over 8 shards pads to 200; result must match unpadded."""
+    X, Y = problem
+    m = 197
+    model_padded = LinearMapEstimator(1.0).fit(Dataset(X[:m]), Dataset(Y[:m]))
+    Wref, bref = ridge_closed_form(X[:m], Y[:m], 1.0)
+    np.testing.assert_allclose(np.asarray(model_padded.W), Wref, atol=2e-2, rtol=1e-2)
+
+
+def test_block_ls_single_block_equals_exact(problem):
+    X, Y = problem
+    lam = 1.0
+    exact = LinearMapEstimator(lam).fit(Dataset(X), Dataset(Y))
+    block = BlockLeastSquaresEstimator(block_size=24, num_iter=1, lam=lam).fit(
+        Dataset(X), Dataset(Y)
+    )
+    pred_e = np.asarray(exact.W)
+    pred_b = np.asarray(block.W)[: pred_e.shape[0]]
+    np.testing.assert_allclose(pred_b, pred_e, atol=5e-3, rtol=1e-2)
+
+
+def test_block_ls_converges_with_blocks(problem):
+    """Multi-block BCD approaches the exact ridge solution; gradient → 0
+    (the reference's zero-gradient check,
+    BlockWeightedLeastSquaresSuite.scala:142-166)."""
+    X, Y = problem
+    lam = 1.0
+    est = BlockLeastSquaresEstimator(block_size=8, num_iter=20, lam=lam)
+    model = est.fit(Dataset(X), Dataset(Y))
+    W = np.asarray(model.W)[: X.shape[1]]
+    b = np.asarray(model.b)
+    # gradient of 0.5||XW+b-Y||^2 + 0.5 lam ||W||^2 wrt W (centered form)
+    xm, ym = X.mean(0), Y.mean(0)
+    Xc, Yc = X - xm, Y - ym
+    grad = Xc.T @ (Xc @ W - Yc) + lam * W
+    assert np.abs(grad).max() < 5e-2
+    np.testing.assert_allclose(b, ym - xm @ W, atol=1e-3)
+
+
+def test_block_ls_nondivisible_blocksize(problem):
+    """d=24 with block 7 (pads to 28) must still converge
+    (reference edge case 'd not divisible by blockSize',
+    BlockWeightedLeastSquaresSuite.scala:188)."""
+    X, Y = problem
+    est = BlockLeastSquaresEstimator(block_size=7, num_iter=20, lam=1.0)
+    model = est.fit(Dataset(X), Dataset(Y))
+    Wref, bref = ridge_closed_form(X, Y, 1.0)
+    np.testing.assert_allclose(np.asarray(model.W)[:24], Wref, atol=5e-2, rtol=5e-2)
+
+
+def test_lbfgs_dense_with_and_without_intercept(problem):
+    """LBFGS shares the (XᵀX + λI) regularization convention with the
+    exact solver, so the same λ must give the same model."""
+    X, Y = problem
+    lam = 20.0
+    for intercept in (True, False):
+        est = DenseLBFGSwithL2(lam=lam, num_iters=60, fit_intercept=intercept)
+        model = est.fit(Dataset(X), Dataset(Y))
+        W = np.asarray(model.W)
+        if intercept:
+            Wref, bref = ridge_closed_form(X, Y, lam)
+            np.testing.assert_allclose(np.asarray(model.b), bref, atol=5e-2, rtol=5e-2)
+        else:
+            Wref, _ = ridge_closed_form(X, Y, lam, intercept=False)
+        np.testing.assert_allclose(W, Wref, atol=5e-2, rtol=5e-2)
+
+
+def test_sparse_lbfgs_gram_form_matches_ridge():
+    import scipy.sparse as sp
+
+    from keystone_tpu.data.sparse import SparseDataset
+    from keystone_tpu.nodes.learning import SparseLBFGSwithL2
+
+    rng = np.random.default_rng(3)
+    n, d, k = 400, 50, 2
+    dense = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.05)
+    X = sp.csr_matrix(dense.astype(np.float32))
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    lam = 5.0
+    model = SparseLBFGSwithL2(lam=lam, num_iters=80, block_rows=128).fit(
+        SparseDataset(X), Dataset(Y)
+    )
+    Xd = np.asarray(dense, np.float32)
+    Wref, bref = ridge_closed_form(Xd, Y, lam)
+    np.testing.assert_allclose(np.asarray(model.W), Wref, atol=5e-2, rtol=5e-2)
+    np.testing.assert_allclose(np.asarray(model.b), bref, atol=5e-2)
+
+
+def test_routing_survives_sparse_input_on_dense_route():
+    """A SparseDataset routed to a dense solver must densify, not crash
+    (review regression)."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.data.sparse import SparseDataset
+
+    rng = np.random.default_rng(5)
+    X = sp.csr_matrix(rng.normal(size=(64, 8)).astype(np.float32))  # fully dense
+    Y = rng.normal(size=(64, 2)).astype(np.float32)
+    est = LeastSquaresEstimator(lam=1.0, num_chips=8)
+    model = est.fit(SparseDataset(X), Dataset(Y))
+    assert est.chosen != "sparse-lbfgs"  # density 1.0 keeps it off that route
+    pred = model.apply_batch(SparseDataset(X))
+    assert pred.numpy().shape == (64, 2)
+
+
+def test_local_least_squares_dual_form():
+    """d >> n regime (LocalLeastSquaresEstimator.scala:16-61): primal and
+    dual ridge agree."""
+    rng = np.random.default_rng(0)
+    n, d, k = 40, 200, 2
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    lam = 3.0
+    model = LocalLeastSquaresEstimator(lam).fit(Dataset(X), Dataset(Y))
+    Wref = np.linalg.solve(X.T @ X + lam * np.eye(d), X.T @ Y)
+    np.testing.assert_allclose(np.asarray(model.W), Wref, atol=1e-2, rtol=1e-2)
+
+
+def test_standard_scaler(problem):
+    X, _ = problem
+    model = StandardScaler().fit(Dataset(X))
+    np.testing.assert_allclose(np.asarray(model.mean), X.mean(0), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(model.std), X.std(0, ddof=1), rtol=1e-3)
+    scaled = model.apply_batch(Dataset(X)).numpy()
+    np.testing.assert_allclose(scaled.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(scaled.std(0, ddof=1), 1.0, rtol=1e-3)
+
+
+def test_standard_scaler_padding_invariance():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(37, 5)).astype(np.float32)  # pads to 40 over 8 shards
+    model = StandardScaler().fit(Dataset(X))
+    np.testing.assert_allclose(np.asarray(model.mean), X.mean(0), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(model.std), X.std(0, ddof=1), rtol=1e-3)
+
+
+# -------------------------------------------------- cost-model routing
+# (model: reference LeastSquaresEstimatorSuite.scala:11-95)
+
+
+def _route(n, d, k, sparsity, chips=16):
+    from keystone_tpu.nodes.learning.cost_model import CostProfile
+
+    est = LeastSquaresEstimator(num_chips=chips)
+
+    class FakeSample:
+        pass
+
+    p = CostProfile(n=n, d=d, k=k, sparsity=sparsity, num_chips=chips)
+    # call the candidate scoring directly via optimize's internals
+    import numpy as _np
+
+    rng = _np.random.default_rng(0)
+    sample = Dataset(rng.normal(size=(64, d)).astype(_np.float32))
+    if sparsity < 1.0:
+        arr = sample.numpy()
+        mask = rng.random(arr.shape) < sparsity
+        sample = Dataset((arr * mask).astype(_np.float32))
+    labels = Dataset(rng.normal(size=(64, k)).astype(_np.float32))
+    est.optimize(sample, labels, num_per_shard=max(n // chips, 1))
+    return est.chosen
+
+
+def test_routing_big_n_small_d_prefers_exact():
+    assert _route(n=2_000_000, d=128, k=10, sparsity=1.0) == "exact"
+
+
+def test_routing_big_d_prefers_block_or_lbfgs():
+    choice = _route(n=100_000, d=16384, k=2, sparsity=1.0)
+    assert choice in ("block-ls", "dense-lbfgs")
+
+
+def test_routing_sparse_prefers_sparse_lbfgs():
+    assert _route(n=5_000_000, d=16384, k=2, sparsity=0.004) == "sparse-lbfgs"
