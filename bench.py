@@ -1,0 +1,84 @@
+"""Benchmark: RandomPatchCifar featurize+solve throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the driver-defined north star is RandomPatchCifar over 50 000
+CIFAR images reaching >=84% accuracy in <60 s on a v5e-16 pod, i.e.
+833 images/sec across 16 chips (BASELINE.md). vs_baseline compares this
+single-chip warm throughput against the full-pod 833 img/s target, so
+vs_baseline > 1.0 means one chip alone already beats the whole-pod
+reference rate.
+
+Uses the learnable synthetic CIFAR task (no dataset egress in this
+environment); pass --train-path to run on real CIFAR binaries.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train-path")
+    p.add_argument("--test-path")
+    p.add_argument("--n-train", type=int, default=10_000)
+    p.add_argument("--n-test", type=int, default=2_000)
+    p.add_argument("--num-filters", type=int, default=256)
+    args = p.parse_args()
+
+    from keystone_tpu.pipelines.random_patch_cifar import (
+        RandomPatchCifarConfig,
+        build_pipeline,
+    )
+    from keystone_tpu.loaders.cifar_loader import cifar_loader, synthetic_cifar
+    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+    from keystone_tpu.workflow import PipelineEnv
+
+    config = RandomPatchCifarConfig(num_filters=args.num_filters)
+    if args.train_path:
+        train = cifar_loader(args.train_path)
+        test = cifar_loader(args.test_path or args.train_path)
+    else:
+        train, test = synthetic_cifar(args.n_train, args.n_test)
+
+    # Warm-up at the SAME shapes (jit caches are shape-keyed): run the
+    # full workload once untimed so the measured run reflects steady-state
+    # TPU throughput, not compile time.
+    warm_pipe = build_pipeline(train, config)
+    _ = warm_pipe(train.data).get()
+    PipelineEnv.reset()
+
+    evaluator = MulticlassClassifierEvaluator(config.num_classes)
+    t0 = time.perf_counter()
+    predictor = build_pipeline(train, config)
+    train_metrics = evaluator(predictor(train.data), train.labels)
+    elapsed = time.perf_counter() - t0
+    test_metrics = evaluator(predictor(test.data), test.labels)
+
+    imgs_per_sec = train.data.count / elapsed
+    baseline = 833.0  # north-star pod rate: 50k imgs / 60 s on v5e-16
+    print(
+        json.dumps(
+            {
+                "metric": "cifar_randompatch_train_images_per_sec",
+                "value": round(imgs_per_sec, 2),
+                "unit": "images/sec (1 chip, warm)",
+                "vs_baseline": round(imgs_per_sec / baseline, 4),
+                "detail": {
+                    "n_train": train.data.count,
+                    "train_seconds": round(elapsed, 3),
+                    "train_error": round(train_metrics.error, 4),
+                    "test_accuracy": round(test_metrics.accuracy, 4),
+                    "num_filters": config.num_filters,
+                    "synthetic": not bool(args.train_path),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

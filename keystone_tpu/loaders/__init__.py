@@ -1,1 +1,2 @@
 from .csv_loader import LabeledData, csv_data_loader
+from .cifar_loader import cifar_loader, synthetic_cifar

@@ -1,0 +1,329 @@
+"""Core image featurization nodes.
+
+TPU-first redesign of the reference's convolution path: the reference
+hand-packs im2col patch matrices per image and GEMMs them against the
+filter bank with per-partition buffer reuse (nodes/images/
+Convolver.scala:20-221). On TPU that entire dance is
+`lax.conv_general_dilated` over the NHWC batch — XLA does the im2col
+tiling onto the MXU itself. Patch-mean normalization and ZCA whitening
+are *folded into the conv algebraically* instead of materializing
+normalized patches:
+
+    out[p, k] = (patch_p − mean(patch_p)·1 − zca_mean) · (W_zca f_k)
+              = conv(img, G)[p, k] − mean_p · colsum(G_k) − zca_mean·G_k
+
+with G = W_zca @ F, and mean_p itself a uniform conv. One big conv + a
+cheap rank-1 correction, fully fused by XLA.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import Transformer
+
+
+@partial(jax.jit, static_argnames=("normalize",))
+def _convolve(images, kernel, colsum, bias, normalize: bool):
+    """Folded conv: one module-level jit keyed on shapes, shared by every
+    Convolver instance (rebuilding a pipeline must not recompile)."""
+    dn = lax.conv_dimension_numbers(
+        images.shape, kernel.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    out = lax.conv_general_dilated(
+        images, kernel, (1, 1), "VALID", dimension_numbers=dn,
+        preferred_element_type=jnp.float32,
+    )
+    if normalize:
+        # per-patch mean via a uniform conv, broadcast against the filter
+        # column sums (the rank-1 correction)
+        p, c = kernel.shape[0], kernel.shape[2]
+        ones = jnp.ones((p, p, c, 1), images.dtype) / (p * p * c)
+        means = lax.conv_general_dilated(
+            images, ones, (1, 1), "VALID",
+            dimension_numbers=lax.conv_dimension_numbers(
+                images.shape, ones.shape, ("NHWC", "HWIO", "NHWC")
+            ),
+            preferred_element_type=jnp.float32,
+        )
+        out = out - means * colsum
+    return out + bias
+
+
+class Convolver(Transformer):
+    """Valid-mode convolution of a filter bank over image batches
+    (Convolver.scala:20-221), with optional folded patch-mean
+    normalization and ZCA whitening.
+
+    filters: (K, D) with D = patch·patch·C (the reference's packed
+    layout, Convolver.scala:99-125) or (K, patch, patch, C).
+    """
+
+    def __init__(
+        self,
+        filters,
+        img_height: int,
+        img_width: int,
+        img_channels: int,
+        whitener=None,
+        normalize_patches: bool = True,
+        patch_size: Optional[int] = None,
+    ):
+        filters = np.asarray(filters, np.float32)
+        if filters.ndim == 2:
+            if patch_size is None:
+                patch_size = int(round((filters.shape[1] / img_channels) ** 0.5))
+            filters = filters.reshape(-1, patch_size, patch_size, img_channels)
+        self.patch = filters.shape[1]
+        self.num_filters = filters.shape[0]
+        self.img_shape = (img_height, img_width, img_channels)
+        self.whitener = whitener
+        self.normalize_patches = normalize_patches
+
+        D = self.patch * self.patch * img_channels
+        F = filters.reshape(self.num_filters, D).T  # (D, K)
+        if whitener is not None:
+            G = np.asarray(whitener.whitener, np.float32) @ F  # (D, K)
+            zca_mean = np.asarray(whitener.means, np.float32)  # (D,)
+            self.bias = -(zca_mean @ G)  # (K,)
+        else:
+            G = F
+            self.bias = np.zeros(self.num_filters, np.float32)
+        # folded conv kernel, HWIO
+        self.kernel = jnp.asarray(
+            G.T.reshape(self.num_filters, self.patch, self.patch, img_channels)
+            .transpose(1, 2, 3, 0)
+        )
+        self.colsum = jnp.asarray(G.sum(axis=0))  # (K,)
+        self.bias = jnp.asarray(self.bias)
+
+    def apply(self, image):
+        return _convolve(
+            jnp.asarray(image)[None], self.kernel, self.colsum, self.bias,
+            self.normalize_patches,
+        )[0]
+
+    def batch_fn(self):
+        return lambda imgs: _convolve(
+            imgs, self.kernel, self.colsum, self.bias, self.normalize_patches
+        )
+
+    def apply_batch(self, data: Dataset):
+        return data.map_batches(self.batch_fn(), jitted=False)
+
+
+class SymmetricRectifier(Transformer):
+    """Two-sided ReLU: channels double to [max(0, x−α), max(0, −x−α)]
+    (SymmetricRectifier.scala:7-32)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = max_val
+        self.alpha = alpha
+
+    def apply(self, x):
+        return jnp.concatenate(
+            [
+                jnp.maximum(self.max_val, x - self.alpha),
+                jnp.maximum(self.max_val, -x - self.alpha),
+            ],
+            axis=-1,
+        )
+
+    def batch_fn(self):
+        return self.apply  # elementwise: batched arrays work directly
+
+
+class Pooler(Transformer):
+    """Strided sum-pooling with an elementwise pre-map
+    (Pooler.scala:21-69) — `lax.reduce_window` on TPU."""
+
+    def __init__(self, stride: int, pool_size: int, pixel_fn=None, pool_fn="sum"):
+        self.stride = stride
+        self.pool_size = pool_size
+        self.pixel_fn = pixel_fn
+        if pool_fn not in ("sum", "max"):
+            raise ValueError("pool_fn must be 'sum' or 'max'")
+        self.pool_fn = pool_fn
+
+    def apply(self, x):  # (H, W, C)
+        if self.pixel_fn is not None:
+            x = self.pixel_fn(x)
+        init, op = (0.0, lax.add) if self.pool_fn == "sum" else (-jnp.inf, lax.max)
+        return lax.reduce_window(
+            x,
+            init,
+            op,
+            window_dimensions=(self.pool_size, self.pool_size, 1),
+            window_strides=(self.stride, self.stride, 1),
+            padding="VALID",
+        )
+
+    def batch_fn(self):
+        def fn(x):  # (N, H, W, C)
+            y = x if self.pixel_fn is None else self.pixel_fn(x)
+            init, op = (0.0, lax.add) if self.pool_fn == "sum" else (-jnp.inf, lax.max)
+            return lax.reduce_window(
+                y, init, op,
+                window_dimensions=(1, self.pool_size, self.pool_size, 1),
+                window_strides=(1, self.stride, self.stride, 1),
+                padding="VALID",
+            )
+
+        return fn
+
+
+class ImageVectorizer(Transformer):
+    """(H, W, C) → flat vector (ImageVectorizer.scala:12)."""
+
+    def apply(self, x):
+        return jnp.ravel(x)
+
+    def batch_fn(self):
+        return lambda x: x.reshape(x.shape[0], -1)
+
+
+class PixelScaler(Transformer):
+    """x / 255 (PixelScaler.scala:9)."""
+
+    def apply(self, x):
+        return jnp.asarray(x, jnp.float32) / 255.0
+
+    def batch_fn(self):
+        return self.apply
+
+
+class GrayScaler(Transformer):
+    """NTSC grayscale (GrayScaler.scala:9)."""
+
+    def apply(self, x):
+        from ...utils.images import grayscale
+
+        return grayscale(x)
+
+
+class Cropper(Transformer):
+    """(Cropper.scala:19)"""
+
+    def __init__(self, y0: int, x0: int, y1: int, x1: int):
+        self.box = (y0, x0, y1, x1)
+
+    def apply(self, x):
+        y0, x0, y1, x1 = self.box
+        return x[y0:y1, x0:x1, :]
+
+
+class Windower(Transformer):
+    """All strided patches of each image; the batch path flattens
+    (N, …) → (N·patches, p, p, C), changing the dataset count
+    (Windower.scala:13-56 — a FunctionNode/flatMap in the reference)."""
+
+    def __init__(self, stride: int, window_size: int):
+        self.stride = stride
+        self.window_size = window_size
+
+    def apply(self, image):
+        from ...utils.images import extract_patches
+
+        flat = extract_patches(np.asarray(image)[None], self.window_size, self.stride)
+        return flat.reshape(-1, self.window_size, self.window_size, image.shape[-1])
+
+    def apply_batch(self, data: Dataset):
+        from ...utils.images import extract_patches
+
+        imgs = data.numpy()
+        c = imgs.shape[-1]
+        patches = extract_patches(imgs, self.window_size, self.stride)
+        return Dataset(
+            patches.reshape(-1, self.window_size, self.window_size, c),
+            mesh=data.mesh,
+        )
+
+
+class RandomPatcher(Transformer):
+    """Random crops for augmentation (RandomPatcher.scala:16-47). The
+    batch path emits `patches_per_image` crops per image (count grows)."""
+
+    def __init__(self, patches_per_image: int, patch_h: int, patch_w: int, seed: int = 0):
+        self.patches_per_image = patches_per_image
+        self.patch_h = patch_h
+        self.patch_w = patch_w
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)  # stateful: varies per call
+
+    def apply_batch(self, data: Dataset):
+        imgs = data.numpy()
+        n, h, w, c = imgs.shape
+        rng = np.random.default_rng(self.seed)
+        ys = rng.integers(0, h - self.patch_h + 1, size=(n, self.patches_per_image))
+        xs = rng.integers(0, w - self.patch_w + 1, size=(n, self.patches_per_image))
+        out = np.empty((n * self.patches_per_image, self.patch_h, self.patch_w, c), imgs.dtype)
+        idx = 0
+        for i in range(n):
+            for j in range(self.patches_per_image):
+                y, x = ys[i, j], xs[i, j]
+                out[idx] = imgs[i, y : y + self.patch_h, x : x + self.patch_w]
+                idx += 1
+        return Dataset(out, mesh=data.mesh)
+
+    def apply(self, image):
+        y = self._rng.integers(0, image.shape[0] - self.patch_h + 1)
+        x = self._rng.integers(0, image.shape[1] - self.patch_w + 1)
+        return image[y : y + self.patch_h, x : x + self.patch_w]
+
+
+class CenterCornerPatcher(Transformer):
+    """Center + 4 corner crops, optionally h-flipped variants
+    (CenterCornerPatcher.scala:19-48)."""
+
+    def __init__(self, patch_h: int, patch_w: int, with_flips: bool = False):
+        self.patch_h = patch_h
+        self.patch_w = patch_w
+        self.with_flips = with_flips
+
+    def _crops(self, image):
+        h, w = image.shape[0], image.shape[1]
+        ph, pw = self.patch_h, self.patch_w
+        starts = [
+            (0, 0), (0, w - pw), (h - ph, 0), (h - ph, w - pw),
+            ((h - ph) // 2, (w - pw) // 2),
+        ]
+        crops = [image[y : y + ph, x : x + pw] for y, x in starts]
+        if self.with_flips:
+            crops += [c[:, ::-1] for c in crops]
+        return crops
+
+    def apply(self, image):
+        return np.stack(self._crops(np.asarray(image)))
+
+    def apply_batch(self, data: Dataset):
+        imgs = data.numpy()
+        out = np.concatenate([np.stack(self._crops(img)) for img in imgs])
+        return Dataset(out, mesh=data.mesh)
+
+
+class RandomImageTransformer(Transformer):
+    """Apply a transform with probability p (RandomImageTransformer.scala:15-31)."""
+
+    def __init__(self, prob: float, transform, seed: int = 0):
+        self.prob = prob
+        self.transform = transform
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)  # stateful: varies per call
+
+    def apply_batch(self, data: Dataset):
+        imgs = np.array(data.numpy(), copy=True)
+        rng = np.random.default_rng(self.seed)
+        flips = rng.random(imgs.shape[0]) < self.prob
+        for i in np.nonzero(flips)[0]:
+            imgs[i] = self.transform(imgs[i])
+        return Dataset(imgs, mesh=data.mesh)
+
+    def apply(self, image):
+        return self.transform(image) if self._rng.random() < self.prob else image

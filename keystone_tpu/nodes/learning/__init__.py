@@ -9,3 +9,4 @@ from .cost_model import (
     ExactSolverCostModel,
     LBFGSCostModel,
 )
+from .zca import ZCAWhitener, ZCAWhitenerEstimator

@@ -25,7 +25,7 @@ passes over the input — feeding auto-caching (BlockLinearMapper.scala:205-210)
 
 from __future__ import annotations
 
-from functools import cached_property, partial
+from functools import partial
 from typing import Optional
 
 import jax
@@ -107,20 +107,16 @@ class BlockLinearMapper(Transformer):
             x = jnp.pad(x, [(0, d - x.shape[-1])])
         return x @ self.W + self.b
 
-    @cached_property
-    def _batch_fn(self):
-        W, b = self.W, self.b
+    def apply_batch(self, data: Dataset):
+        from .linear import _gemm_bias
 
         def fn(X):
-            d = W.shape[0]
+            d = self.W.shape[0]
             if X.shape[1] < d:
                 X = jnp.pad(X, [(0, 0), (0, d - X.shape[1])])
-            return X @ W + b
+            return _gemm_bias(X, self.W, self.b)
 
-        return jax.jit(fn)
-
-    def apply_batch(self, data: Dataset):
-        return data.map_batches(self._batch_fn, jitted=False)
+        return data.map_batches(fn, jitted=False)
 
     def apply_and_evaluate(self, data: Dataset, eval_fn):
         """Incremental per-block evaluation (BlockLinearMapper.scala:96-137):

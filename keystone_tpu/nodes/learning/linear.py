@@ -19,13 +19,20 @@ padding).
 
 from __future__ import annotations
 
-from functools import cached_property, partial
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 from ...data.dataset import Dataset
 from ...workflow.pipeline import LabelEstimator, Transformer
+
+
+@jax.jit
+def _gemm_bias(X, W, b):
+    """Module-level jit: one compile per shape, shared by every linear
+    model instance (rebuilding a pipeline must not recompile)."""
+    return X @ W + b
 
 
 class LinearMapper(Transformer):
@@ -45,18 +52,11 @@ class LinearMapper(Transformer):
             out = out + self.b
         return out
 
-    @cached_property
-    def _batch_fn(self):
-        # One jitted GEMM per model instance: repeated prediction calls hit
-        # the jit cache instead of retracing (cf. CosineRandomFeatures).
-        W = self.W
-        b = self.b if self.b is not None else jnp.zeros(self.W.shape[1], self.W.dtype)
-        return jax.jit(lambda X: X @ W + b)
-
     def apply_batch(self, data: Dataset):
         if self.feature_scaler is not None:
             data = self.feature_scaler.apply_batch(data)
-        return data.map_batches(self._batch_fn, jitted=False)
+        b = self.b if self.b is not None else jnp.zeros(self.W.shape[1], self.W.dtype)
+        return data.map_batches(lambda X: _gemm_bias(X, self.W, b), jitted=False)
 
 
 @partial(jax.jit, static_argnames=("fit_intercept",))

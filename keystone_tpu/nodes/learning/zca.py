@@ -1,0 +1,64 @@
+"""ZCA whitening (reference nodes/learning/ZCAWhitener.scala:12-77).
+
+The reference collects a sampled patch matrix to the driver and runs
+LAPACK `sgesvd`; here the SVD runs on-device via `jnp.linalg.svd` on the
+(replicated) sample — whitener = V diag((s²/(n−1) + ε))^(-1/2) Vᵀ.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import Estimator, Transformer
+
+
+@jax.jit
+def _whiten(X, W, mu):
+    return (X - mu) @ W
+
+
+class ZCAWhitener(Transformer):
+    def __init__(self, whitener, means):
+        self.whitener = jnp.asarray(whitener)  # (D, D)
+        self.means = jnp.asarray(means)  # (D,)
+        # host copies for driver-side filter math (no device round-trips)
+        self.whitener_np = np.asarray(whitener, np.float32)
+        self.means_np = np.asarray(means, np.float32)
+
+    def apply(self, x):
+        return (jnp.asarray(x) - self.means) @ self.whitener
+
+    def apply_batch(self, data: Dataset):
+        return data.map_batches(
+            lambda X: _whiten(X, self.whitener, self.means), jitted=False
+        )
+
+
+def _fit_zca_np(X: np.ndarray, eps: float):
+    """Host eigendecomposition (D×D is small; the reference also fits on
+    the driver via LAPACK, ZCAWhitener.scala:53-60)."""
+    n = X.shape[0]
+    mu = X.mean(axis=0)
+    Xc = X - mu
+    cov = (Xc.T @ Xc) / max(n - 1.0, 1.0)
+    lams, V = np.linalg.eigh(cov)
+    scale = 1.0 / np.sqrt(np.maximum(lams, 0.0) + eps)
+    W = (V * scale) @ V.T
+    return W.astype(np.float32), mu.astype(np.float32)
+
+
+class ZCAWhitenerEstimator(Estimator):
+    def __init__(self, eps: float = 0.1):
+        self.eps = eps
+
+    def fit(self, data) -> ZCAWhitener:
+        X = data.numpy() if isinstance(data, Dataset) else np.asarray(data)
+        return self.fit_single(X)
+
+    def fit_single(self, X: np.ndarray) -> ZCAWhitener:
+        """Fit on an in-memory (m × D) matrix (ZCAWhitener.fitSingle)."""
+        W, mu = _fit_zca_np(np.asarray(X, np.float32), self.eps)
+        return ZCAWhitener(W, mu)

@@ -1,0 +1,195 @@
+"""RandomPatchCifar — the north-star pipeline.
+
+Reference: pipelines/images/cifar/RandomPatchCifar.scala:21-86. Filters
+are whitened random patches from the training set (Coates & Ng style):
+
+  driver-side filter learning (:45-57):
+    Windower(1, patch) → vectorize → sample 100k patches
+    → normalizeRows(sample, 10) → ZCAWhitenerEstimator.fitSingle
+    → whiten sample → normalize → take numFilters rows as filters
+  prediction pipeline (:59-69):
+    Convolver(filters, whitener) → SymmetricRectifier(α=0.25)
+    → Pooler(stride, size, sum) → ImageVectorizer → Cacher
+    → StandardScaler → BlockLeastSquares(4096, 1, λ) → MaxClassifier
+
+The TPU featurization path is one fused XLA program per batch: conv with
+whitening folded into the kernel, two-sided ReLU, reduce_window pooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..evaluation import MulticlassClassifierEvaluator
+from ..loaders.cifar_loader import cifar_loader, synthetic_cifar
+from ..nodes.images.core import (
+    Convolver,
+    ImageVectorizer,
+    PixelScaler,
+    Pooler,
+    SymmetricRectifier,
+)
+from ..nodes.learning import BlockLeastSquaresEstimator
+from ..nodes.learning.zca import ZCAWhitenerEstimator
+from ..nodes.stats import StandardScaler
+from ..nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
+from ..nodes.util.fusion import FusedBatchTransformer
+from ..utils.images import extract_patches
+from ..workflow import Pipeline
+
+
+@dataclass
+class RandomPatchCifarConfig:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_filters: int = 256
+    patch_size: int = 6
+    patch_steps: int = 1
+    pool_size: int = 14
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: float = 10.0
+    sample_patches: int = 100_000
+    block_size: int = 4096
+    num_classes: int = 10
+    microbatch: int = 2048
+    seed: int = 0
+    # synthetic fallback sizes (used when no train_path)
+    synth_train: int = 2000
+    synth_test: int = 500
+
+
+def learn_filters(train_data: Dataset, config) -> tuple:
+    """Whitened random-patch filter learning (reference :45-57).
+
+    Runs entirely host-side on a small image sample — only the sampled
+    images cross the device boundary (the full dataset stays sharded on
+    the mesh; collects are expensive, especially over a TPU tunnel).
+    This mirrors the reference's driver-side LAPACK filter learning.
+    """
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(config.seed)
+    n = train_data.count
+    n_sample = min(n, max(config.sample_patches // 100, 64))
+    idx = np.sort(rng.choice(n, size=n_sample, replace=False))
+    sample_imgs = np.asarray(jnp.take(train_data.array, idx, axis=0)) / 255.0
+
+    patches = extract_patches(sample_imgs, config.patch_size, config.patch_steps)
+    if patches.shape[0] > config.sample_patches:
+        patches = patches[
+            rng.choice(patches.shape[0], config.sample_patches, replace=False)
+        ]
+    # normalizeRows(_, 10.0): subtract patch mean, divide by max(norm, 10/255)
+    patches = patches - patches.mean(axis=1, keepdims=True)
+    norms = np.linalg.norm(patches, axis=1, keepdims=True)
+    patches = (patches / np.maximum(norms, 10.0 / 255.0)).astype(np.float32)
+
+    whitener = ZCAWhitenerEstimator(eps=0.1).fit_single(patches)
+    whitened = (patches - whitener.means_np) @ whitener.whitener_np
+    wnorms = np.linalg.norm(whitened, axis=1, keepdims=True)
+    whitened = whitened / np.maximum(wnorms, 1e-8)
+    filters = whitened[
+        rng.choice(whitened.shape[0], config.num_filters, replace=False)
+    ]
+    return filters, whitener
+
+
+def build_pipeline(train, config):
+    """Build + fit the full prediction pipeline; returns (pipeline, labels)."""
+    filters, whitener = learn_filters(train.data, config)
+
+    leaves = train.data.array
+    h, w, c = leaves.shape[1:]
+    # One fused, microbatched XLA program for the whole featurization:
+    # scale → folded-whitening conv → two-sided ReLU → sum-pool → flatten.
+    featurizer = (
+        FusedBatchTransformer(
+            [
+                PixelScaler(),
+                Convolver(filters, h, w, c, whitener=whitener, normalize_patches=True),
+                SymmetricRectifier(alpha=config.alpha),
+                Pooler(config.pool_stride, config.pool_size, pool_fn="sum"),
+                ImageVectorizer(),
+            ],
+            microbatch=config.microbatch,
+        ).to_pipeline()
+        >> Cacher("features")
+    )
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(train.labels).get()
+    predictor = (
+        featurizer
+        .and_then(StandardScaler(), train.data)
+        .and_then(
+            BlockLeastSquaresEstimator(config.block_size, num_iter=1, lam=config.lam),
+            train.data,
+            labels,
+        )
+        >> MaxClassifier()
+    )
+    return predictor
+
+
+def run(config: RandomPatchCifarConfig):
+    if config.train_path:
+        train = cifar_loader(config.train_path)
+        test = cifar_loader(config.test_path or config.train_path)
+    else:
+        train, test = synthetic_cifar(
+            config.synth_train, config.synth_test, config.num_classes, config.seed
+        )
+
+    t0 = time.perf_counter()
+    predictor = build_pipeline(train, config)
+    evaluator = MulticlassClassifierEvaluator(config.num_classes)
+    train_metrics = evaluator(predictor(train.data), train.labels)
+    t_train = time.perf_counter() - t0
+    test_metrics = evaluator(predictor(test.data), test.labels)
+    return {
+        "train_error": train_metrics.error,
+        "test_error": test_metrics.error,
+        "test_accuracy": test_metrics.accuracy,
+        "train_seconds": t_train,
+        "images_per_sec": train.data.count / t_train,
+        "summary": test_metrics.summary(),
+        "predictor": predictor,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train-path", dest="train_path")
+    p.add_argument("--test-path", dest="test_path")
+    p.add_argument("--num-filters", dest="num_filters", type=int, default=256)
+    p.add_argument("--patch-size", dest="patch_size", type=int, default=6)
+    p.add_argument("--pool-size", dest="pool_size", type=int, default=14)
+    p.add_argument("--pool-stride", dest="pool_stride", type=int, default=13)
+    p.add_argument("--alpha", type=float, default=0.25)
+    p.add_argument("--lam", type=float, default=10.0)
+    p.add_argument("--block-size", dest="block_size", type=int, default=4096)
+    p.add_argument("--synth-train", dest="synth_train", type=int, default=2000)
+    p.add_argument("--synth-test", dest="synth_test", type=int, default=500)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    config = RandomPatchCifarConfig(
+        **{k: v for k, v in vars(args).items() if v is not None}
+    )
+    result = run(config)
+    print(result["summary"])
+    print(
+        f"train_error={result['train_error']:.4f} "
+        f"test_error={result['test_error']:.4f} "
+        f"train_time={result['train_seconds']:.2f}s "
+        f"({result['images_per_sec']:.0f} img/s)"
+    )
+    return result
+
+
+if __name__ == "__main__":
+    main()

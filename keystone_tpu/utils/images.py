@@ -1,0 +1,95 @@
+"""Image containers and utilities.
+
+The reference builds five vectorized image layouts over flat arrays
+(utils/images/Image.scala:19-394) because the JVM needs manual layout
+control. TPU-natively an image is just an (H, W, C) float array — XLA
+owns layout — so `Image` reduces to a thin metadata wrapper and
+`ImageUtils` (utils/images/ImageUtils.scala:16-421) to jnp helpers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ImageMetadata:
+    """(reference utils/images/Image.scala:143)"""
+
+    x_dim: int
+    y_dim: int
+    num_channels: int
+
+
+@dataclass
+class LabeledImage:
+    """(reference utils/images/Image.scala:374-380)"""
+
+    image: np.ndarray  # (H, W, C)
+    label: int
+
+
+@dataclass
+class MultiLabeledImage:
+    """(reference utils/images/Image.scala:385-394)"""
+
+    image: np.ndarray
+    labels: Sequence[int]
+    filename: Optional[str] = None
+
+
+def grayscale(image):
+    """NTSC luminance (ImageUtils.toGrayScale)."""
+    weights = jnp.asarray([0.299, 0.587, 0.114], dtype=jnp.float32)
+    if image.shape[-1] == 1:
+        return image
+    return jnp.sum(image * weights, axis=-1, keepdims=True)
+
+
+def crop(image, y0: int, x0: int, y1: int, x1: int):
+    """(ImageUtils.crop)"""
+    return image[y0:y1, x0:x1, :]
+
+
+def flip_horizontal(image):
+    return image[:, ::-1, :]
+
+
+def depthwise_conv2d(image, kernel_y, kernel_x):
+    """Separable depthwise 2-D convolution, 'same' padding — one
+    `lax.conv_general_dilated` per axis with `feature_group_count=C`
+    (ImageUtils.conv2D's separable path — used by DAISY's Gaussian
+    blur layers)."""
+    from jax import lax
+
+    img = jnp.asarray(image, jnp.float32)[None]  # (1, H, W, C)
+    c = img.shape[-1]
+    ky = jnp.asarray(kernel_y, jnp.float32).reshape(-1, 1, 1, 1)
+    kx = jnp.asarray(kernel_x, jnp.float32).reshape(1, -1, 1, 1)
+    dn = lax.conv_dimension_numbers(img.shape, (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC"))
+    out = lax.conv_general_dilated(
+        img, jnp.tile(ky, (1, 1, 1, c)), (1, 1), "SAME",
+        dimension_numbers=dn, feature_group_count=c,
+    )
+    out = lax.conv_general_dilated(
+        out, jnp.tile(kx, (1, 1, 1, c)), (1, 1), "SAME",
+        dimension_numbers=dn, feature_group_count=c,
+    )
+    return out[0]
+
+
+def extract_patches(images: np.ndarray, patch: int, stride: int = 1) -> np.ndarray:
+    """All strided (patch × patch × C) windows of a batch of images,
+    flattened per patch: (N·num_patches, patch*patch*C). Host-side numpy
+    (used for filter learning on samples, reference Windower.scala:13-56)."""
+    images = np.asarray(images)
+    n, h, w, c = images.shape
+    view = np.lib.stride_tricks.sliding_window_view(images, (patch, patch), axis=(1, 2))
+    # view: (n, h-p+1, w-p+1, c, p, p)
+    view = view[:, ::stride, ::stride]
+    view = view.transpose(0, 1, 2, 4, 5, 3)  # (n, gy, gx, p, p, c)
+    return view.reshape(-1, patch * patch * c)

@@ -296,7 +296,19 @@ class Transformer(TransformerOperator, Chainable):
     def apply_batch(self, data: Any) -> Any:
         from ..data.dataset import Dataset, HostDataset
 
-        if isinstance(data, (Dataset, HostDataset)):
+        if isinstance(data, Dataset):
+            # One stable jitted vmap per transformer instance: repeated
+            # batch applies hit the jit cache instead of retracing (the
+            # cache is keyed on function identity, so a fresh
+            # jit(vmap(bound_method)) per call would always miss).
+            fn = self.__dict__.get("_jitted_batch_apply")
+            if fn is None:
+                import jax
+
+                fn = jax.jit(jax.vmap(self.apply))
+                self.__dict__["_jitted_batch_apply"] = fn
+            return data.map_batches(fn, jitted=False)
+        if isinstance(data, HostDataset):
             return data.map(self.apply)
         return [self.apply(x) for x in data]
 

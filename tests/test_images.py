@@ -1,0 +1,143 @@
+"""Image featurizer tests (model: reference ConvolverSuite golden test vs
+scipy — src/test/python/images/pyconv.py — plus shape/semantics suites)."""
+
+import numpy as np
+import pytest
+import scipy.signal
+
+from keystone_tpu import Dataset
+from keystone_tpu.nodes.images import (
+    CenterCornerPatcher,
+    Convolver,
+    Cropper,
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+    Pooler,
+    RandomPatcher,
+    SymmetricRectifier,
+    Windower,
+)
+from keystone_tpu.nodes.learning import ZCAWhitenerEstimator
+from keystone_tpu.nodes.util.fusion import FusedBatchTransformer
+from keystone_tpu.utils.images import extract_patches
+
+
+def test_convolver_matches_scipy_golden():
+    """Plain conv (no whitening/normalization) vs scipy.signal.correlate
+    (the reference checks per-pixel agreement with a SciPy fixture)."""
+    rng = np.random.default_rng(0)
+    img = rng.normal(size=(16, 16, 3)).astype(np.float32)
+    filters = rng.normal(size=(4, 5, 5, 3)).astype(np.float32)
+    conv = Convolver(filters, 16, 16, 3, whitener=None, normalize_patches=False)
+    out = np.asarray(conv.apply(img))
+    assert out.shape == (12, 12, 4)
+    for k in range(4):
+        ref = sum(
+            scipy.signal.correlate(img[:, :, c], filters[k, :, :, c], mode="valid")
+            for c in range(3)
+        )
+        np.testing.assert_allclose(out[:, :, k], ref, atol=1e-3)
+
+
+def test_convolver_whitening_fold_matches_explicit_patches():
+    """The folded conv must equal: extract patch → subtract patch mean →
+    ZCA whiten → dot filters (the reference's im2col semantics,
+    Convolver.scala:158-203)."""
+    rng = np.random.default_rng(1)
+    imgs = rng.normal(size=(3, 12, 12, 3)).astype(np.float32)
+    patch = 4
+    D = patch * patch * 3
+    sample = extract_patches(imgs, patch).astype(np.float32)
+    whitener = ZCAWhitenerEstimator(eps=0.1).fit_single(sample)
+    filters = rng.normal(size=(8, D)).astype(np.float32)
+
+    conv = Convolver(filters, 12, 12, 3, whitener=whitener, normalize_patches=True)
+    out = np.asarray(conv.apply(imgs[0]))
+
+    # explicit path
+    patches = extract_patches(imgs[0][None], patch)  # (81, D) row-major grid
+    patches = patches - patches.mean(axis=1, keepdims=True)
+    whitened = (patches - whitener.means_np) @ whitener.whitener_np
+    expected = (whitened @ filters.T).reshape(9, 9, 8)
+    np.testing.assert_allclose(out, expected, atol=2e-3)
+
+
+def test_symmetric_rectifier_doubles_channels():
+    x = np.array([[[1.0, -2.0]]], np.float32)
+    out = np.asarray(SymmetricRectifier(alpha=0.25).apply(x))
+    np.testing.assert_allclose(out[0, 0], [0.75, 0.0, 0.0, 1.75])
+
+
+def test_pooler_sum_and_max():
+    x = np.arange(16, dtype=np.float32).reshape(4, 4, 1)
+    s = np.asarray(Pooler(2, 2, pool_fn="sum").apply(x))
+    assert s.shape == (2, 2, 1)
+    assert s[0, 0, 0] == 0 + 1 + 4 + 5
+    m = np.asarray(Pooler(2, 2, pool_fn="max").apply(x))
+    assert m[1, 1, 0] == 15
+
+
+def test_pooler_batch_fn_matches_per_item():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(5, 6, 6, 2)).astype(np.float32)
+    p = Pooler(2, 3, pool_fn="sum")
+    batched = np.asarray(p.batch_fn()(x))
+    for i in range(5):
+        np.testing.assert_allclose(batched[i], np.asarray(p.apply(x[i])), atol=1e-5)
+
+
+def test_fused_transformer_equals_sequential():
+    rng = np.random.default_rng(3)
+    imgs = rng.uniform(0, 255, size=(23, 12, 12, 3)).astype(np.float32)
+    filters = rng.normal(size=(6, 4, 4, 3)).astype(np.float32)
+    stages = [
+        PixelScaler(),
+        Convolver(filters, 12, 12, 3, whitener=None, normalize_patches=False),
+        SymmetricRectifier(alpha=0.1),
+        Pooler(3, 4, pool_fn="sum"),
+        ImageVectorizer(),
+    ]
+    ds = Dataset(imgs)
+    fused_out = FusedBatchTransformer(stages, microbatch=4).apply_batch(ds).numpy()
+    seq = ds
+    for s in stages:
+        seq = s.apply_batch(seq)
+    np.testing.assert_allclose(fused_out, seq.numpy(), atol=1e-4)
+    assert fused_out.shape[0] == 23
+
+
+def test_windower_counts_and_values():
+    imgs = np.arange(2 * 5 * 5 * 1, dtype=np.float32).reshape(2, 5, 5, 1)
+    out = Windower(2, 3).apply_batch(Dataset(imgs))
+    # grid positions: ceil((5-3+1)/2)=2 per axis -> 4 patches per image
+    assert out.count == 2 * 4
+    first = out.numpy()[0]
+    np.testing.assert_allclose(first[:, :, 0], imgs[0, 0:3, 0:3, 0])
+
+
+def test_patchers_and_croppers():
+    imgs = np.random.default_rng(4).normal(size=(3, 8, 8, 3)).astype(np.float32)
+    rp = RandomPatcher(5, 4, 4, seed=1).apply_batch(Dataset(imgs))
+    assert rp.count == 15 and rp.numpy().shape[1:] == (4, 4, 3)
+    cc = CenterCornerPatcher(4, 4, with_flips=True).apply_batch(Dataset(imgs))
+    assert cc.count == 3 * 10
+    crop = np.asarray(Cropper(1, 2, 5, 6).apply(imgs[0]))
+    assert crop.shape == (4, 4, 3)
+    np.testing.assert_allclose(crop, imgs[0][1:5, 2:6])
+
+
+def test_grayscaler_ntsc():
+    img = np.ones((2, 2, 3), np.float32)
+    out = np.asarray(GrayScaler().apply(img))
+    np.testing.assert_allclose(out, np.ones((2, 2, 1)), atol=1e-5)
+
+
+def test_zca_whitener_decorrelates():
+    rng = np.random.default_rng(5)
+    A = rng.normal(size=(4, 4)).astype(np.float32)
+    X = (rng.normal(size=(2000, 4)) @ A).astype(np.float32)
+    w = ZCAWhitenerEstimator(eps=1e-5).fit_single(X)
+    Xw = (X - w.means_np) @ w.whitener_np
+    cov = Xw.T @ Xw / (len(X) - 1)
+    np.testing.assert_allclose(cov, np.eye(4), atol=0.05)
