@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Pipeline launcher (mirrors the reference bin/run-pipeline.sh: class
+# name + flags -> JVM/spark-submit; here -> python -m keystone_tpu).
+#
+#   ./bin/run-pipeline.sh pipelines.images.cifar.RandomPatchCifar --num-filters 256
+#
+# Env:
+#   KEYSTONE_BACKEND=tpu|cpu   (default: whatever jax picks; cpu forces
+#                               JAX_PLATFORMS=cpu)
+#   KEYSTONE_CPU_DEVICES=N     (virtual device count when backend=cpu)
+set -euo pipefail
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+
+if [[ "${KEYSTONE_BACKEND:-}" == "cpu" ]]; then
+  export JAX_PLATFORMS=cpu
+  if [[ -n "${KEYSTONE_CPU_DEVICES:-}" ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${KEYSTONE_CPU_DEVICES}"
+  fi
+fi
+
+exec python -m keystone_tpu "$@"

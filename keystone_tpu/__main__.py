@@ -1,0 +1,55 @@
+"""Pipeline launcher (the reference's bin/run-pipeline.sh: class name +
+flags → spark-submit; here: pipeline name + flags → the app's argparse
+main, reference bin/run-pipeline.sh:1-55).
+
+    python -m keystone_tpu pipelines.images.cifar.RandomPatchCifar --num-filters 256
+    python -m keystone_tpu MnistRandomFFT --num-ffts 4
+
+Names accept the reference's fully-qualified form or the bare class name.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+#: reference class name -> (module, main callable name)
+REGISTRY = {
+    "pipelines.images.mnist.MnistRandomFFT": ("keystone_tpu.pipelines.mnist_random_fft", "main"),
+    "pipelines.images.cifar.RandomPatchCifar": ("keystone_tpu.pipelines.random_patch_cifar", "main"),
+    "pipelines.images.cifar.LinearPixels": ("keystone_tpu.pipelines.cli_mains", "linear_pixels_main"),
+    "pipelines.images.cifar.RandomCifar": ("keystone_tpu.pipelines.cli_mains", "random_cifar_main"),
+    "pipelines.images.cifar.RandomPatchCifarKernel": ("keystone_tpu.pipelines.cli_mains", "cifar_kernel_main"),
+    "pipelines.images.cifar.RandomPatchCifarAugmented": ("keystone_tpu.pipelines.cli_mains", "cifar_augmented_main"),
+    "pipelines.images.voc.VOCSIFTFisher": ("keystone_tpu.pipelines.voc_sift_fisher", "main"),
+    "pipelines.images.imagenet.ImageNetSiftLcsFV": ("keystone_tpu.pipelines.imagenet_sift_lcs_fv", "main"),
+    "pipelines.speech.TimitPipeline": ("keystone_tpu.pipelines.timit", "main"),
+    "pipelines.text.NewsgroupsPipeline": ("keystone_tpu.pipelines.cli_mains", "newsgroups_main"),
+    "pipelines.text.AmazonReviewsPipeline": ("keystone_tpu.pipelines.cli_mains", "amazon_main"),
+    "pipelines.nlp.StupidBackoffPipeline": ("keystone_tpu.pipelines.cli_mains", "stupid_backoff_main"),
+}
+
+_SHORT = {name.rsplit(".", 1)[-1]: v for name, v in REGISTRY.items()}
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("Available pipelines:")
+        for name in sorted(REGISTRY):
+            print(f"  {name}")
+        return 0
+    name, rest = argv[0], argv[1:]
+    entry = REGISTRY.get(name) or _SHORT.get(name)
+    if entry is None:
+        print(f"unknown pipeline {name!r}; run with --help to list", file=sys.stderr)
+        return 2
+    module, fn_name = entry
+    fn = getattr(importlib.import_module(module), fn_name)
+    fn(rest)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Augmented-example evaluation (reference
+evaluation/AugmentedExamplesEvaluator.scala): average the score vectors
+of all augmented variants of each original example (by id), argmax the
+averaged scores, then evaluate multiclass metrics."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Sequence
+
+import numpy as np
+
+from .multiclass import MulticlassClassifierEvaluator, MulticlassMetrics
+
+
+class AugmentedExamplesEvaluator:
+    def __init__(self, num_classes: int, agg: str = "mean"):
+        self.num_classes = num_classes
+        if agg not in ("mean", "max"):
+            raise ValueError("agg must be 'mean' or 'max'")
+        self.agg = agg
+
+    def evaluate(self, ids: Sequence, scores, actuals) -> MulticlassMetrics:
+        """ids: original-example id per augmented row; scores: per-row
+        class-score vectors; actuals: true label per row (consistent
+        within an id group)."""
+        from ..data.dataset import Dataset, HostDataset
+        from ..workflow.pipeline import PipelineResult
+
+        if isinstance(scores, PipelineResult):
+            scores = scores.get()
+        if isinstance(scores, Dataset):
+            scores = np.asarray(scores.numpy())
+        elif isinstance(scores, HostDataset):
+            scores = np.asarray(scores.items)
+        if isinstance(actuals, (Dataset, HostDataset)):
+            actuals = np.asarray(
+                actuals.numpy() if isinstance(actuals, Dataset) else actuals.items
+            )
+        else:
+            actuals = np.asarray(actuals)
+
+        groups = defaultdict(list)
+        labels = {}
+        for i, ex_id in enumerate(ids):
+            groups[ex_id].append(scores[i])
+            labels[ex_id] = int(actuals[i])
+        preds, trues = [], []
+        for ex_id, rows in groups.items():
+            stacked = np.stack(rows)
+            agg = stacked.mean(axis=0) if self.agg == "mean" else stacked.max(axis=0)
+            preds.append(int(np.argmax(agg)))
+            trues.append(labels[ex_id])
+        return MulticlassClassifierEvaluator(self.num_classes)(preds, trues)
+
+    def __call__(self, ids, scores, actuals) -> MulticlassMetrics:
+        return self.evaluate(ids, scores, actuals)

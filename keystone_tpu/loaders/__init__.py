@@ -1,2 +1,9 @@
 from .csv_loader import LabeledData, csv_data_loader
 from .cifar_loader import cifar_loader, synthetic_cifar
+from .image_loaders import imagenet_loader, load_images_from_tar, voc_loader
+from .text_loaders import (
+    TextLabeledData,
+    amazon_reviews_loader,
+    newsgroups_loader,
+    timit_loader,
+)

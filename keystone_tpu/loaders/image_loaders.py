@@ -1,0 +1,100 @@
+"""Archive-based image loaders.
+
+Reference: loaders/ImageLoaderUtils.scala:56-94 (tar streaming +
+ImageIO decode), ImageNetLoader.scala:11-39 (tar with
+class-subdirectory entries + labels map), VOCLoader.scala:15-53 (tar +
+multi-label csv join). Decoding is host-side (PIL), producing
+HostDatasets of LabeledImage / MultiLabeledImage; fixed-size stacks move
+to the device via `HostDataset.stack` when shapes allow.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import HostDataset
+from ..utils.images import LabeledImage, MultiLabeledImage
+
+
+def _decode_image(data: bytes) -> Optional[np.ndarray]:
+    try:
+        from PIL import Image as PILImage
+
+        img = PILImage.open(io.BytesIO(data)).convert("RGB")
+        return np.asarray(img, dtype=np.float32)
+    except Exception:
+        return None
+
+
+def load_images_from_tar(
+    path: str,
+    label_fn: Callable[[str], Optional[object]],
+    max_images: Optional[int] = None,
+) -> List[tuple]:
+    """Stream a tar archive, decode images, attach label_fn(entry_name)
+    (ImageLoaderUtils.scala:56-94). Returns [(name, image, label)]."""
+    out = []
+    with tarfile.open(path, "r:*") as tar:
+        for member in tar:
+            if not member.isfile():
+                continue
+            label = label_fn(member.name)
+            if label is None:
+                continue
+            f = tar.extractfile(member)
+            if f is None:
+                continue
+            img = _decode_image(f.read())
+            if img is None:
+                continue
+            out.append((member.name, img, label))
+            if max_images and len(out) >= max_images:
+                break
+    return out
+
+
+def imagenet_loader(
+    path: str, labels_map: Dict[str, int], max_images: Optional[int] = None
+) -> HostDataset:
+    """Tar of images named <synset>/<file> or <synset>_<file>
+    (ImageNetLoader.scala:11-39) → HostDataset[LabeledImage]."""
+
+    def label_fn(name: str):
+        base = os.path.basename(name)
+        synset = (
+            os.path.dirname(name)
+            or (base.split("_")[0] if "_" in base else None)
+        )
+        return labels_map.get(synset)
+
+    rows = load_images_from_tar(path, label_fn, max_images)
+    return HostDataset([LabeledImage(img, label) for _, img, label in rows])
+
+
+def voc_loader(
+    path: str, labels_csv: str, num_classes: int = 20,
+    max_images: Optional[int] = None,
+) -> HostDataset:
+    """VOC tar + filename→labels csv join (VOCLoader.scala:15-53) →
+    HostDataset[MultiLabeledImage]. csv rows: filename,class_id"""
+    labels: Dict[str, List[int]] = {}
+    with open(labels_csv) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            fname, cid = line.rsplit(",", 1)
+            labels.setdefault(os.path.basename(fname), []).append(int(cid))
+
+    def label_fn(name: str):
+        return labels.get(os.path.basename(name))
+
+    rows = load_images_from_tar(path, label_fn, max_images)
+    return HostDataset(
+        [MultiLabeledImage(img, lab, name) for name, img, lab in rows]
+    )

@@ -1,0 +1,81 @@
+"""Text and speech-feature loaders.
+
+Reference: NewsgroupsDataLoader.scala:9-52 (`wholeTextFiles` per class
+dir), AmazonReviewsDataLoader.scala:6-27 (JSON reviews via SparkSQL →
+(text, rating>3 label)), TimitFeaturesDataLoader.scala:15-70
+(pre-featurized csv + sparse label join).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import Dataset, HostDataset
+from .csv_loader import LabeledData
+
+
+@dataclass
+class TextLabeledData:
+    labels: HostDataset  # int class ids
+    data: HostDataset  # raw strings
+
+    @property
+    def class_names(self) -> Optional[List[str]]:
+        return getattr(self, "_class_names", None)
+
+
+def newsgroups_loader(path: str) -> TextLabeledData:
+    """Directory of per-class subdirectories of text files
+    (NewsgroupsDataLoader.scala:44-50)."""
+    classes = sorted(
+        d for d in os.listdir(path) if os.path.isdir(os.path.join(path, d))
+    )
+    texts, labels = [], []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(path, cls)
+        for fname in sorted(os.listdir(cdir)):
+            fpath = os.path.join(cdir, fname)
+            if os.path.isfile(fpath):
+                with open(fpath, errors="replace") as f:
+                    texts.append(f.read())
+                labels.append(label)
+    out = TextLabeledData(labels=HostDataset(labels), data=HostDataset(texts))
+    out._class_names = classes
+    return out
+
+
+def amazon_reviews_loader(path: str, threshold: float = 3.5) -> TextLabeledData:
+    """JSON-lines reviews with reviewText + overall rating
+    (AmazonReviewsDataLoader.scala:19-26); label = rating > threshold."""
+    texts, labels = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            texts.append(row.get("reviewText", ""))
+            labels.append(1 if float(row.get("overall", 0)) > threshold else 0)
+    return TextLabeledData(labels=HostDataset(labels), data=HostDataset(texts))
+
+
+def timit_loader(
+    features_path: str, labels_path: str, mesh=None
+) -> LabeledData:
+    """Pre-featurized TIMIT: features csv (row per frame) + sparse label
+    file 'index,label' (TimitFeaturesDataLoader.scala:44-69)."""
+    feats = np.loadtxt(features_path, delimiter=",", dtype=np.float32, ndmin=2)
+    labels = np.zeros(feats.shape[0], np.int32)
+    with open(labels_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            idx, lab = line.split(",")
+            labels[int(idx)] = int(lab)
+    return LabeledData.from_arrays(labels, feats, mesh=mesh)

@@ -11,3 +11,17 @@ from .core import (
     SymmetricRectifier,
     Windower,
 )
+from .fisher_vector import (
+    EncEvalGMMFisherVectorEstimator,
+    FisherVector,
+    GMMFisherVectorEstimator,
+    ScalaGMMFisherVectorEstimator,
+)
+from .sift import SIFTExtractor, SIFTExtractorInterface
+from .descriptors import DaisyExtractor, HogExtractor, LCSExtractor
+from .extractors import (
+    ImageExtractor,
+    LabelExtractor,
+    MultiLabelExtractor,
+    MultiLabeledImageExtractor,
+)

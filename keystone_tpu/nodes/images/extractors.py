@@ -1,0 +1,53 @@
+"""Field extractors from labeled images
+(reference nodes/images/LabeledImageExtractors.scala:7-32)."""
+
+from ...data.dataset import Dataset, HostDataset
+from ...workflow.pipeline import Transformer
+
+
+class ImageExtractor(Transformer):
+    """LabeledImage -> image."""
+
+    def apply(self, x):
+        return x.image
+
+    def apply_batch(self, data):
+        if isinstance(data, HostDataset):
+            return HostDataset([x.image for x in data.items])
+        return data  # tuple datasets handled upstream
+
+
+class LabelExtractor(Transformer):
+    """LabeledImage -> label."""
+
+    def apply(self, x):
+        return x.label
+
+    def apply_batch(self, data):
+        if isinstance(data, HostDataset):
+            return HostDataset([x.label for x in data.items])
+        return data
+
+
+class MultiLabelExtractor(Transformer):
+    """MultiLabeledImage -> labels list."""
+
+    def apply(self, x):
+        return list(x.labels)
+
+    def apply_batch(self, data):
+        if isinstance(data, HostDataset):
+            return HostDataset([list(x.labels) for x in data.items])
+        return data
+
+
+class MultiLabeledImageExtractor(Transformer):
+    """MultiLabeledImage -> image."""
+
+    def apply(self, x):
+        return x.image
+
+    def apply_batch(self, data):
+        if isinstance(data, HostDataset):
+            return HostDataset([x.image for x in data.items])
+        return data

@@ -1,0 +1,110 @@
+"""Fisher vector encoding.
+
+Reference: nodes/images/FisherVector.scala:14-94 (Sanchez et al. closed
+form over GMM posteriors :33-53) and the native enceval variant
+(external/FisherVector.scala:17-55, EncEval.cxx `calcAndGetFVs`). The
+C++ encoder is replaced by a jitted einsum program — per image:
+posteriors (nd×k GEMM), then first/second-order aggregated gradients.
+
+`GMMFisherVectorEstimator` keeps the reference's optimizable shape
+(FisherVector.scala:86-94 picks native iff k ≥ 32); here both routes are
+the same device kernel so optimize() just returns the default.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset, HostDataset
+from ...workflow.pipeline import Estimator, OptimizableEstimator, Transformer
+from ..learning.gmm import GaussianMixtureModel, GaussianMixtureModelEstimator, _log_gauss_posteriors
+
+
+@jax.jit
+def _fisher_vector(X, means, variances, weights):
+    """FV of one descriptor matrix X (nd, d) → (d, 2k) (matching the
+    reference's DenseMatrix[d, 2k] layout, FisherVector.scala:33-53)."""
+    with jax.default_matmul_precision("highest"):
+        nd = X.shape[0]
+        q = jnp.exp(_log_gauss_posteriors(X, means, variances, weights))  # (nd, k)
+        sigma = jnp.sqrt(variances)  # (k, d)
+        # normalized deviations per component: (nd, k, d) contracted via GEMMs
+        # S0_k = sum_i q_ik ; S1_k = sum_i q_ik x_i ; S2_k = sum_i q_ik x_i²
+        S0 = jnp.sum(q, axis=0)  # (k,)
+        S1 = q.T @ X  # (k, d)
+        S2 = q.T @ (X * X)  # (k, d)
+        w = weights[:, None]
+        # gradient wrt means:   (S1 - mu*S0) / (sigma * sqrt(w) * nd)
+        g_mu = (S1 - means * S0[:, None]) / (sigma * jnp.sqrt(w) * nd)
+        # gradient wrt sigmas:  (S2 - 2 mu S1 + (mu²-sigma²) S0) / (sigma² sqrt(2w) nd)
+        g_sig = (
+            S2 - 2.0 * means * S1 + (means**2 - variances) * S0[:, None]
+        ) / (variances * jnp.sqrt(2.0 * w) * nd)
+        return jnp.concatenate([g_mu.T, g_sig.T], axis=1)  # (d, 2k)
+
+
+class FisherVector(Transformer):
+    """Descriptor matrix (nd, d) → FV matrix (d, 2k)
+    (FisherVector.scala:14-62)."""
+
+    def __init__(self, gmm: GaussianMixtureModel):
+        self.gmm = gmm
+
+    def apply(self, x):
+        return _fisher_vector(
+            jnp.asarray(x, jnp.float32),
+            self.gmm.means,
+            self.gmm.variances,
+            self.gmm.weights,
+        )
+
+    def apply_batch(self, data):
+        if isinstance(data, HostDataset):
+            return HostDataset([np.asarray(self.apply(x)) for x in data.items])
+        g = self.gmm
+        return data.map_batches(
+            lambda X: jax.vmap(
+                lambda xi: _fisher_vector(xi, g.means, g.variances, g.weights)
+            )(X),
+            jitted=False,
+        )
+
+
+class ScalaGMMFisherVectorEstimator(Estimator):
+    """Fit a GMM on descriptor samples, return the FV encoder
+    (FisherVector.scala:69-84)."""
+
+    def __init__(self, k: int, num_iters: int = 30, seed: int = 0):
+        self.k = k
+        self.num_iters = num_iters
+        self.seed = seed
+
+    def fit(self, data) -> FisherVector:
+        gmm = GaussianMixtureModelEstimator(
+            self.k, num_iters=self.num_iters, seed=self.seed
+        ).fit(data)
+        return FisherVector(gmm)
+
+
+# the "native" route of the reference is the same device kernel here
+EncEvalGMMFisherVectorEstimator = ScalaGMMFisherVectorEstimator
+
+
+class GMMFisherVectorEstimator(OptimizableEstimator):
+    """Optimizable FV estimator (FisherVector.scala:86-94). Both the
+    reference's scala and enceval routes map to the same XLA kernel, so
+    the choice is degenerate — kept for API parity."""
+
+    def __init__(self, k: int, num_iters: int = 30, seed: int = 0):
+        self.k = k
+        self.num_iters = num_iters
+        self.seed = seed
+
+    @property
+    def default(self) -> Estimator:
+        return ScalaGMMFisherVectorEstimator(self.k, self.num_iters, self.seed)
+
+    def optimize(self, sample, num_per_shard) -> Estimator:
+        return self.default

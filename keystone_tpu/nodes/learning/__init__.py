@@ -10,3 +10,28 @@ from .cost_model import (
     LBFGSCostModel,
 )
 from .zca import ZCAWhitener, ZCAWhitenerEstimator
+from .pca import (
+    ApproximatePCAEstimator,
+    BatchPCATransformer,
+    ColumnPCAEstimator,
+    DistributedPCAEstimator,
+    PCAEstimator,
+    PCATransformer,
+)
+from .kmeans import KMeansModel, KMeansPlusPlusEstimator
+from .gmm import GaussianMixtureModel, GaussianMixtureModelEstimator
+from .classifiers import (
+    LinearDiscriminantAnalysis,
+    LogisticRegressionEstimator,
+    LogisticRegressionModel,
+    NaiveBayesEstimator,
+    NaiveBayesModel,
+)
+from .weighted_ls import BlockWeightedLeastSquaresEstimator, PerClassWeightedLeastSquares
+from .kernels import (
+    BlockKernelMatrix,
+    GaussianKernelGenerator,
+    GaussianKernelTransformer,
+    KernelBlockLinearMapper,
+    KernelRidgeRegression,
+)

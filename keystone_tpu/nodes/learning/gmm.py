@@ -1,0 +1,145 @@
+"""Diagonal-covariance Gaussian mixture model.
+
+Reference: nodes/learning/GaussianMixtureModel.scala:19-106 (transformer),
+GaussianMixtureModelEstimator.scala:25-203 (local EM, Sanchez et al.
+recipe with cluster/variance floors), and the native enceval variant
+(utils/external/EncEval.scala `computeGMM`). The C++/JNI EM is replaced
+by jitted batched einsum EM on device — the entire E and M steps are two
+GEMMs each, which is exactly what the MXU wants.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset, HostDataset
+from ...workflow.pipeline import Estimator, Transformer
+from .kmeans import kmeans_pp_init
+
+
+@jax.jit
+def _log_gauss_posteriors(X, means, variances, weights):
+    """log p(k|x) for diagonal Gaussians via the batched Mahalanobis GEMM
+    trick (GaussianMixtureModel.scala:49-80)."""
+    with jax.default_matmul_precision("highest"):
+        inv = 1.0 / variances  # (k, d)
+        # ||x-m||²_inv = x²·inv - 2x·(m·inv) + m²·inv
+        quad = (
+            (X * X) @ inv.T
+            - 2.0 * X @ (means * inv).T
+            + jnp.sum(means * means * inv, axis=1)
+        )
+        logdet = jnp.sum(jnp.log(variances), axis=1)
+        d = X.shape[1]
+        logp = (
+            jnp.log(weights)
+            - 0.5 * (quad + logdet + d * jnp.log(2.0 * jnp.pi))
+        )
+        return logp - jax.scipy.special.logsumexp(logp, axis=1, keepdims=True)
+
+
+class GaussianMixtureModel(Transformer):
+    """x → thresholded posterior assignment vector
+    (GaussianMixtureModel.scala:19-106)."""
+
+    def __init__(self, means, variances, weights, posterior_threshold: float = 1e-4):
+        self.means = jnp.asarray(means)  # (k, d)
+        self.variances = jnp.asarray(variances)  # (k, d)
+        self.weights = jnp.asarray(weights)  # (k,)
+        self.posterior_threshold = posterior_threshold
+
+    @property
+    def k(self) -> int:
+        return self.means.shape[0]
+
+    def posteriors(self, X):
+        return jnp.exp(
+            _log_gauss_posteriors(
+                jnp.atleast_2d(jnp.asarray(X)), self.means, self.variances, self.weights
+            )
+        )
+
+    def apply(self, x):
+        x2 = jnp.atleast_2d(jnp.asarray(x))
+        q = self.posteriors(x2)
+        q = jnp.where(q < self.posterior_threshold, 0.0, q)
+        return q[0] if jnp.ndim(x) == 1 else q
+
+    @staticmethod
+    def load_csv(means_path, variances_path, weights_path) -> "GaussianMixtureModel":
+        """Sideband CSV loading (GaussianMixtureModel.scala:97-105)."""
+        return GaussianMixtureModel(
+            np.loadtxt(means_path, delimiter=",", ndmin=2),
+            np.loadtxt(variances_path, delimiter=",", ndmin=2),
+            np.loadtxt(weights_path, delimiter=","),
+        )
+
+
+@partial(jax.jit, static_argnames=("num_iters",))
+def _em(X, means0, variances0, weights0, num_iters: int, min_variance):
+    with jax.default_matmul_precision("highest"):
+        n = X.shape[0]
+
+        def step(carry, _):
+            means, variances, weights = carry
+            q = jnp.exp(_log_gauss_posteriors(X, means, variances, weights))  # (n, k)
+            nk = jnp.sum(q, axis=0)  # (k,)
+            safe_nk = jnp.maximum(nk, 1e-8)
+            new_means = (q.T @ X) / safe_nk[:, None]
+            ex2 = (q.T @ (X * X)) / safe_nk[:, None]
+            new_vars = jnp.maximum(ex2 - new_means**2, min_variance)
+            new_weights = jnp.maximum(nk / n, 1e-10)
+            new_weights = new_weights / jnp.sum(new_weights)
+            return (new_means, new_vars, new_weights), None
+
+        (means, variances, weights), _ = jax.lax.scan(
+            step, (means0, variances0, weights0), None, length=num_iters
+        )
+        return means, variances, weights
+
+
+class GaussianMixtureModelEstimator(Estimator):
+    """Local EM with k-means++ (or random) init and variance floors
+    (GaussianMixtureModelEstimator.scala:25-203)."""
+
+    def __init__(
+        self,
+        k: int,
+        num_iters: int = 30,
+        init: str = "kmeans++",
+        min_variance_factor: float = 0.01,
+        seed: int = 0,
+        max_rows: int = 200_000,
+    ):
+        self.k = k
+        self.num_iters = num_iters
+        if init not in ("kmeans++", "random"):
+            raise ValueError("init must be 'kmeans++' or 'random'")
+        self.init = init
+        self.min_variance_factor = min_variance_factor
+        self.seed = seed
+        self.max_rows = max_rows
+
+    def fit(self, data) -> GaussianMixtureModel:
+        from .pca import _collect_rows
+
+        X = _collect_rows(data, self.max_rows)
+        rng = np.random.default_rng(self.seed)
+        if self.init == "kmeans++":
+            means0 = kmeans_pp_init(X, self.k, rng)
+        else:
+            means0 = X[rng.choice(X.shape[0], self.k, replace=False)]
+        global_var = X.var(axis=0) + 1e-6
+        variances0 = np.tile(global_var, (self.k, 1)).astype(np.float32)
+        weights0 = np.full((self.k,), 1.0 / self.k, np.float32)
+        # variance floor relative to the global variance (Sanchez et al.)
+        min_var = jnp.asarray(self.min_variance_factor * global_var, jnp.float32)
+        means, variances, weights = _em(
+            jnp.asarray(X), jnp.asarray(means0), jnp.asarray(variances0),
+            jnp.asarray(weights0), self.num_iters, min_var,
+        )
+        return GaussianMixtureModel(means, variances, weights)

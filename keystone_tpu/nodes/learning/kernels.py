@@ -1,0 +1,182 @@
+"""Kernel methods: RBF kernel blocks, kernel ridge regression via
+Gauss-Seidel block coordinate descent, and blocked kernel model apply.
+
+Reference: nodes/learning/KernelGenerator.scala:18-206 (RBF via the
+dot-product trick, broadcast column block), KernelMatrix.scala:17-90
+(lazy column-block view with optional caching),
+KernelRidgeRegression.scala:37-275 (arXiv:1602.05310 — per block:
+kernel col-block gen → treeReduce residual → local (B×B) solve →
+distributed model update; lineage truncation via checkpoint every 25
+blocks), KernelBlockLinearMapper.scala:28-90.
+
+TPU-native: the n×n kernel never materializes. One jitted `krr_step`
+(kernel block GEMM + replicated solve + residual update) is compiled
+once and reused for every block and epoch — the host loop only permutes
+block order. The reference's RDD checkpointing maps to the natural
+materialization of each step's outputs (no lineage to truncate).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import Estimator, LabelEstimator, Transformer
+
+
+@jax.jit
+def _rbf_block(X, Xb, gamma):
+    """K(X, Xb) = exp(-γ‖x−y‖²) via the dot-product trick
+    (KernelGenerator.scala:18-206)."""
+    with jax.default_matmul_precision("highest"):
+        d2 = (
+            jnp.sum(X * X, axis=1, keepdims=True)
+            - 2.0 * X @ Xb.T
+            + jnp.sum(Xb * Xb, axis=1)
+        )
+        return jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+
+
+class GaussianKernelTransformer(Transformer):
+    """x → K(x, anchors) (KernelGenerator.scala)."""
+
+    def __init__(self, anchors, gamma: float):
+        self.anchors = jnp.asarray(anchors)
+        self.gamma = gamma
+
+    def apply(self, x):
+        return _rbf_block(
+            jnp.atleast_2d(jnp.asarray(x)), self.anchors, jnp.float32(self.gamma)
+        )[0]
+
+    def apply_batch(self, data: Dataset):
+        return data.map_batches(
+            lambda X: _rbf_block(X, self.anchors, jnp.float32(self.gamma)),
+            jitted=False,
+        )
+
+
+class GaussianKernelGenerator(Estimator):
+    def __init__(self, gamma: float):
+        self.gamma = gamma
+
+    def fit(self, data: Dataset) -> GaussianKernelTransformer:
+        return GaussianKernelTransformer(np.asarray(data.numpy()), self.gamma)
+
+
+class BlockKernelMatrix:
+    """Lazy column-block view of K(X, X) with optional block caching
+    (KernelMatrix.scala:17-90)."""
+
+    def __init__(self, X, gamma: float, cache_blocks: bool = False):
+        self.X = X  # (n_pad, d) sharded
+        self.gamma = jnp.float32(gamma)
+        self.cache_blocks = cache_blocks
+        self._cache = {}
+
+    def block(self, idx, block_size: int):
+        key = (int(idx), block_size)
+        if key in self._cache:
+            return self._cache[key]
+        Xb = jax.lax.dynamic_slice_in_dim(self.X, int(idx) * block_size, block_size, 0)
+        Kb = _rbf_block(self.X, Xb, self.gamma)
+        if self.cache_blocks:
+            self._cache[key] = Kb
+        return Kb
+
+
+@jax.jit
+def _krr_step(X, Y, mask, alpha, KA, lam, gamma, block_ids):
+    """One Gauss-Seidel block update of dual KRR (K + λI)α = Y.
+
+    KA tracks K @ alpha. For block b: solve
+      (K_bb + λI + eps) Δ = (Y_b − KA_b − λ α_b)
+    then α_b += Δ, KA += K[:, b] Δ.
+    """
+    with jax.default_matmul_precision("highest"):
+        B = block_ids.shape[0]
+        Xb = jnp.take(X, block_ids, axis=0)
+        Kb = _rbf_block(X, Xb, gamma) * mask[:, None]  # (n, B) masked rows
+        Kbb = jnp.take(Kb, block_ids, axis=0)  # (B, B)
+        alpha_b = jnp.take(alpha, block_ids, axis=0)
+        resid_b = (
+            jnp.take(Y, block_ids, axis=0)
+            - jnp.take(KA, block_ids, axis=0)
+            - lam * alpha_b
+        )
+        delta = jax.scipy.linalg.solve(
+            Kbb + lam * jnp.eye(B, dtype=X.dtype), resid_b, assume_a="pos"
+        )
+        alpha = alpha.at[block_ids].add(delta)
+        KA = KA + Kb @ delta
+        return alpha, KA
+
+
+class KernelBlockLinearMapper(Transformer):
+    """Apply a kernel model to test data block-by-block with incremental
+    accumulation (KernelBlockLinearMapper.scala:28-90)."""
+
+    def __init__(self, train_X, alpha, gamma: float, block_size: int = 4096):
+        self.train_X = jnp.asarray(train_X)
+        self.alpha = jnp.asarray(alpha)
+        self.gamma = gamma
+        self.block_size = block_size
+
+    def apply(self, x):
+        K = _rbf_block(
+            jnp.atleast_2d(jnp.asarray(x)), self.train_X, jnp.float32(self.gamma)
+        )
+        return (K @ self.alpha)[0]
+
+    def apply_batch(self, data: Dataset):
+        X = data.array
+        n_train = self.train_X.shape[0]
+        out = jnp.zeros((X.shape[0], self.alpha.shape[1]), X.dtype)
+        for start in range(0, n_train, self.block_size):
+            end = min(start + self.block_size, n_train)
+            Kb = _rbf_block(X, self.train_X[start:end], jnp.float32(self.gamma))
+            out = out + Kb @ self.alpha[start:end]
+        return data.with_data(out)
+
+
+class KernelRidgeRegression(LabelEstimator):
+    """Dual KRR via Gauss-Seidel BCD over permuted sample blocks
+    (KernelRidgeRegression.scala:37-275)."""
+
+    def __init__(self, gamma: float, lam: float, block_size: int = 2048,
+                 num_epochs: int = 1, seed: int = 0):
+        self.gamma = gamma
+        self.lam = lam
+        self.block_size = block_size
+        self.num_epochs = num_epochs
+        self.seed = seed
+        self.weight = 3 * num_epochs + 1
+
+    def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
+        X = data.array
+        Y = labels.array * data.mask[:, None]
+        n_pad = X.shape[0]
+        mask = data.mask.astype(X.dtype)
+        B = min(self.block_size, n_pad)
+        # permutable blocks over VALID rows only; padded rows keep alpha=0
+        rng = np.random.default_rng(self.seed)
+        n_blocks = -(-data.count // B)
+        alpha = jnp.zeros((n_pad, Y.shape[1]), X.dtype)
+        KA = jnp.zeros_like(alpha)
+        lam = jnp.asarray(self.lam, X.dtype)
+        gamma = jnp.asarray(self.gamma, X.dtype)
+        for epoch in range(self.num_epochs):
+            perm = rng.permutation(data.count)
+            pad = (-len(perm)) % (n_blocks * B)
+            ids = np.concatenate([perm, perm[: pad]]) if pad else perm
+            for b in range(n_blocks):
+                block_ids = jnp.asarray(ids[b * B : (b + 1) * B], jnp.int32)
+                alpha, KA = _krr_step(X, Y, mask, alpha, KA, lam, gamma, block_ids)
+        return KernelBlockLinearMapper(
+            np.asarray(X), alpha, self.gamma, self.block_size
+        )

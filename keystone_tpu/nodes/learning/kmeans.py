@@ -1,0 +1,105 @@
+"""K-means++ (reference nodes/learning/KMeansPlusPlus.scala:16-181).
+
+The reference runs k-means++ init + Lloyd's locally on collected data
+with a GEMM distance trick; here Lloyd's iterations are one jitted
+`lax.scan` (assignment einsum + segment-sum centroid update) and the
+batch assignment transformer is the same GEMM distance trick on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.dataset import Dataset, HostDataset
+from ...workflow.pipeline import Estimator, Transformer
+
+
+@jax.jit
+def _assign(X, centers):
+    """argmin_c ||x - c||² via the GEMM trick (KMeansPlusPlus.scala:140+)."""
+    with jax.default_matmul_precision("highest"):
+        d2 = (
+            jnp.sum(X * X, axis=1, keepdims=True)
+            - 2.0 * X @ centers.T
+            + jnp.sum(centers * centers, axis=1)
+        )
+        return jnp.argmin(d2, axis=1)
+
+
+class KMeansModel(Transformer):
+    """x → one-hot cluster assignment (the reference emits indicator
+    vectors for downstream featurization)."""
+
+    def __init__(self, centers):
+        self.centers = jnp.asarray(centers)
+
+    def apply(self, x):
+        x = jnp.atleast_2d(jnp.asarray(x))
+        idx = _assign(x, self.centers)
+        out = jax.nn.one_hot(idx, self.centers.shape[0])
+        return out[0] if out.shape[0] == 1 else out
+
+    def assign(self, data: Dataset):
+        """Cluster indices for a dataset."""
+        return data.map_batches(lambda X: _assign(X, self.centers), jitted=False)
+
+    def apply_batch(self, data: Dataset):
+        k = self.centers.shape[0]
+        return data.map_batches(
+            lambda X: jax.nn.one_hot(_assign(X, self.centers), k), jitted=False
+        )
+
+
+@partial(jax.jit, static_argnames=("num_iters",))
+def _lloyds(X, centers0, num_iters: int):
+    with jax.default_matmul_precision("highest"):
+        k = centers0.shape[0]
+
+        def step(centers, _):
+            idx = _assign(X, centers)
+            onehot = jax.nn.one_hot(idx, k, dtype=X.dtype)  # (n, k)
+            counts = jnp.sum(onehot, axis=0)  # (k,)
+            sums = onehot.T @ X  # (k, d)
+            new = jnp.where(
+                counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+            )
+            return new, None
+
+        centers, _ = jax.lax.scan(step, centers0, None, length=num_iters)
+        return centers
+
+
+def kmeans_pp_init(X: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
+    """Host-side k-means++ seeding (KMeansPlusPlus.scala:16-80)."""
+    n = X.shape[0]
+    centers = np.empty((k, X.shape[1]), X.dtype)
+    centers[0] = X[rng.integers(n)]
+    d2 = np.sum((X - centers[0]) ** 2, axis=1)
+    for i in range(1, k):
+        probs = d2 / max(d2.sum(), 1e-12)
+        centers[i] = X[rng.choice(n, p=probs)]
+        d2 = np.minimum(d2, np.sum((X - centers[i]) ** 2, axis=1))
+    return centers
+
+
+class KMeansPlusPlusEstimator(Estimator):
+    def __init__(self, num_means: int, num_iters: int = 20, seed: int = 0):
+        self.num_means = num_means
+        self.num_iters = num_iters
+        self.seed = seed
+
+    def fit(self, data) -> KMeansModel:
+        if isinstance(data, HostDataset):
+            X = np.stack([np.asarray(x) for x in data.items]).astype(np.float32)
+        elif isinstance(data, Dataset):
+            X = np.asarray(data.numpy(), np.float32)
+        else:
+            X = np.asarray(data, np.float32)
+        rng = np.random.default_rng(self.seed)
+        centers0 = kmeans_pp_init(X, self.num_means, rng)
+        centers = _lloyds(jnp.asarray(X), jnp.asarray(centers0), self.num_iters)
+        return KMeansModel(centers)

@@ -1,0 +1,14 @@
+from .text import (
+    HashingTF,
+    LowerCase,
+    NGram,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    TermFrequency,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+)
+from .indexers import BackoffIndexer, NaiveBitPackIndexer, NGramIndexer
+from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel

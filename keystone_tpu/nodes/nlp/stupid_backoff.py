@@ -1,0 +1,76 @@
+"""Stupid-backoff language model (Brants et al. 2007).
+
+Reference: nodes/nlp/StupidBackoff.scala:14-182. The reference
+partitions n-grams by their first two words (`InitialBigramPartitioner`,
+:25-59) so backoff lookups stay partition-local on the cluster; here
+scoring state is a host dict (the model is a lookup table — TPU has no
+role until scores become features).
+
+S(w | w_{i-n+1..i-1}) = count(ngram)/count(context) if seen,
+else α · S(w | shorter context), bottoming out at unigram frequency.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+from ...data.dataset import HostDataset
+from ...workflow.pipeline import Estimator, Transformer
+
+ALPHA = 0.4
+
+
+class StupidBackoffModel(Transformer):
+    def __init__(self, ngram_counts: Dict[tuple, int], unigram_counts: Dict[str, int],
+                 total_tokens: int, alpha: float = ALPHA):
+        self.ngram_counts = ngram_counts
+        self.unigram_counts = unigram_counts
+        self.total_tokens = max(total_tokens, 1)
+        self.alpha = alpha
+
+    def score(self, ngram: Sequence[str]) -> float:
+        ngram = tuple(ngram)
+        if len(ngram) == 1:
+            return self.unigram_counts.get(ngram[0], 0) / self.total_tokens
+        count = self.ngram_counts.get(ngram, 0)
+        if count > 0:
+            context = ngram[:-1]
+            ctx_count = (
+                self.ngram_counts.get(context, 0)
+                if len(context) > 1
+                else self.unigram_counts.get(context[0], 0)
+            )
+            if ctx_count > 0:
+                return count / ctx_count
+        return self.alpha * self.score(ngram[1:])
+
+    def apply(self, ngram):
+        return self.score(ngram)
+
+    def apply_batch(self, data):
+        return HostDataset([self.score(x) for x in data.items])
+
+
+class StupidBackoffEstimator(Estimator):
+    """Fit from a dataset of (ngram tuple, count) pair lists or Counters
+    (StupidBackoff.scala:61-182)."""
+
+    def __init__(self, unigram_counts: Dict[str, int] = None, alpha: float = ALPHA):
+        self.unigram_counts = unigram_counts
+        self.alpha = alpha
+
+    def fit(self, data) -> StupidBackoffModel:
+        ngram_counts: Counter = Counter()
+        for item in data.items:
+            pairs = item.items() if isinstance(item, (dict, Counter)) else item
+            for ng, c in pairs:
+                ngram_counts[tuple(ng)] += c
+        unigrams = self.unigram_counts
+        if unigrams is None:
+            unigrams = Counter()
+            for ng, c in ngram_counts.items():
+                if len(ng) == 1:
+                    unigrams[ng[0]] += c
+        total = sum(unigrams.values())
+        return StupidBackoffModel(dict(ngram_counts), dict(unigrams), total, self.alpha)

@@ -1,0 +1,175 @@
+"""Text processing nodes (reference nodes/nlp/).
+
+These are host-side by design — strings never belong on a TPU. The dense
+boundary is downstream: hashing / top-K vocabulary selection produce
+fixed-width vectors or host CSR that `Densify` moves to the device
+(exactly the reference's JVM-side tokenization → Breeze SparseVector →
+solver pipeline).
+
+- `Tokenizer`, `Trim`, `LowerCase` — StringUtils.scala:13-29
+- `NGramsFeaturizer` — ngrams.scala:20-98
+- `NGram`, `NGramsCounts` — ngrams.scala:100-185
+- `HashingTF` — HashingTF.scala:15-31
+- `NGramsHashingTF` — NGramsHashingTF.scala:25-118 (rolling-hash
+  equivalence of NGrams ∘ HashingTF)
+- `WordFrequencyEncoder` — WordFrequencyEncoder.scala:7-62
+- `TermFrequency` — nodes/stats/TermFrequency.scala:19
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...data.dataset import HostDataset
+from ...workflow.pipeline import Estimator, Transformer
+
+
+class Trim(Transformer):
+    def apply(self, s: str) -> str:
+        return s.strip()
+
+
+class LowerCase(Transformer):
+    def apply(self, s: str) -> str:
+        return s.lower()
+
+
+class Tokenizer(Transformer):
+    """Regex-split tokenizer (StringUtils.scala `Tokenizer`)."""
+
+    def __init__(self, pattern: str = "[\\s]+"):
+        self.pattern = re.compile(pattern)
+
+    def apply(self, s: str) -> List[str]:
+        return [t for t in self.pattern.split(s) if t]
+
+
+class NGram:
+    """Hash/equals-correct n-gram key (ngrams.scala:100-130)."""
+
+    __slots__ = ("words",)
+
+    def __init__(self, words: Sequence[str]):
+        self.words = tuple(words)
+
+    def __hash__(self) -> int:
+        return hash(self.words)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, NGram) and self.words == other.words
+
+    def __repr__(self) -> str:
+        return "[" + ",".join(self.words) + "]"
+
+
+class NGramsFeaturizer(Transformer):
+    """All n-grams of orders [min..max] per token list (ngrams.scala:20-98)."""
+
+    def __init__(self, orders: Sequence[int]):
+        orders = sorted(orders)
+        if not orders or orders[0] < 1:
+            raise ValueError("ngram orders must be >= 1")
+        self.orders = orders
+
+    def apply(self, tokens: List[str]) -> List[Tuple[str, ...]]:
+        out = []
+        for n in self.orders:
+            for i in range(len(tokens) - n + 1):
+                out.append(tuple(tokens[i : i + n]))
+        return out
+
+
+class NGramsCounts(Transformer):
+    """Count n-grams over the whole corpus (ngrams.scala:132-185).
+
+    mode 'default': global reduce (≈ reduceByKey + sort);
+    mode 'no-add': per-item counts kept separate."""
+
+    def __init__(self, mode: str = "default"):
+        if mode not in ("default", "no-add"):
+            raise ValueError("mode must be 'default' or 'no-add'")
+        self.mode = mode
+
+    def apply(self, ngrams):
+        return Counter(ngrams)
+
+    def apply_batch(self, data):
+        if self.mode == "no-add":
+            return HostDataset([Counter(x) for x in data.items])
+        total: Counter = Counter()
+        for item in data.items:
+            total.update(item)
+        pairs = sorted(total.items(), key=lambda kv: -kv[1])
+        return HostDataset([pairs])
+
+
+class HashingTF(Transformer):
+    """Feature hashing into a fixed-width count vector (HashingTF.scala:15-31)."""
+
+    def __init__(self, num_features: int):
+        self.num_features = num_features
+
+    def _hash(self, term) -> int:
+        return hash(term) % self.num_features
+
+    def apply(self, terms) -> np.ndarray:
+        v = np.zeros(self.num_features, np.float32)
+        for t in terms:
+            v[self._hash(t)] += 1.0
+        return v
+
+
+class NGramsHashingTF(Transformer):
+    """NGrams ∘ HashingTF fused with a rolling hash
+    (NGramsHashingTF.scala:25-118)."""
+
+    def __init__(self, orders: Sequence[int], num_features: int):
+        self.featurizer = NGramsFeaturizer(orders)
+        self.num_features = num_features
+
+    def apply(self, tokens) -> np.ndarray:
+        v = np.zeros(self.num_features, np.float32)
+        for ng in self.featurizer.apply(tokens):
+            v[hash(ng) % self.num_features] += 1.0
+        return v
+
+
+class TermFrequency(Transformer):
+    """terms → (term, fn(count)) pairs (nodes/stats/TermFrequency.scala:19).
+    fn defaults to identity; pass e.g. sqrt for sublinear tf."""
+
+    def __init__(self, fn: Optional[Callable[[float], float]] = None):
+        self.fn = fn or (lambda x: x)
+
+    def apply(self, terms):
+        return [(t, self.fn(c)) for t, c in Counter(terms).items()]
+
+
+class _WordFrequencyTransformer(Transformer):
+    def __init__(self, vocab: dict):
+        self.vocab = vocab  # word -> index (frequency-sorted); OOV -> -1
+
+    def apply(self, tokens):
+        return [self.vocab.get(t, -1) for t in tokens]
+
+
+class WordFrequencyEncoder(Estimator):
+    """Fit a frequency-sorted vocabulary; transformer maps word → rank
+    index, OOV → -1 (WordFrequencyEncoder.scala:7-62)."""
+
+    def fit(self, data) -> _WordFrequencyTransformer:
+        counts: Counter = Counter()
+        for tokens in data.items:
+            counts.update(tokens)
+        vocab = {
+            w: i for i, (w, _) in enumerate(
+                sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+            )
+        }
+        t = _WordFrequencyTransformer(vocab)
+        t.word_counts = dict(counts)
+        return t

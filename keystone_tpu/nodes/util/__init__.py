@@ -10,3 +10,10 @@ from .basic import (
     TopKClassifier,
     VectorCombiner,
 )
+from .basic import Densify, Sparsify
+from .sparse_features import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseFeatureVectorizer,
+)
+from .fusion import FusedBatchTransformer

@@ -1,0 +1,86 @@
+"""Sparse feature vocabulary selection and vectorization.
+
+Reference: nodes/util/CommonSparseFeatures.scala:19-64 (top-K via
+per-partition heaps + treeReduce merge), AllSparseFeatures.scala:14-27,
+SparseFeatureVectorizer. Host-side by design: the output is a host CSR
+`SparseDataset` (or, for `CommonSparseFeatures` with modest K, dense
+enough to densify wholesale onto the device — the intended TPU path).
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import Counter
+from typing import List, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+from ...data.dataset import HostDataset
+from ...data.sparse import SparseDataset
+from ...workflow.pipeline import Estimator, Transformer
+
+
+class SparseFeatureVectorizer(Transformer):
+    """(feature, value) pairs → CSR rows over a fixed vocabulary."""
+
+    def __init__(self, vocab: dict):
+        self.vocab = vocab
+
+    def apply(self, pairs):
+        # duplicates sum, matching the batch path's coo->csr semantics
+        acc: dict = {}
+        for f, val in pairs:
+            j = self.vocab.get(f)
+            if j is not None:
+                acc[j] = acc.get(j, 0.0) + val
+        v = sp.dok_matrix((1, len(self.vocab)), dtype=np.float32)
+        for j, val in acc.items():
+            v[0, j] = val
+        return v.tocsr()
+
+    def apply_batch(self, data):
+        rows, cols, vals = [], [], []
+        for i, pairs in enumerate(data.items):
+            for f, val in pairs:
+                j = self.vocab.get(f)
+                if j is not None:
+                    rows.append(i)
+                    cols.append(j)
+                    vals.append(val)
+        mat = sp.csr_matrix(
+            (vals, (rows, cols)), shape=(len(data.items), len(self.vocab)),
+            dtype=np.float32,
+        )
+        return SparseDataset(mat)
+
+
+class CommonSparseFeatures(Estimator):
+    """Keep the K most frequent features (CommonSparseFeatures.scala:19-64;
+    the heap+merge becomes one host Counter pass)."""
+
+    def __init__(self, num_features: int):
+        self.num_features = num_features
+
+    def fit(self, data) -> SparseFeatureVectorizer:
+        counts: Counter = Counter()
+        for pairs in data.items:
+            for f, _ in pairs:
+                counts[f] += 1
+        top = heapq.nlargest(
+            self.num_features, counts.items(), key=lambda kv: (kv[1], kv[0])
+        )
+        vocab = {f: i for i, f in enumerate(sorted(f for f, _ in top))}
+        return SparseFeatureVectorizer(vocab)
+
+
+class AllSparseFeatures(Estimator):
+    """Vocabulary of every observed feature (AllSparseFeatures.scala:14-27)."""
+
+    def fit(self, data) -> SparseFeatureVectorizer:
+        seen = set()
+        for pairs in data.items:
+            for f, _ in pairs:
+                seen.add(f)
+        vocab = {f: i for i, f in enumerate(sorted(seen))}
+        return SparseFeatureVectorizer(vocab)

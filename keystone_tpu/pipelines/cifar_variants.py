@@ -1,0 +1,235 @@
+"""Remaining CIFAR example apps.
+
+- LinearPixels (reference pipelines/images/cifar/LinearPixels.scala):
+  GrayScaler→ImageVectorizer→LinearMapEstimator→MaxClassifier.
+- RandomCifar (RandomCifar.scala): random (unwhitened) conv filters.
+- RandomPatchCifarKernel (RandomPatchCifarKernel.scala:62-75): the
+  RandomPatchCifar featurization with KernelRidgeRegression as solver.
+- RandomPatchCifarAugmented (RandomPatchCifarAugmented.scala): random
+  patch + flip augmentation at train, center/corner patches at test,
+  AugmentedExamplesEvaluator.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..evaluation import AugmentedExamplesEvaluator, MulticlassClassifierEvaluator
+from ..loaders.cifar_loader import cifar_loader, synthetic_cifar
+from ..nodes.images.core import (
+    CenterCornerPatcher,
+    Convolver,
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+    Pooler,
+    RandomPatcher,
+    SymmetricRectifier,
+)
+from ..nodes.learning import KernelRidgeRegression, LinearMapEstimator
+from ..nodes.stats import StandardScaler
+from ..nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
+from ..nodes.util.fusion import FusedBatchTransformer
+from ..workflow import Pipeline
+from .random_patch_cifar import RandomPatchCifarConfig, learn_filters
+
+
+def _load(config):
+    if getattr(config, "train_path", None):
+        return cifar_loader(config.train_path), cifar_loader(
+            config.test_path or config.train_path
+        )
+    return synthetic_cifar(config.synth_train, config.synth_test, config.num_classes,
+                           config.seed)
+
+
+@dataclass
+class LinearPixelsConfig:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    lam: float = 1.0
+    num_classes: int = 10
+    synth_train: int = 1000
+    synth_test: int = 250
+    seed: int = 0
+
+
+def run_linear_pixels(config: LinearPixelsConfig):
+    train, test = _load(config)
+    t0 = time.perf_counter()
+    featurizer = (
+        FusedBatchTransformer(
+            [PixelScaler(), GrayScaler(), ImageVectorizer()], microbatch=4096
+        ).to_pipeline()
+        >> Cacher("pixels")
+    )
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(train.labels).get()
+    predictor = featurizer.and_then(
+        LinearMapEstimator(config.lam), train.data, labels
+    ) >> MaxClassifier()
+    evaluator = MulticlassClassifierEvaluator(config.num_classes)
+    train_eval = evaluator(predictor(train.data), train.labels)
+    test_eval = evaluator(predictor(test.data), test.labels)
+    return {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "test_accuracy": test_eval.accuracy,
+        "seconds": time.perf_counter() - t0,
+    }
+
+
+@dataclass
+class RandomCifarConfig(RandomPatchCifarConfig):
+    pass
+
+
+def run_random_cifar(config: RandomCifarConfig):
+    """Random Gaussian filters, no whitening (RandomCifar.scala)."""
+    train, test = _load(config)
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(config.seed)
+    d = config.patch_size * config.patch_size * 3
+    filters = rng.normal(size=(config.num_filters, d)).astype(np.float32)
+    filters /= np.linalg.norm(filters, axis=1, keepdims=True)
+    h, w, c = train.data.array.shape[1:]
+    featurizer = (
+        FusedBatchTransformer(
+            [
+                PixelScaler(),
+                Convolver(filters, h, w, c, whitener=None, normalize_patches=True),
+                SymmetricRectifier(alpha=config.alpha),
+                Pooler(config.pool_stride, config.pool_size, pool_fn="sum"),
+                ImageVectorizer(),
+            ],
+            microbatch=config.microbatch,
+        ).to_pipeline()
+        >> Cacher("features")
+    )
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(train.labels).get()
+    from ..nodes.learning import BlockLeastSquaresEstimator
+
+    predictor = (
+        featurizer.and_then(StandardScaler(), train.data)
+        .and_then(
+            BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+            train.data, labels,
+        )
+        >> MaxClassifier()
+    )
+    evaluator = MulticlassClassifierEvaluator(config.num_classes)
+    test_eval = evaluator(predictor(test.data), test.labels)
+    return {
+        "test_error": test_eval.error,
+        "test_accuracy": test_eval.accuracy,
+        "seconds": time.perf_counter() - t0,
+    }
+
+
+@dataclass
+class RandomPatchCifarKernelConfig(RandomPatchCifarConfig):
+    gamma: float = 2e-3
+    kernel_block: int = 2048
+    kernel_epochs: int = 1
+
+
+def run_random_patch_cifar_kernel(config: RandomPatchCifarKernelConfig):
+    """RandomPatchCifar featurization + kernel ridge regression solver
+    (RandomPatchCifarKernel.scala:62-75)."""
+    train, test = _load(config)
+    t0 = time.perf_counter()
+    filters, whitener = learn_filters(train.data, config)
+    h, w, c = train.data.array.shape[1:]
+    featurizer = (
+        FusedBatchTransformer(
+            [
+                PixelScaler(),
+                Convolver(filters, h, w, c, whitener=whitener),
+                SymmetricRectifier(alpha=config.alpha),
+                Pooler(config.pool_stride, config.pool_size, pool_fn="sum"),
+                ImageVectorizer(),
+            ],
+            microbatch=config.microbatch,
+        ).to_pipeline()
+        >> Cacher("features")
+    )
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(train.labels).get()
+    predictor = (
+        featurizer.and_then(StandardScaler(), train.data)
+        .and_then(
+            KernelRidgeRegression(
+                config.gamma, config.lam, config.kernel_block, config.kernel_epochs
+            ),
+            train.data, labels,
+        )
+        >> MaxClassifier()
+    )
+    evaluator = MulticlassClassifierEvaluator(config.num_classes)
+    test_eval = evaluator(predictor(test.data), test.labels)
+    return {
+        "test_error": test_eval.error,
+        "test_accuracy": test_eval.accuracy,
+        "seconds": time.perf_counter() - t0,
+    }
+
+
+@dataclass
+class RandomPatchCifarAugmentedConfig(RandomPatchCifarConfig):
+    patches_per_image: int = 4
+    aug_patch: int = 24
+
+
+def run_random_patch_cifar_augmented(config: RandomPatchCifarAugmentedConfig):
+    """Train on random crops (+id-tracked center/corner crops at test),
+    average augmented scores per original image
+    (RandomPatchCifarAugmented.scala)."""
+    train, test = _load(config)
+    t0 = time.perf_counter()
+    ap = config.aug_patch
+
+    # augment train: random crops; labels repeat per crop
+    patcher = RandomPatcher(config.patches_per_image, ap, ap, seed=config.seed)
+    aug_train = patcher.apply_batch(train.data)
+    aug_labels = np.repeat(np.asarray(train.labels.numpy()), config.patches_per_image)
+
+    filters, whitener = learn_filters(aug_train, config)
+    h = w = ap
+    featurizer = (
+        FusedBatchTransformer(
+            [
+                PixelScaler(),
+                Convolver(filters, h, w, 3, whitener=whitener),
+                SymmetricRectifier(alpha=config.alpha),
+                Pooler(max(ap // 2 - 1, 1), ap // 2, pool_fn="sum"),
+                ImageVectorizer(),
+            ],
+            microbatch=config.microbatch,
+        ).to_pipeline()
+        >> Cacher("features")
+    )
+    label_ind = ClassLabelIndicatorsFromInt(config.num_classes)(
+        Dataset(aug_labels.astype(np.int32))
+    ).get()
+    from ..nodes.learning import BlockLeastSquaresEstimator
+
+    scorer = featurizer.and_then(StandardScaler(), aug_train).and_then(
+        BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+        aug_train, label_ind,
+    )
+    # test: center+corner crops, ids track the source image
+    cc = CenterCornerPatcher(ap, ap, with_flips=False)
+    aug_test = cc.apply_batch(test.data)
+    n_aug = 5
+    ids = np.repeat(np.arange(test.data.count), n_aug)
+    actuals = np.repeat(np.asarray(test.labels.numpy()), n_aug)
+    scores = scorer(aug_test).get()
+    m = AugmentedExamplesEvaluator(config.num_classes)(ids, scores, actuals)
+    return {
+        "test_error": m.error,
+        "test_accuracy": m.accuracy,
+        "seconds": time.perf_counter() - t0,
+    }

@@ -1,0 +1,123 @@
+"""TimitPipeline (reference pipelines/speech/TimitPipeline.scala:1-148):
+pre-featurized TIMIT frames → CosineRandomFeatures (Gaussian/Cauchy) →
+BlockLeastSquares → MaxClassifier, evaluated multiclass (139 phone
+classes in the reference)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..evaluation import MulticlassClassifierEvaluator
+from ..loaders.csv_loader import LabeledData
+from ..loaders.text_loaders import timit_loader
+from ..nodes.learning import BlockLeastSquaresEstimator
+from ..nodes.stats import CosineRandomFeatures
+from ..nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
+
+
+@dataclass
+class TimitConfig:
+    train_features: Optional[str] = None
+    train_labels: Optional[str] = None
+    test_features: Optional[str] = None
+    test_labels: Optional[str] = None
+    num_cosines: int = 4096
+    gamma: float = 0.0555
+    distribution: str = "gaussian"
+    block_size: int = 2048
+    num_epochs: int = 3
+    lam: float = 1e-3
+    num_classes: int = 147
+    n_synth: int = 4000
+    synth_dim: int = 440
+    seed: int = 0
+
+
+def _synthetic_timit(n, dim, num_classes, noise_seed, class_seed=1234):
+    """Class-dependent frames — learnable stand-in. Class structure comes
+    from `class_seed` so train/test splits share the same classes; only
+    the noise/labels vary with `noise_seed`."""
+    crng = np.random.default_rng(class_seed)
+    latent = crng.normal(size=(num_classes, 16)).astype(np.float32) * 3.0
+    embed = crng.normal(size=(16, dim)).astype(np.float32) / 4.0
+    rng = np.random.default_rng(noise_seed)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    X = latent[y] @ embed + 1.0 * rng.normal(size=(n, dim)).astype(np.float32)
+    return LabeledData.from_arrays(y, X)
+
+
+def run(config: TimitConfig):
+    if config.train_features:
+        train = timit_loader(config.train_features, config.train_labels)
+        test = timit_loader(
+            config.test_features or config.train_features,
+            config.test_labels or config.train_labels,
+        )
+        num_classes = config.num_classes
+    else:
+        num_classes = min(config.num_classes, 12)
+        train = _synthetic_timit(config.n_synth, config.synth_dim, num_classes, config.seed)
+        test = _synthetic_timit(config.n_synth // 4, config.synth_dim, num_classes, config.seed + 1)
+
+    dim = train.data.array.shape[1]
+    featurizer = (
+        CosineRandomFeatures(
+            dim, config.num_cosines, config.gamma,
+            distribution=config.distribution, seed=config.seed,
+        ).to_pipeline()
+        >> Cacher("timit-features")
+    )
+    labels = ClassLabelIndicatorsFromInt(num_classes)(train.labels).get()
+    predictor = featurizer.and_then(
+        BlockLeastSquaresEstimator(config.block_size, config.num_epochs, config.lam),
+        train.data,
+        labels,
+    ) >> MaxClassifier()
+
+    t0 = time.perf_counter()
+    evaluator = MulticlassClassifierEvaluator(num_classes)
+    train_eval = evaluator(predictor(train.data), train.labels)
+    elapsed = time.perf_counter() - t0
+    test_eval = evaluator(predictor(test.data), test.labels)
+    return {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "test_accuracy": test_eval.accuracy,
+        "train_seconds": elapsed,
+        "summary": test_eval.summary(),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train-features")
+    p.add_argument("--train-labels")
+    p.add_argument("--test-features")
+    p.add_argument("--test-labels")
+    p.add_argument("--num-cosines", type=int, default=4096)
+    p.add_argument("--gamma", type=float, default=0.0555)
+    p.add_argument("--distribution", default="gaussian", choices=["gaussian", "cauchy"])
+    p.add_argument("--block-size", type=int, default=2048)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lam", type=float, default=1e-3)
+    p.add_argument("--n-synth", type=int, default=4000)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    config = TimitConfig(**{k: v for k, v in vars(args).items() if v is not None})
+    result = run(config)
+    print(result["summary"])
+    print(
+        f"train_error={result['train_error']:.4f} test_error={result['test_error']:.4f} "
+        f"train_time={result['train_seconds']:.2f}s"
+    )
+    return result
+
+
+if __name__ == "__main__":
+    main()

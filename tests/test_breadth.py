@@ -1,0 +1,266 @@
+"""Tests for the breadth wave: weighted solvers, kernel methods,
+classifiers, NLP stack, sparse features, MAP/augmented evaluators."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import Dataset, HostDataset
+from keystone_tpu.evaluation import (
+    AugmentedExamplesEvaluator,
+    MeanAveragePrecisionEvaluator,
+)
+from keystone_tpu.nodes.learning import (
+    BlockWeightedLeastSquaresEstimator,
+    GaussianKernelTransformer,
+    KernelRidgeRegression,
+    LinearDiscriminantAnalysis,
+    LinearMapEstimator,
+    LogisticRegressionEstimator,
+    NaiveBayesEstimator,
+    PerClassWeightedLeastSquares,
+)
+from keystone_tpu.nodes.nlp import (
+    HashingTF,
+    NaiveBitPackIndexer,
+    NGramsCounts,
+    NGramsFeaturizer,
+    StupidBackoffEstimator,
+    Tokenizer,
+    WordFrequencyEncoder,
+)
+from keystone_tpu.nodes.util import (
+    AllSparseFeatures,
+    ClassLabelIndicatorsFromInt,
+    CommonSparseFeatures,
+)
+from keystone_tpu.nodes.nlp.text import TermFrequency
+
+
+# ------------------------------------------------------------- weighted LS
+
+
+def test_bwls_mixture_zero_equals_unweighted():
+    """mixtureWeight=0 → every class uses uniform 1/n weights → matches
+    plain ridge (cross-implementation agreement,
+    BlockWeightedLeastSquaresSuite.scala:115)."""
+    rng = np.random.default_rng(0)
+    n, d, k = 160, 12, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, k, n)
+    Y = (2.0 * np.eye(k, dtype=np.float32)[y] - 1.0)
+    lam = 1.0
+    bw = BlockWeightedLeastSquaresEstimator(d, 12, lam, mixture_weight=0.0).fit(
+        Dataset(X), Dataset(Y)
+    )
+    # unweighted ridge on 1/n-scaled objective: (XᵀX/n + λI) W = XᵀYc/n
+    xm, ym = X.mean(0), Y.mean(0)
+    Xc, Yc = X - xm, Y - ym
+    Wref = np.linalg.solve(Xc.T @ Xc / n + lam * np.eye(d), Xc.T @ Yc / n)
+    np.testing.assert_allclose(np.asarray(bw.W), Wref, atol=2e-2, rtol=5e-2)
+
+
+def test_bwls_zero_gradient():
+    """Weighted normal equations hold at the solution (the reference's
+    zero-gradient check, BlockWeightedLeastSquaresSuite.scala:142-166)."""
+    rng = np.random.default_rng(1)
+    n, d, k = 120, 10, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, k, n)
+    Y = (2.0 * np.eye(k, dtype=np.float32)[y] - 1.0)
+    lam, mw = 0.5, 0.7
+    model = BlockWeightedLeastSquaresEstimator(5, 25, lam, mw).fit(
+        Dataset(X), Dataset(Y)
+    )
+    W = np.asarray(model.W)
+    b = np.asarray(model.b)
+    for c in range(k):
+        member = (Y[:, c] > 0).astype(np.float64)
+        wts = mw * member / member.sum() + (1 - mw) / n
+        resid = X @ W[:, c] + b[c] - Y[:, c]
+        grad = X.T @ (wts * resid) + lam * W[:, c]
+        assert np.abs(grad).max() < 5e-3, f"class {c}"
+
+
+def test_per_class_weighted_delegates():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(64, 6)).astype(np.float32)
+    y = rng.integers(0, 2, 64)
+    Y = 2.0 * np.eye(2, dtype=np.float32)[y] - 1.0
+    model = PerClassWeightedLeastSquares(0.1, 0.5).fit(Dataset(X), Dataset(Y))
+    assert np.asarray(model.W).shape == (6, 2)
+
+
+# ------------------------------------------------------------------ kernels
+
+
+def test_gaussian_kernel_values():
+    X = np.array([[0.0, 0.0], [1.0, 0.0]], np.float32)
+    t = GaussianKernelTransformer(X, gamma=0.5)
+    K = np.asarray(t.apply_batch(Dataset(X)).numpy())
+    np.testing.assert_allclose(np.diag(K), 1.0, atol=1e-5)
+    np.testing.assert_allclose(K[0, 1], np.exp(-0.5), atol=1e-5)
+
+
+def test_krr_learns_xor():
+    """XOR learnability (KernelModelSuite.scala:13-39)."""
+    X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+    X = np.tile(X, (16, 1)) + 0.05 * np.random.default_rng(3).normal(
+        size=(64, 2)
+    ).astype(np.float32)
+    y = (np.round(X[:, 0]) != np.round(X[:, 1])).astype(int)
+    Y = 2.0 * np.eye(2, dtype=np.float32)[y] - 1.0
+    model = KernelRidgeRegression(gamma=2.0, lam=0.01, block_size=16, num_epochs=4).fit(
+        Dataset(X), Dataset(Y)
+    )
+    preds = np.argmax(model.apply_batch(Dataset(X)).numpy(), axis=1)
+    assert (preds == y).mean() > 0.95
+
+
+def test_krr_blocked_equals_unblocked():
+    """blocked == unblocked (KernelModelSuite.scala:29-39)."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(48, 3)).astype(np.float32)
+    Y = rng.normal(size=(48, 2)).astype(np.float32)
+    full = KernelRidgeRegression(1.0, 0.5, block_size=48, num_epochs=8).fit(
+        Dataset(X), Dataset(Y)
+    )
+    blocked = KernelRidgeRegression(1.0, 0.5, block_size=12, num_epochs=8).fit(
+        Dataset(X), Dataset(Y)
+    )
+    pred_f = full.apply_batch(Dataset(X)).numpy()
+    pred_b = blocked.apply_batch(Dataset(X)).numpy()
+    np.testing.assert_allclose(pred_f, pred_b, atol=5e-2)
+
+
+# -------------------------------------------------------------- classifiers
+
+
+def test_naive_bayes_separates_counts():
+    X = np.array(
+        [[5, 0, 1], [4, 1, 0], [0, 5, 1], [1, 4, 0]], np.float32
+    )
+    y = np.array([0, 0, 1, 1], np.int32)
+    model = NaiveBayesEstimator(2).fit(Dataset(X), Dataset(y))
+    scores = model.apply_batch(Dataset(X)).numpy()
+    assert (np.argmax(scores, axis=1) == y).all()
+
+
+def test_logistic_regression_linearly_separable():
+    rng = np.random.default_rng(5)
+    X = np.concatenate(
+        [rng.normal(-2, 0.5, (60, 2)), rng.normal(2, 0.5, (60, 2))]
+    ).astype(np.float32)
+    y = np.array([0] * 60 + [1] * 60, np.int32)
+    model = LogisticRegressionEstimator(2, lam=1e-3, num_iters=40).fit(
+        Dataset(X), Dataset(y)
+    )
+    preds = np.asarray(model.apply_batch(Dataset(X)).numpy())
+    assert (preds == y).mean() > 0.98
+
+
+def test_lda_projects_classes_apart():
+    rng = np.random.default_rng(6)
+    X = np.concatenate(
+        [rng.normal([0, 0, 0], 1, (80, 3)), rng.normal([5, 5, 0], 1, (80, 3))]
+    ).astype(np.float32)
+    y = np.array([0] * 80 + [1] * 80)
+    proj = LinearDiscriminantAnalysis(1).fit(Dataset(X), Dataset(y.astype(np.int32)))
+    Z = proj.apply_batch(Dataset(X)).numpy().ravel()
+    gap = abs(Z[:80].mean() - Z[80:].mean())
+    spread = Z[:80].std() + Z[80:].std()
+    assert gap > 2 * spread
+
+
+# ---------------------------------------------------------------------- NLP
+
+
+def test_tokenize_ngrams_counts():
+    tok = Tokenizer()
+    toks = tok.apply("the cat sat on the mat")
+    ngrams = NGramsFeaturizer([1, 2]).apply(toks)
+    assert ("the",) in ngrams and ("the", "cat") in ngrams
+    counted = NGramsCounts("default").apply_batch(HostDataset([ngrams, ngrams]))
+    pairs = dict(counted.items[0])
+    assert pairs[("the",)] == 4  # 2 occurrences x 2 docs
+
+
+def test_hashing_tf_and_term_frequency():
+    v = HashingTF(16).apply(["a", "b", "a"])
+    assert v.sum() == 3.0 and v.shape == (16,)
+    tf = dict(TermFrequency().apply(["a", "b", "a"]))
+    assert tf["a"] == 2
+
+
+def test_word_frequency_encoder_rank_and_oov():
+    enc = WordFrequencyEncoder().fit(
+        HostDataset([["a", "b", "a", "c"], ["a", "b"]])
+    )
+    assert enc.apply(["a", "b", "c", "zzz"]) == [0, 1, 2, -1]
+
+
+def test_bitpack_indexer_roundtrip():
+    idx = NaiveBitPackIndexer()
+    packed = idx.pack([3, 7, 11])
+    assert idx.unpack(packed) == [3, 7, 11]
+    assert idx.unpack(idx.remove_far_left_word(packed)) == [7, 11]
+
+
+def test_stupid_backoff_scores():
+    from collections import Counter
+
+    counts = Counter(
+        {("the", "cat"): 2, ("the", "dog"): 1, ("the",): 3, ("cat",): 2, ("dog",): 1}
+    )
+    model = StupidBackoffEstimator().fit(HostDataset([counts]))
+    assert abs(model.score(("the", "cat")) - 2 / 3) < 1e-9
+    # unseen bigram backs off to alpha * unigram freq
+    assert abs(model.score(("cat", "dog")) - 0.4 * (1 / 6)) < 1e-9
+
+
+def test_sparse_features_topk_and_vectorize():
+    docs = [[("a", 1.0), ("b", 2.0)], [("a", 1.0), ("c", 3.0)], [("a", 1.0)]]
+    vec = CommonSparseFeatures(2).fit(HostDataset(docs))
+    out = vec.apply_batch(HostDataset(docs))
+    assert out.dim == 2
+    assert out.matrix.shape == (3, 2)
+    all_vec = AllSparseFeatures().fit(HostDataset(docs))
+    assert all_vec.apply_batch(HostDataset(docs)).dim == 3
+
+
+# --------------------------------------------------------------- evaluators
+
+
+def test_map_evaluator_perfect_and_reverse():
+    scores = np.array([[0.9, 0.1], [0.8, 0.2], [0.1, 0.9]])
+    actuals = [[0], [0], [1]]
+    aps = MeanAveragePrecisionEvaluator(2)(scores, actuals)
+    np.testing.assert_allclose(aps, [1.0, 1.0], atol=1e-9)
+
+
+def test_augmented_examples_evaluator_averages():
+    ids = ["a", "a", "b", "b"]
+    scores = np.array([[0.6, 0.4], [0.0, 1.0], [0.9, 0.1], [0.8, 0.2]])
+    actuals = [1, 1, 0, 0]
+    m = AugmentedExamplesEvaluator(2)(ids, scores, actuals)
+    # 'a' mean = [0.3, 0.7] -> 1 correct; 'b' -> 0 correct
+    assert m.accuracy == 1.0
+
+
+def test_bitpack_rejects_overflow_and_roundtrips_max():
+    from keystone_tpu.nodes.nlp.indexers import MAX_WORD
+
+    idx = NaiveBitPackIndexer()
+    assert idx.unpack(idx.pack([MAX_WORD, 0]))[0] == MAX_WORD
+    with pytest.raises(ValueError):
+        idx.pack([MAX_WORD + 1])
+
+
+def test_sparse_vectorizer_single_batch_duplicate_parity():
+    from keystone_tpu.nodes.util import AllSparseFeatures
+
+    docs = [[("a", 1.0), ("a", 2.0)]]
+    vec = AllSparseFeatures().fit(HostDataset(docs))
+    single = vec.apply(docs[0]).toarray().ravel()
+    batch = vec.apply_batch(HostDataset(docs)).matrix.toarray().ravel()
+    np.testing.assert_allclose(single, batch)
+    assert single[0] == 3.0

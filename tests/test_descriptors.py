@@ -1,0 +1,79 @@
+"""Dense descriptor extractor tests: shapes, determinism, invariance
+properties (the reference checks exact VLFeat descriptor counts; we
+check the analogous static grid counts and SIFT normalization bounds)."""
+
+import numpy as np
+
+from keystone_tpu import Dataset, HostDataset
+from keystone_tpu.nodes.images import (
+    DaisyExtractor,
+    HogExtractor,
+    LCSExtractor,
+    SIFTExtractor,
+)
+
+
+def gray_image(h=64, w=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0, 1, size=(h, w, 1)).astype(np.float32)
+
+
+def test_sift_shapes_and_norm():
+    img = gray_image()
+    ext = SIFTExtractor(step=4, bin_size=4, num_scales=2)
+    out = np.asarray(ext.apply(img))
+    assert out.shape[1] == 128
+    # per-scale counts: span=16 -> 13x13; span=32 -> 9x9 at step 4
+    assert out.shape[0] == 13 * 13 + 9 * 9
+    # vlfeat scaling: L2 norm of each descriptor is 512 (before clamping loss)
+    norms = np.linalg.norm(out, axis=1)
+    assert np.all(norms < 513.0)
+    assert np.median(norms) > 400.0
+
+
+def test_sift_deterministic_and_batch_parity():
+    img = gray_image(seed=1)
+    ext = SIFTExtractor(step=8, bin_size=4, num_scales=1)
+    a = np.asarray(ext.apply(img))
+    b = np.asarray(ext.apply(img))
+    np.testing.assert_array_equal(a, b)
+    batch = ext.apply_batch(Dataset(np.stack([img, img]))).numpy()
+    np.testing.assert_allclose(batch[0], a, atol=1e-4)
+
+
+def test_sift_host_dataset_path():
+    out = SIFTExtractor(step=8, num_scales=1).apply_batch(
+        HostDataset([gray_image(seed=2), gray_image(seed=3)])
+    )
+    assert len(out) == 2
+    assert out.items[0].shape[1] == 128
+
+
+def test_lcs_shapes():
+    rng = np.random.default_rng(5)
+    img = rng.uniform(0, 1, size=(48, 48, 3)).astype(np.float32)
+    out = np.asarray(LCSExtractor(stride=4, subpatch_size=6, subpatches=4).apply(img))
+    # span 24 -> 7x7 grid at stride 4; dim = 2 stats * 16 subpatches * 3 ch
+    assert out.shape == (49, 96)
+    assert np.isfinite(out).all()
+
+
+def test_hog_shapes():
+    rng = np.random.default_rng(6)
+    img = rng.uniform(0, 1, size=(64, 64, 3)).astype(np.float32)
+    out = np.asarray(HogExtractor(cell_size=8).apply(img))
+    assert out.shape == (8 * 8, 31)
+    assert np.isfinite(out).all()
+    # orientation features bounded by 0.4 (0.5·Σ of four ≤0.2 norms);
+    # the 4 texture-energy features can reach ~0.85
+    assert out[:, :27].max() <= 0.4 + 1e-5
+    assert out.max() <= 1.0
+
+
+def test_daisy_shapes_and_norm():
+    img = gray_image(80, 80, seed=7)
+    out = np.asarray(DaisyExtractor(stride=8, radius=15).apply(img))
+    # margin 16 -> (80-32)//8+1 = 7 per axis; dim (1+3*8)*8 = 200
+    assert out.shape == (49, 200)
+    norms = np.linalg.norm(out, axis=1)
+    np.testing.assert_allclose(norms[norms > 1e-6], 1.0, atol=1e-4)

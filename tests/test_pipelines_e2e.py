@@ -1,0 +1,104 @@
+"""End-to-end smoke tests for every example app on synthetic data
+(small configs; the CLI registry is exercised too)."""
+
+import numpy as np
+import pytest
+
+
+def test_timit_pipeline():
+    from keystone_tpu.pipelines.timit import TimitConfig, run
+
+    r = run(TimitConfig(num_cosines=512, n_synth=1500, synth_dim=128, num_classes=8,
+                        block_size=256))
+    assert r["test_accuracy"] > 0.9, r["summary"]
+
+
+def test_newsgroups_pipeline():
+    from keystone_tpu.pipelines.text_pipelines import NewsgroupsConfig, run_newsgroups
+
+    r = run_newsgroups(NewsgroupsConfig(n_synth=200))
+    assert r["test_accuracy"] > 0.9, r["summary"]
+
+
+def test_amazon_pipeline():
+    from keystone_tpu.pipelines.text_pipelines import AmazonReviewsConfig, run_amazon
+
+    r = run_amazon(AmazonReviewsConfig(n_synth=200))
+    assert r["test_accuracy"] > 0.9
+
+
+def test_stupid_backoff_pipeline():
+    from keystone_tpu.pipelines.text_pipelines import (
+        StupidBackoffConfig,
+        run_stupid_backoff,
+    )
+
+    r = run_stupid_backoff(StupidBackoffConfig(n_synth=50))
+    assert np.isfinite(r["mean_log_score"])
+    assert r["num_trigrams"] > 0
+
+
+def test_linear_pixels():
+    from keystone_tpu.pipelines.cifar_variants import (
+        LinearPixelsConfig,
+        run_linear_pixels,
+    )
+
+    r = run_linear_pixels(LinearPixelsConfig(synth_train=300, synth_test=80))
+    assert r["test_accuracy"] > 0.8
+
+
+def test_random_cifar_kernel():
+    from keystone_tpu.pipelines.cifar_variants import (
+        RandomPatchCifarKernelConfig,
+        run_random_patch_cifar_kernel,
+    )
+
+    r = run_random_patch_cifar_kernel(
+        RandomPatchCifarKernelConfig(
+            synth_train=240, synth_test=60, num_filters=48, sample_patches=5000,
+            microbatch=64, kernel_block=128,
+        )
+    )
+    assert r["test_accuracy"] > 0.9
+
+
+def test_random_patch_cifar_augmented():
+    from keystone_tpu.pipelines.cifar_variants import (
+        RandomPatchCifarAugmentedConfig,
+        run_random_patch_cifar_augmented,
+    )
+
+    r = run_random_patch_cifar_augmented(
+        RandomPatchCifarAugmentedConfig(
+            synth_train=200, synth_test=50, num_filters=48, sample_patches=5000,
+            microbatch=64, block_size=512,
+        )
+    )
+    assert r["test_accuracy"] > 0.85
+
+
+def test_voc_sift_fisher():
+    from keystone_tpu.pipelines.voc_sift_fisher import VOCSIFTFisherConfig, run
+
+    r = run(VOCSIFTFisherConfig(n_synth=30, num_classes=4, gmm_k=4, pca_dims=16))
+    assert r["map"] > 0.6
+
+
+def test_imagenet_sift_lcs_fv():
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        run,
+    )
+
+    r = run(ImageNetSiftLcsFVConfig(n_synth=40, num_classes=5, gmm_k=4, pca_dims=16))
+    assert r["test_accuracy"] > 0.6
+
+
+def test_cli_registry_lists_and_dispatches(capsys):
+    from keystone_tpu.__main__ import main
+
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "pipelines.images.cifar.RandomPatchCifar" in out
+    assert main(["NoSuchPipeline"]) == 2

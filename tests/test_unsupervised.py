@@ -1,0 +1,133 @@
+"""PCA / k-means / GMM / FisherVector tests (model: reference PCASuite
+distributed≈local checks :85, sketch validity :134-198, KMeans/GMM
+suites)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import Dataset, HostDataset
+from keystone_tpu.nodes.learning import (
+    ApproximatePCAEstimator,
+    ColumnPCAEstimator,
+    DistributedPCAEstimator,
+    GaussianMixtureModelEstimator,
+    KMeansPlusPlusEstimator,
+    PCAEstimator,
+)
+from keystone_tpu.nodes.images import FisherVector, ScalaGMMFisherVectorEstimator
+
+
+@pytest.fixture
+def correlated_data():
+    rng = np.random.default_rng(0)
+    # strong low-rank structure in 12 dims
+    U = rng.normal(size=(2000, 3)).astype(np.float32)
+    A = rng.normal(size=(3, 12)).astype(np.float32)
+    return U @ A + 0.05 * rng.normal(size=(2000, 12)).astype(np.float32)
+
+
+def _subspace_angle(V1, V2):
+    """Largest principal angle between column spaces (0 = identical)."""
+    q1, _ = np.linalg.qr(V1)
+    q2, _ = np.linalg.qr(V2)
+    s = np.linalg.svd(q1.T @ q2, compute_uv=False)
+    return np.degrees(np.arccos(np.clip(s.min(), -1, 1)))
+
+
+def test_local_pca_matches_numpy(correlated_data):
+    X = correlated_data
+    model = PCAEstimator(3).fit(Dataset(X))
+    mu = X.mean(0)
+    _, _, Vt = np.linalg.svd(X - mu, full_matrices=False)
+    assert _subspace_angle(np.asarray(model.components), Vt[:3].T) < 1.0
+
+
+def test_distributed_pca_matches_local(correlated_data):
+    """distributed ≈ local (PCASuite.scala:85) — TSQR over the 8-shard
+    mesh must agree with the single-replica SVD."""
+    X = correlated_data
+    local = PCAEstimator(3).fit(Dataset(X))
+    dist = DistributedPCAEstimator(3).fit(Dataset(X))
+    assert _subspace_angle(
+        np.asarray(local.components), np.asarray(dist.components)
+    ) < 1.0
+
+
+def test_approximate_pca_sketch_validity(correlated_data):
+    X = correlated_data
+    approx = ApproximatePCAEstimator(3, oversample=8, q=2).fit(Dataset(X))
+    mu = X.mean(0)
+    _, _, Vt = np.linalg.svd(X - mu, full_matrices=False)
+    assert _subspace_angle(np.asarray(approx.components), Vt[:3].T) < 5.0
+
+
+def test_column_pca_routing(correlated_data):
+    est = ColumnPCAEstimator(3, num_chips=8)
+    model = est.optimize(Dataset(correlated_data), num_per_shard=250)
+    assert est.chosen in ("local", "distributed")
+
+
+def test_pca_on_descriptor_matrices():
+    rng = np.random.default_rng(1)
+    items = [rng.normal(size=(30, 16)).astype(np.float32) for _ in range(5)]
+    model = PCAEstimator(4).fit(HostDataset(items))
+    out = model.apply_batch(HostDataset(items))
+    assert out.items[0].shape == (30, 4)
+
+
+def test_kmeans_separates_clusters():
+    rng = np.random.default_rng(2)
+    centers = np.array([[0, 0], [10, 0], [0, 10]], np.float32)
+    X = np.concatenate(
+        [c + 0.3 * rng.normal(size=(100, 2)).astype(np.float32) for c in centers]
+    )
+    model = KMeansPlusPlusEstimator(3, num_iters=10, seed=0).fit(Dataset(X))
+    learned = np.sort(np.asarray(model.centers), axis=0)
+    np.testing.assert_allclose(learned, np.sort(centers, axis=0), atol=0.5)
+    # one-hot assignment
+    onehot = model.apply_batch(Dataset(X)).numpy()
+    assert onehot.shape == (300, 3)
+    assert np.all(onehot.sum(axis=1) == 1.0)
+
+
+def test_gmm_recovers_mixture():
+    rng = np.random.default_rng(3)
+    X = np.concatenate(
+        [
+            rng.normal(loc=-4, scale=0.5, size=(500, 2)),
+            rng.normal(loc=4, scale=1.0, size=(500, 2)),
+        ]
+    ).astype(np.float32)
+    gmm = GaussianMixtureModelEstimator(2, num_iters=40, seed=0).fit(Dataset(X))
+    means = np.sort(np.asarray(gmm.means)[:, 0])
+    np.testing.assert_allclose(means, [-4, 4], atol=0.3)
+    # posteriors are a valid distribution
+    q = np.asarray(gmm.posteriors(X[:10]))
+    np.testing.assert_allclose(q.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_fisher_vector_shape_and_gradient_property():
+    rng = np.random.default_rng(4)
+    descs = rng.normal(size=(200, 8)).astype(np.float32)
+    fv_est = ScalaGMMFisherVectorEstimator(k=4, num_iters=10)
+    fv = fv_est.fit(HostDataset([descs]))
+    out = np.asarray(fv.apply(descs))
+    assert out.shape == (8, 2 * 4)  # (d, 2k): means + variances gradients
+    assert np.isfinite(out).all()
+    # FV of data drawn exactly at a component mean has near-zero mean-gradient
+    gmm = fv.gmm
+    at_mean = np.tile(np.asarray(gmm.means[0]), (50, 1)).astype(np.float32)
+    g = np.asarray(fv.apply(at_mean))
+    assert np.abs(g[:, 0]).max() < 1e-3  # component-0 mean gradient ≈ 0
+
+
+def test_distributed_pca_on_descriptor_matrices():
+    """3D descriptor datasets: distributed must match local (review
+    regression — wrong mean/mask on the flattened rows)."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(8, 5, 4)).astype(np.float32)
+    local = PCAEstimator(2).fit(Dataset(X.reshape(-1, 4)))
+    dist = DistributedPCAEstimator(2).fit(Dataset(X))
+    assert _subspace_angle(
+        np.asarray(local.components), np.asarray(dist.components)
+    ) < 1.0
