@@ -30,14 +30,10 @@ def cifar_loader(path: str, mesh=None) -> LabeledData:
             raise ValueError(f"{f}: size {raw.size} is not a multiple of {RECORD_BYTES}")
         raws.append(raw.reshape(-1, RECORD_BYTES))
     records = np.concatenate(raws)
-    labels = records[:, 0].astype(np.int32)
-    # channel-planar (3, 32, 32) -> HWC
-    images = (
-        records[:, 1:]
-        .reshape(-1, 3, 32, 32)
-        .transpose(0, 2, 3, 1)
-        .astype(np.float32)
-    )
+    # native multithreaded parse (channel-planar -> HWC); numpy fallback
+    from ..utils.native_io import parse_cifar
+
+    images, labels = parse_cifar(records)
     return LabeledData(
         labels=Dataset(labels, mesh=mesh), data=Dataset(images, mesh=mesh)
     )

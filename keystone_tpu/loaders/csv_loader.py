@@ -12,8 +12,16 @@ from ..data.dataset import Dataset
 
 
 def csv_data_loader(path: str, delimiter: str = ",", dtype=np.float32, mesh=None) -> Dataset:
-    """Load a dense CSV of floats into a data-sharded Dataset."""
-    arr = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
+    """Load a dense CSV of floats into a data-sharded Dataset (native
+    multithreaded parser when built; numpy fallback). The native parser is
+    float32-only, so wider dtypes take the numpy path to preserve
+    precision."""
+    if np.dtype(dtype) == np.float32:
+        from ..utils.native_io import parse_csv
+
+        arr = parse_csv(path, delimiter)
+    else:
+        arr = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
     return Dataset(arr, mesh=mesh)
 
 
@@ -40,7 +48,9 @@ class LabeledData:
     def label_featured_csv(path: str, label_col: int = 0, mesh=None) -> "LabeledData":
         """CSV whose ``label_col`` holds the integer label and the rest are
         features (the reference's MNIST format, MnistRandomFFT.scala:30-38)."""
-        arr = np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
+        from ..utils.native_io import parse_csv
+
+        arr = parse_csv(path)
         labels = arr[:, label_col].astype(np.int32)
         features = np.delete(arr, label_col, axis=1)
         return LabeledData.from_arrays(labels, features, mesh=mesh)

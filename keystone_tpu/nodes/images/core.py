@@ -66,6 +66,8 @@ class Convolver(Transformer):
     layout, Convolver.scala:99-125) or (K, patch, patch, C).
     """
 
+    fusable = True
+
     def __init__(
         self,
         filters,
@@ -123,6 +125,8 @@ class SymmetricRectifier(Transformer):
     """Two-sided ReLU: channels double to [max(0, x−α), max(0, −x−α)]
     (SymmetricRectifier.scala:7-32)."""
 
+    fusable = True
+
     def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
         self.max_val = max_val
         self.alpha = alpha
@@ -143,6 +147,8 @@ class SymmetricRectifier(Transformer):
 class Pooler(Transformer):
     """Strided sum-pooling with an elementwise pre-map
     (Pooler.scala:21-69) — `lax.reduce_window` on TPU."""
+
+    fusable = True
 
     def __init__(self, stride: int, pool_size: int, pixel_fn=None, pool_fn="sum"):
         self.stride = stride
@@ -182,6 +188,8 @@ class Pooler(Transformer):
 class ImageVectorizer(Transformer):
     """(H, W, C) → flat vector (ImageVectorizer.scala:12)."""
 
+    fusable = True
+
     def apply(self, x):
         return jnp.ravel(x)
 
@@ -191,6 +199,8 @@ class ImageVectorizer(Transformer):
 
 class PixelScaler(Transformer):
     """x / 255 (PixelScaler.scala:9)."""
+
+    fusable = True
 
     def apply(self, x):
         return jnp.asarray(x, jnp.float32) / 255.0
@@ -202,6 +212,8 @@ class PixelScaler(Transformer):
 class GrayScaler(Transformer):
     """NTSC grayscale (GrayScaler.scala:9)."""
 
+    fusable = True
+
     def apply(self, x):
         from ...utils.images import grayscale
 
@@ -210,6 +222,8 @@ class GrayScaler(Transformer):
 
 class Cropper(Transformer):
     """(Cropper.scala:19)"""
+
+    fusable = True
 
     def __init__(self, y0: int, x0: int, y1: int, x1: int):
         self.box = (y0, x0, y1, x1)

@@ -149,34 +149,87 @@ class KernelRidgeRegression(LabelEstimator):
     (KernelRidgeRegression.scala:37-275)."""
 
     def __init__(self, gamma: float, lam: float, block_size: int = 2048,
-                 num_epochs: int = 1, seed: int = 0):
+                 num_epochs: int = 1, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None,
+                 blocks_before_checkpoint: int = 25):
         self.gamma = gamma
         self.lam = lam
         self.block_size = block_size
         self.num_epochs = num_epochs
         self.seed = seed
-        self.weight = 3 * num_epochs + 1
+        # block-loop checkpoint/resume — the analog of the reference's RDD
+        # lineage truncation + checkpointDir (KernelRidgeRegression.scala:
+        # 35,199-205): solver state (alpha, KA) is persisted every
+        # `blocks_before_checkpoint` blocks and restored on restart.
+        self.checkpoint_dir = checkpoint_dir
+        self.blocks_before_checkpoint = blocks_before_checkpoint
+
+    @property
+    def weight(self):
+        return 3 * self.num_epochs + 1
+
+    def _ckpt_path(self, data, labels) -> Optional[str]:
+        if not self.checkpoint_dir:
+            return None
+        import hashlib
+        import os
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        # fingerprint the data, not just shapes: a stale checkpoint from a
+        # different dataset with identical shape must not resume
+        h = hashlib.sha1()
+        h.update(np.asarray(data.take(4)).tobytes())
+        h.update(np.asarray(labels.take(4)).tobytes())
+        h.update(str((data.count, data.array.shape)).encode())
+        tag = (
+            f"krr_{h.hexdigest()[:12]}_B{self.block_size}"
+            f"_g{self.gamma}_l{self.lam}_s{self.seed}"
+        )
+        return os.path.join(self.checkpoint_dir, tag + ".npz")
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
+        import os
+
         X = data.array
         Y = labels.array * data.mask[:, None]
         n_pad = X.shape[0]
         mask = data.mask.astype(X.dtype)
         B = min(self.block_size, n_pad)
         # permutable blocks over VALID rows only; padded rows keep alpha=0
-        rng = np.random.default_rng(self.seed)
         n_blocks = -(-data.count // B)
         alpha = jnp.zeros((n_pad, Y.shape[1]), X.dtype)
         KA = jnp.zeros_like(alpha)
+        start_epoch, start_block = 0, 0
+        ckpt = self._ckpt_path(data, labels)
+        if ckpt and os.path.exists(ckpt):
+            state = np.load(ckpt)
+            alpha = jnp.asarray(state["alpha"])
+            KA = jnp.asarray(state["KA"])
+            start_epoch, start_block = int(state["epoch"]), int(state["block"])
         lam = jnp.asarray(self.lam, X.dtype)
         gamma = jnp.asarray(self.gamma, X.dtype)
-        for epoch in range(self.num_epochs):
-            perm = rng.permutation(data.count)
+        done = 0
+        for epoch in range(start_epoch, self.num_epochs):
+            # per-epoch seed so a resumed run replays identical block orders
+            perm = np.random.default_rng(self.seed + epoch).permutation(data.count)
             pad = (-len(perm)) % (n_blocks * B)
             ids = np.concatenate([perm, perm[: pad]]) if pad else perm
-            for b in range(n_blocks):
+            first = start_block if epoch == start_epoch else 0
+            for b in range(first, n_blocks):
                 block_ids = jnp.asarray(ids[b * B : (b + 1) * B], jnp.int32)
                 alpha, KA = _krr_step(X, Y, mask, alpha, KA, lam, gamma, block_ids)
+                done += 1
+                if ckpt and done % self.blocks_before_checkpoint == 0:
+                    # atomic write: a crash mid-save must not corrupt the
+                    # checkpoint the next run resumes from
+                    tmp = ckpt + ".tmp.npz"
+                    np.savez(
+                        tmp, alpha=np.asarray(alpha), KA=np.asarray(KA),
+                        epoch=epoch, block=b + 1,
+                    )
+                    os.replace(tmp, ckpt)
+        if ckpt and os.path.exists(ckpt):
+            os.unlink(ckpt)  # fit completed; stale state must not resume
         return KernelBlockLinearMapper(
             np.asarray(X), alpha, self.gamma, self.block_size
         )

@@ -12,3 +12,4 @@ from .text import (
 )
 from .indexers import BackoffIndexer, NaiveBitPackIndexer, NGramIndexer
 from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel
+from .annotators import NER, CoreNLPFeatureExtractor, POSTagger

@@ -1,0 +1,127 @@
+"""Linguistic annotator nodes: POS tagging, NER, CoreNLP-style features.
+
+The reference wraps external JVM models — Epic CRF/SemiCRF for
+POS/NER (nodes/nlp/POSTagger.scala:24-36, NER.scala:20-32) and
+sista/processors for CoreNLP features (CoreNLPFeatureExtractor.scala:
+18-45) — models it downloads at build time. This environment has no such
+artifacts, so these nodes take any ``model`` callable (token list →
+tags) and ship honest lightweight built-ins:
+
+  - POS: regex/suffix heuristics over a closed-class lexicon
+    (determiner/preposition/pronoun lists + morphological suffix rules).
+  - NER: capitalization/shape heuristics (sentence-initial demotion,
+    ALL-CAPS and TitleCase runs).
+  - CoreNLPFeatureExtractor: tokenize → suffix-stripping lemmatizer →
+    NER-replace → n-grams, mirroring the reference's pipeline shape.
+
+Swap in a real tagger by passing ``model=``; the node API and pipeline
+position match the reference exactly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ...workflow.pipeline import Transformer
+from .text import NGramsFeaturizer, Tokenizer
+
+_DETERMINERS = {"the", "a", "an", "this", "that", "these", "those"}
+_PREPOSITIONS = {"in", "on", "at", "by", "for", "with", "to", "from", "of"}
+_PRONOUNS = {"i", "you", "he", "she", "it", "we", "they", "me", "him", "her"}
+_CONJUNCTIONS = {"and", "or", "but", "nor", "so", "yet"}
+_BE = {"is", "am", "are", "was", "were", "be", "been", "being"}
+
+
+def _heuristic_pos(tokens: Sequence[str]) -> List[str]:
+    tags = []
+    for t in tokens:
+        low = t.lower()
+        if low in _DETERMINERS:
+            tags.append("DT")
+        elif low in _PREPOSITIONS:
+            tags.append("IN")
+        elif low in _PRONOUNS:
+            tags.append("PRP")
+        elif low in _CONJUNCTIONS:
+            tags.append("CC")
+        elif low in _BE:
+            tags.append("VB")
+        elif re.fullmatch(r"[-+]?\d[\d.,]*", t):
+            tags.append("CD")
+        elif low.endswith("ly"):
+            tags.append("RB")
+        elif low.endswith(("ing", "ed", "ize", "ise")):
+            tags.append("VB")
+        elif low.endswith(("ous", "ful", "ive", "able", "ible", "al", "ic")):
+            tags.append("JJ")
+        elif low.endswith("s") and len(low) > 3:
+            tags.append("NNS")
+        else:
+            tags.append("NN")
+    return tags
+
+
+def _heuristic_ner(tokens: Sequence[str]) -> List[str]:
+    tags = []
+    for i, t in enumerate(tokens):
+        if re.fullmatch(r"[A-Z][a-z]+", t) and i > 0:
+            tags.append("ENTITY")
+        elif re.fullmatch(r"[A-Z]{2,}", t):
+            tags.append("ENTITY")
+        elif re.fullmatch(r"[-+]?\d[\d.,]*", t):
+            tags.append("NUMBER")
+        else:
+            tags.append("O")
+    return tags
+
+
+class POSTagger(Transformer):
+    """tokens → (token, tag) pairs (POSTagger.scala:24-36)."""
+
+    def __init__(self, model: Optional[Callable] = None):
+        self.model = model or _heuristic_pos
+
+    def apply(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
+        return list(zip(tokens, self.model(tokens)))
+
+
+class NER(Transformer):
+    """tokens → (token, entity-tag) pairs (NER.scala:20-32)."""
+
+    def __init__(self, model: Optional[Callable] = None):
+        self.model = model or _heuristic_ner
+
+    def apply(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
+        return list(zip(tokens, self.model(tokens)))
+
+
+_SUFFIXES = ("ations", "ation", "ings", "ing", "edly", "ed", "ies", "es", "s")
+
+
+def _lemma(token: str) -> str:
+    low = token.lower()
+    for suf in _SUFFIXES:
+        if low.endswith(suf) and len(low) - len(suf) >= 3:
+            stem = low[: -len(suf)]
+            # collapse doubled final consonant (running -> run)
+            if len(stem) >= 3 and stem[-1] == stem[-2] and stem[-1] not in "aeiou":
+                stem = stem[:-1]
+            return stem
+    return low
+
+
+class CoreNLPFeatureExtractor(Transformer):
+    """text → n-grams of lemmatized, NER-replaced tokens
+    (CoreNLPFeatureExtractor.scala:18-45)."""
+
+    def __init__(self, orders: Sequence[int] = (1, 2), ner: Optional[NER] = None):
+        self.tokenizer = Tokenizer()
+        self.featurizer = NGramsFeaturizer(orders)
+        self.ner = ner or NER()
+
+    def apply(self, text: str) -> List[tuple]:
+        tokens = self.tokenizer.apply(text)
+        tagged = self.ner.apply(tokens)
+        processed = [tag if tag != "O" else _lemma(tok) for tok, tag in tagged]
+        return self.featurizer.apply(processed)

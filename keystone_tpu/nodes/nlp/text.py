@@ -39,12 +39,19 @@ class LowerCase(Transformer):
 
 
 class Tokenizer(Transformer):
-    """Regex-split tokenizer (StringUtils.scala `Tokenizer`)."""
+    """Regex-split tokenizer (StringUtils.scala `Tokenizer`). The default
+    whitespace pattern routes through the native offset scanner
+    (native/keystone_io.cpp `ks_tokenize_ws`) when built."""
 
     def __init__(self, pattern: str = "[\\s]+"):
+        self.pattern_str = pattern
         self.pattern = re.compile(pattern)
 
     def apply(self, s: str) -> List[str]:
+        if self.pattern_str == "[\\s]+":
+            from ...utils.native_io import tokenize_ws
+
+            return tokenize_ws(s)
         return [t for t in self.pattern.split(s) if t]
 
 

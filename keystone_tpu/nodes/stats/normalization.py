@@ -16,6 +16,8 @@ from ...workflow.pipeline import Transformer
 
 
 class NormalizeRows(Transformer):
+
+    fusable = True
     def __init__(self, eps: float = 2.2e-16):
         self.eps = eps
 
@@ -25,6 +27,8 @@ class NormalizeRows(Transformer):
 
 
 class SignedHellingerMapper(Transformer):
+
+    fusable = True
     def apply(self, x):
         return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
 

@@ -26,6 +26,8 @@ class CosineRandomFeatures(Transformer):
     """cos(x Wᵀ + b) with W ~ gamma·N(0,1) (gaussian) or gamma·Cauchy,
     b ~ U[0, 2π]."""
 
+    fusable = True
+
     def __init__(
         self,
         input_dim: int,
@@ -61,6 +63,8 @@ class CosineRandomFeatures(Transformer):
 class RandomSignNode(Transformer):
     """Elementwise multiply by a fixed random ±1 vector."""
 
+    fusable = True
+
     def __init__(self, dim: int, seed: int = 0):
         rng = np.random.default_rng(seed)
         self.signs = jnp.asarray(
@@ -75,6 +79,8 @@ class PaddedFFT(Transformer):
     """Zero-pad to the next power of two and return the real part of the
     positive-frequency half of the FFT."""
 
+    fusable = True
+
     def apply(self, x):
         n = x.shape[-1]
         padded = 1 << max(int(np.ceil(np.log2(n))), 0)
@@ -83,6 +89,8 @@ class PaddedFFT(Transformer):
 
 class LinearRectifier(Transformer):
     """max(maxVal, x - alpha)."""
+
+    fusable = True
 
     def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
         self.max_val = max_val

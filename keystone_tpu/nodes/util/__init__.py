@@ -17,3 +17,4 @@ from .sparse_features import (
     SparseFeatureVectorizer,
 )
 from .fusion import FusedBatchTransformer
+from .vector_splitter import VectorSplitter

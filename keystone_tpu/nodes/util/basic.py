@@ -67,6 +67,8 @@ class ClassLabelIndicatorsFromIntArray(Transformer):
 class MaxClassifier(Transformer):
     """argmax over scores → int label (MaxClassifier.scala)."""
 
+    fusable = True
+
     def apply(self, x):
         return jnp.argmax(x, axis=-1)
 
@@ -155,6 +157,8 @@ class FloatToDouble(Transformer):
 
 class MatrixVectorizer(Transformer):
     """Flatten a per-item matrix to a vector (MatrixVectorizer.scala)."""
+
+    fusable = True
 
     def apply(self, x):
         return jnp.ravel(x)

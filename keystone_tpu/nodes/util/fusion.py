@@ -67,7 +67,14 @@ class FusedBatchTransformer(Transformer):
 
         return chunk_fn
 
-    def apply_batch(self, data: Dataset):
+    def apply_batch(self, data):
+        from ...data.dataset import HostDataset
+
+        if not isinstance(data, Dataset):
+            # host/object datasets: run the stages' own batch paths
+            for s in self.stages:
+                data = s.apply_batch(data)
+            return data
         key = ("_fused_program", data.padded_count, data.n_shards)
         program = self.__dict__.get("_program_cache", {}).get(key)
         if program is None:

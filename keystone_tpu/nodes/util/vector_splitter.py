@@ -1,0 +1,37 @@
+"""VectorSplitter — the feature-block / model-parallel axis
+(reference nodes/util/VectorSplitter.scala:10-36: splits RDD[DenseVector]
+into a Seq[RDD] of feature blocks; every block solver iterates them).
+
+TPU-native: the block solvers slice the feature axis inside their jitted
+scans (block_ls.py) so splitting is usually implicit; this node exists
+for API parity and for explicitly staging blocked apply paths. It returns
+a list of Datasets that share the source's mesh and count.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...data.dataset import Dataset
+from ...workflow.pipeline import Transformer
+
+
+class VectorSplitter(Transformer):
+    def __init__(self, block_size: int, num_features: Optional[int] = None):
+        self.block_size = block_size
+        self.num_features = num_features
+
+    def apply(self, x):
+        d = self.num_features or x.shape[-1]
+        return [
+            x[..., start : min(start + self.block_size, d)]
+            for start in range(0, d, self.block_size)
+        ]
+
+    def apply_batch(self, data: Dataset) -> List[Dataset]:
+        X = data.array
+        d = self.num_features or X.shape[1]
+        return [
+            data.with_data(X[:, start : min(start + self.block_size, d)])
+            for start in range(0, d, self.block_size)
+        ]

@@ -1,0 +1,126 @@
+"""ctypes bindings for the native host data-plane (native/keystone_io.cpp).
+
+Mirrors the reference's JNI wrappers (utils/external/VLFeat.scala,
+EncEval.scala) in role: a thin typed facade over a C ABI, loaded from the
+repo's build output. Every entry point has a pure-Python fallback so the
+framework works without the native build; `available()` reports which
+path is active.
+
+Build: ``make -C native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(here, "native", "libkeystone_io.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ks_parse_cifar.restype = ctypes.c_int
+        lib.ks_parse_cifar.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int,
+        ]
+        lib.ks_csv_shape.restype = ctypes.c_int
+        lib.ks_csv_shape.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ks_parse_csv.restype = ctypes.c_int
+        lib.ks_parse_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.ks_tokenize_ws.restype = ctypes.c_int64
+        lib.ks_tokenize_ws.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def _threads() -> int:
+    return max(os.cpu_count() or 1, 1)
+
+
+def parse_cifar(records: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(n, 3073) uint8 records → ((n,32,32,3) float32, (n,) int32)."""
+    records = np.ascontiguousarray(records, np.uint8)
+    n = records.shape[0]
+    lib = _lib()
+    if lib is not None:
+        images = np.empty((n, 32, 32, 3), np.float32)
+        labels = np.empty((n,), np.int32)
+        rc = lib.ks_parse_cifar(
+            records.ctypes.data, n, images.ctypes.data, labels.ctypes.data,
+            _threads(),
+        )
+        if rc == 0:
+            return images, labels
+    # fallback: vectorized numpy
+    labels = records[:, 0].astype(np.int32)
+    images = (
+        records[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        .astype(np.float32)
+    )
+    return images, labels
+
+
+def parse_csv(path: str, delimiter: str = ",") -> np.ndarray:
+    """Dense float CSV → (rows, cols) float32."""
+    lib = _lib()
+    if lib is None:
+        return np.loadtxt(path, delimiter=delimiter, dtype=np.float32, ndmin=2)
+    with open(path, "rb") as f:
+        buf = f.read()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    d = delimiter.encode()[:1]
+    if lib.ks_csv_shape(buf, len(buf), d, ctypes.byref(rows), ctypes.byref(cols)):
+        return np.loadtxt(path, delimiter=delimiter, dtype=np.float32, ndmin=2)
+    out = np.empty((rows.value, cols.value), np.float32)
+    rc = lib.ks_parse_csv(
+        buf, len(buf), d, rows.value, cols.value, out.ctypes.data, _threads()
+    )
+    if rc != 0:
+        return np.loadtxt(path, delimiter=delimiter, dtype=np.float32, ndmin=2)
+    return out
+
+
+def tokenize_ws(text: str) -> list:
+    """Whitespace tokens of a string (native offset scan when available)."""
+    lib = _lib()
+    if lib is None:
+        return text.split()
+    raw = text.encode("utf-8", errors="replace")
+    cap = max(len(raw) // 2 + 1, 16)
+    spans = np.empty((cap, 2), np.int64)
+    n = lib.ks_tokenize_ws(raw, len(raw), spans.ctypes.data, cap)
+    if n < 0:
+        return text.split()
+    if n > cap:
+        spans = np.empty((n, 2), np.int64)
+        n = lib.ks_tokenize_ws(raw, len(raw), spans.ctypes.data, n)
+    return [raw[s:e].decode("utf-8", errors="replace") for s, e in spans[:n]]

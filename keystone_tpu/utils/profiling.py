@@ -1,0 +1,84 @@
+"""Execution tracing / profiling.
+
+Reference tracing (SURVEY.md §5): per-node nanoTime deltas in solver
+logs, DOT plan dumps before/after optimizer rules
+(RuleExecutor.scala:44-77), and the AutoCacheRule sampled profiler
+(workflow/autocache.py here). This module adds the user-facing piece: a
+profiler that records wall time and output size of every node forced
+during execution.
+
+    with profile_execution() as prof:
+        pipeline(data).get()
+    print(prof.report())
+
+Timing wraps each node's lazy Expression, so it measures the real force
+time (including device compute via the `.cache()` block) rather than
+graph construction.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..workflow.env import PipelineEnv
+from ..workflow.expressions import Expression
+
+
+@dataclass
+class NodeProfile:
+    label: str
+    seconds: float = 0.0
+    bytes: float = 0.0
+    forced: int = 0
+
+
+class ExecutionProfiler:
+    def __init__(self):
+        self.profiles: Dict[str, NodeProfile] = {}
+
+    def wrap(self, label: str, expr: Expression) -> Expression:
+        orig_thunk = expr._thunk
+        if orig_thunk is None:  # already forced; nothing to time
+            return expr
+
+        def timed():
+            t0 = time.perf_counter()
+            value = orig_thunk()
+            if hasattr(value, "cache"):
+                value.cache()  # block so device time is attributed here
+            dt = time.perf_counter() - t0
+            p = self.profiles.setdefault(label, NodeProfile(label))
+            p.seconds += dt
+            p.forced += 1
+            from ..workflow.autocache import _estimate_bytes
+
+            p.bytes += _estimate_bytes(value)
+            return value
+
+        expr._thunk = timed
+        return expr
+
+    def report(self) -> str:
+        rows = sorted(self.profiles.values(), key=lambda p: -p.seconds)
+        lines = [f"{'node':<44} {'seconds':>9} {'MB':>9} {'forced':>6}"]
+        for p in rows:
+            lines.append(
+                f"{p.label[:44]:<44} {p.seconds:>9.3f} {p.bytes / 1e6:>9.1f} "
+                f"{p.forced:>6}"
+            )
+        return "\n".join(lines)
+
+
+@contextmanager
+def profile_execution():
+    env = PipelineEnv.get()
+    prof = ExecutionProfiler()
+    prev = getattr(env, "profiler", None)
+    env.profiler = prof
+    try:
+        yield prof
+    finally:
+        env.profiler = prev

@@ -1,0 +1,36 @@
+"""Numeric helpers (reference utils/Stats.scala:12-124 and
+utils/MatrixUtils.scala:17-205)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def about_eq(a, b, tol: float = 1e-8) -> bool:
+    """Elementwise approximate equality (Stats.aboutEq,
+    utils/Stats.scala:24-75) — the tolerance helper the reference's
+    numerical suites are built on."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    if a.shape != b.shape:
+        return False
+    return bool(np.all(np.abs(a - b) <= tol))
+
+
+def normalize_rows(X: np.ndarray, floor: float = 2.2e-16) -> np.ndarray:
+    """Row L2 normalization with a norm floor (Stats.normalizeRows,
+    utils/Stats.scala:90-124 — used by the CIFAR filter-learning path)."""
+    X = np.asarray(X)
+    norms = np.linalg.norm(X, axis=1, keepdims=True)
+    return X / np.maximum(norms, floor)
+
+
+def rows_to_matrix(rows) -> np.ndarray:
+    """Stack an iterable of row vectors into a matrix
+    (MatrixUtils.rowsToMatrix)."""
+    return np.stack([np.asarray(r) for r in rows])
+
+
+def matrix_to_rows(M) -> list:
+    """(MatrixUtils.matrixToRowArray)"""
+    return [np.asarray(r) for r in np.asarray(M)]

@@ -61,6 +61,7 @@ class PipelineEnv:
     def __init__(self):
         self.state: Dict[Prefix, Expression] = {}
         self._optimizer = None
+        self.profiler = None  # set by utils.profiling.profile_execution
 
     @classmethod
     def get(cls) -> "PipelineEnv":

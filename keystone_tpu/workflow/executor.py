@@ -66,7 +66,11 @@ class GraphExecutor:
                 expr = go(graph.get_sink_dependency(vid))
             else:
                 dep_exprs = [go(d) for d in graph.get_dependencies(vid)]
-                expr = graph.get_operator(vid).execute(dep_exprs)
+                op = graph.get_operator(vid)
+                expr = op.execute(dep_exprs)
+                profiler = getattr(env, "profiler", None)
+                if profiler is not None:
+                    expr = profiler.wrap(op.label, expr)
                 prefix = prefixes.get(vid)
                 if prefix is not None and prefix not in env.state:
                     env.state[prefix] = expr

@@ -1,0 +1,86 @@
+"""Automatic stage-fusion rule — a TPU-native optimizer pass with no
+reference analog (Spark streams partition iterators, so per-node
+materialization is free there; on TPU every node boundary is an HBM
+round-trip).
+
+`NodeFusionRule` finds maximal linear chains of adjacent transformer
+nodes that declare themselves XLA-traceable (``fusable = True``) and
+replaces each chain with one `FusedBatchTransformer`, so the whole chain
+compiles into a single microbatched XLA program (see
+nodes/util/fusion.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .analysis import children
+from .graph import Graph, NodeId
+from .optimizer import Plan, Rule
+
+
+class NodeFusionRule(Rule):
+    def __init__(self, microbatch: int = 2048):
+        self.microbatch = microbatch
+
+    @staticmethod
+    def _fusable(graph: Graph, node: NodeId) -> bool:
+        op = graph.get_operator(node)
+        return getattr(op, "fusable", False) and len(graph.get_dependencies(node)) == 1
+
+    def apply(self, plan: Plan) -> Plan:
+        from ..nodes.util.fusion import FusedBatchTransformer
+
+        graph, prefixes = plan
+        visited: set = set()
+        chains: List[List[NodeId]] = []
+        for node in sorted(graph.operators, key=lambda n: n.id):
+            if node in visited or not self._fusable(graph, node):
+                continue
+            # walk up to the chain head
+            head = node
+            while True:
+                dep = graph.get_dependencies(head)[0]
+                if (
+                    isinstance(dep, NodeId)
+                    and self._fusable(graph, dep)
+                    and len(children(graph, dep)) == 1
+                ):
+                    head = dep
+                else:
+                    break
+            # walk down collecting the chain
+            chain = [head]
+            cur = head
+            while True:
+                kids = children(graph, cur)
+                if len(kids) != 1:
+                    break
+                (kid,) = kids
+                if isinstance(kid, NodeId) and self._fusable(graph, kid):
+                    chain.append(kid)
+                    cur = kid
+                else:
+                    break
+            visited.update(chain)
+            if len(chain) >= 2:
+                chains.append(chain)
+
+        for chain in chains:
+            if any(n not in graph.operators for n in chain):
+                continue  # already rewritten by an overlapping chain
+            stages = [graph.get_operator(n) for n in chain]
+            fused = FusedBatchTransformer(stages, microbatch=self.microbatch)
+            head_dep = graph.get_dependencies(chain[0])
+            graph = graph.set_operator(chain[0], fused)
+            # rewire users of the tail to the head, then drop the rest
+            graph = graph.replace_dependency(chain[-1], chain[0])
+            # the head now (wrongly) depends on itself via the rewire if the
+            # chain's second node pointed at head — restore true deps
+            graph = graph.set_dependencies(chain[0], head_dep)
+            for n in reversed(chain[1:]):
+                graph = graph.set_dependencies(n, ())
+                graph = graph.remove_node(n)
+            for n in chain[1:]:
+                prefixes.pop(n, None)
+        return graph, prefixes

@@ -241,19 +241,24 @@ class Optimizer(RuleExecutor):
 
 
 class DefaultOptimizer(Optimizer):
-    """Batches mirror DefaultOptimizer.scala:8-31: saved-state reuse and
+    """Batches mirror DefaultOptimizer.scala:8-31 (saved-state reuse and
     dead-branch removal once; CSE to fixpoint; node-level optimization
-    once."""
+    once) plus the TPU-native stage-fusion pass (see fusion_rule.py)."""
 
-    def __init__(self, samples_per_shard: int = 3):
+    def __init__(self, samples_per_shard: int = 3, fuse: bool = True,
+                 fusion_microbatch: int = 2048):
+        from .fusion_rule import NodeFusionRule
+
         self._batches = [
             Batch(
                 "state",
                 [ExtractSaveablePrefixes(), SavedStateLoadRule(), UnusedBranchRemovalRule()],
             ),
             Batch("cse", [EquivalentNodeMergeRule()], max_iterations=10),
-            Batch("node-opt", [NodeOptimizationRule(samples_per_shard)]),
         ]
+        if fuse:
+            self._batches.append(Batch("fuse", [NodeFusionRule(fusion_microbatch)]))
+        self._batches.append(Batch("node-opt", [NodeOptimizationRule(samples_per_shard)]))
 
     @property
     def batches(self) -> List[Batch]:

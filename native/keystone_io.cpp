@@ -1,0 +1,192 @@
+// keystone_io — native host-side data-plane kernels.
+//
+// The reference's native layer (src/main/cpp, Makefile:1-121) accelerates
+// compute (VLFeat SIFT, enceval GMM/FV) — those moved to XLA where they
+// belong on TPU. What remains host-bound on a TPU system is the ingest
+// path: parsing binary/CSV datasets into pinned float32 batches fast
+// enough to keep the chips fed (SURVEY.md §7 hard part (f)). This library
+// provides multithreaded parsers exposed via a C ABI for ctypes:
+//
+//   - CIFAR binary records  -> float32 NHWC images + int32 labels
+//   - dense float CSV       -> float32 row-major matrix
+//   - whitespace tokenization offsets for a UTF-8 corpus buffer
+//
+// Build: make -C native   (produces libkeystone_io.so)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- CIFAR
+
+// records: n x (1 label byte + 3072 channel-planar bytes)
+// out_images: n*32*32*3 float32 (NHWC), out_labels: n int32
+// Returns 0 on success.
+int ks_parse_cifar(const uint8_t* records, int64_t n_records,
+                   float* out_images, int32_t* out_labels, int num_threads) {
+  if (!records || !out_images || !out_labels || n_records < 0) return 1;
+  const int64_t rec = 1 + 3072;
+  if (num_threads < 1) num_threads = 1;
+
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* r = records + i * rec;
+      out_labels[i] = r[0];
+      const uint8_t* px = r + 1;
+      float* img = out_images + i * 3072;
+      // channel-planar (3,32,32) -> HWC (32,32,3)
+      for (int y = 0; y < 32; ++y) {
+        for (int x = 0; x < 32; ++x) {
+          const int p = y * 32 + x;
+          float* o = img + (p * 3);
+          o[0] = static_cast<float>(px[p]);
+          o[1] = static_cast<float>(px[1024 + p]);
+          o[2] = static_cast<float>(px[2048 + p]);
+        }
+      }
+    }
+  };
+
+  if (num_threads == 1 || n_records < 1024) {
+    worker(0, n_records);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t chunk = (n_records + num_threads - 1) / num_threads;
+    for (int t = 0; t < num_threads; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = lo + chunk > n_records ? n_records : lo + chunk;
+      if (lo >= hi) break;
+      ts.emplace_back(worker, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+  }
+  return 0;
+}
+
+// ----------------------------------------------------------------- CSV
+
+// Count rows and columns of a dense delimited float file already in
+// memory. Returns 0 on success; *out_rows/*out_cols receive the shape.
+int ks_csv_shape(const char* buf, int64_t len, char delim,
+                 int64_t* out_rows, int64_t* out_cols) {
+  if (!buf || !out_rows || !out_cols) return 1;
+  int64_t rows = 0, cols = 0, cur_cols = 1;
+  bool any = false;
+  for (int64_t i = 0; i < len; ++i) {
+    char c = buf[i];
+    if (c == delim) {
+      ++cur_cols;
+    } else if (c == '\n') {
+      if (any) {
+        if (cols == 0) cols = cur_cols;
+        else if (cols != cur_cols) return 2;  // ragged
+        ++rows;
+      }
+      cur_cols = 1;
+      any = false;
+    } else if (c != '\r' && c != ' ' && c != '\t') {
+      any = true;
+    }
+  }
+  if (any) {  // trailing row without newline
+    if (cols == 0) cols = cur_cols;
+    else if (cols != cur_cols) return 2;
+    ++rows;
+  }
+  *out_rows = rows;
+  *out_cols = cols;
+  return 0;
+}
+
+// Parse the dense float CSV into out (rows*cols float32), multithreaded
+// by row ranges (rows are found by scanning newline offsets first).
+int ks_parse_csv(const char* buf, int64_t len, char delim,
+                 int64_t rows, int64_t cols, float* out, int num_threads) {
+  if (!buf || !out) return 1;
+  // index row starts
+  std::vector<int64_t> starts;
+  starts.reserve(rows + 1);
+  starts.push_back(0);
+  for (int64_t i = 0; i < len; ++i)
+    if (buf[i] == '\n') starts.push_back(i + 1);
+  // drop trailing empty segments
+  while (starts.size() > 1 && starts.back() >= len) starts.pop_back();
+  if (static_cast<int64_t>(starts.size()) < rows) return 3;
+
+  std::atomic<int> err{0};
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      const char* p = buf + starts[r];
+      const char* end = (r + 1 < static_cast<int64_t>(starts.size()))
+                            ? buf + starts[r + 1]
+                            : buf + len;
+      float* o = out + r * cols;
+      for (int64_t c = 0; c < cols; ++c) {
+        // strict field scan: empty fields (',,' or trailing ',') are an
+        // error, never silently filled from the next row (strtof on its
+        // own would skip the newline and shift all following values)
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+        if (p >= end || *p == delim || *p == '\n') { err.store(5); return; }
+        char* next = nullptr;
+        o[c] = strtof(p, &next);
+        if (next == p) { err.store(4); return; }
+        p = next;
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+        if (c + 1 < cols) {
+          if (p < end && *p == delim) ++p;
+          else { err.store(6); return; }
+        }
+      }
+    }
+  };
+
+  if (num_threads < 1) num_threads = 1;
+  if (num_threads == 1 || rows < 256) {
+    worker(0, rows);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t chunk = (rows + num_threads - 1) / num_threads;
+    for (int t = 0; t < num_threads; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = lo + chunk > rows ? rows : lo + chunk;
+      if (lo >= hi) break;
+      ts.emplace_back(worker, lo, hi);
+    }
+    for (auto& t : ts) t.join();
+  }
+  return err.load();
+}
+
+// ------------------------------------------------------------ tokenize
+
+// Whitespace-tokenize a UTF-8 buffer: writes (start, end) byte offsets
+// into out_spans (capacity max_tokens pairs). Returns token count, or -1
+// on error. A second call with a larger buffer handles overflow.
+int64_t ks_tokenize_ws(const char* buf, int64_t len,
+                       int64_t* out_spans, int64_t max_tokens) {
+  if (!buf || !out_spans) return -1;
+  int64_t count = 0;
+  int64_t i = 0;
+  while (i < len) {
+    while (i < len && (buf[i] == ' ' || buf[i] == '\n' || buf[i] == '\t' ||
+                       buf[i] == '\r')) ++i;
+    if (i >= len) break;
+    int64_t start = i;
+    while (i < len && buf[i] != ' ' && buf[i] != '\n' && buf[i] != '\t' &&
+           buf[i] != '\r') ++i;
+    if (count < max_tokens) {
+      out_spans[2 * count] = start;
+      out_spans[2 * count + 1] = i;
+    }
+    ++count;
+  }
+  return count;
+}
+
+}  // extern "C"

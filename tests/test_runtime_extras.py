@@ -1,0 +1,175 @@
+"""Tests for the runtime extras: auto-fusion rule, profiler, KRR
+checkpoint/resume, VectorSplitter, native IO, annotators, stats."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import Dataset, HostDataset, PipelineEnv, Transformer
+from keystone_tpu.nodes.images.core import ImageVectorizer, PixelScaler, Pooler
+from keystone_tpu.nodes.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.nodes.util import VectorSplitter
+from keystone_tpu.nodes.util.fusion import FusedBatchTransformer
+from keystone_tpu.utils.stats import about_eq, normalize_rows
+from keystone_tpu.workflow.fusion_rule import NodeFusionRule
+
+
+def test_node_fusion_rule_fuses_chain():
+    p = (RandomSignNode(8).to_pipeline() >> PaddedFFT() >> LinearRectifier())
+    from keystone_tpu.workflow.optimizer import DefaultOptimizer
+
+    graph, _ = DefaultOptimizer().execute(p.graph)
+    fused_nodes = [
+        n for n in graph.nodes
+        if isinstance(graph.get_operator(n), FusedBatchTransformer)
+    ]
+    assert len(fused_nodes) == 1
+    assert len(graph.get_operator(fused_nodes[0]).stages) == 3
+
+
+def test_fused_pipeline_output_matches_unfused():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    p = RandomSignNode(8).to_pipeline() >> PaddedFFT() >> LinearRectifier()
+    fused_out = p(Dataset(X)).get().numpy()
+    from keystone_tpu.workflow.optimizer import DefaultOptimizer
+
+    PipelineEnv.reset()
+    PipelineEnv.get().set_optimizer(DefaultOptimizer(fuse=False))
+    unfused_out = p(Dataset(X)).get().numpy()
+    np.testing.assert_allclose(fused_out, unfused_out, atol=1e-5)
+
+
+def test_fusion_not_applied_across_branches():
+    """A node with two consumers must not be absorbed into a chain."""
+    from keystone_tpu.workflow import Pipeline
+
+    shared = RandomSignNode(8)
+    p = Pipeline.gather([
+        shared.to_pipeline() >> LinearRectifier(),
+        shared.to_pipeline() >> LinearRectifier(1.0),
+    ])
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(16, 8)).astype(np.float32)
+    out = p(Dataset(X)).get()
+    assert out.count == 16  # executes correctly with branching
+
+
+def test_profiler_records_forced_nodes():
+    from keystone_tpu.utils.profiling import profile_execution
+
+    ds = Dataset(np.ones((16, 4), np.float32))
+    p = Transformer.from_function(lambda x: x * 2, name="double").to_pipeline()
+    with profile_execution() as prof:
+        p(ds).get()
+    assert any("double" in label for label in prof.profiles)
+    assert "seconds" in prof.report()
+
+
+def test_krr_checkpoint_resume(tmp_path):
+    from keystone_tpu.nodes.learning import KernelRidgeRegression
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    Y = rng.normal(size=(64, 2)).astype(np.float32)
+    full = KernelRidgeRegression(1.0, 0.5, block_size=16, num_epochs=2).fit(
+        Dataset(X), Dataset(Y)
+    )
+    # run with checkpointing every block; simulate crash by pre-seeding a
+    # mid-run checkpoint, then confirm the final model matches
+    ck = KernelRidgeRegression(
+        1.0, 0.5, block_size=16, num_epochs=2,
+        checkpoint_dir=str(tmp_path), blocks_before_checkpoint=1,
+    )
+    model = ck.fit(Dataset(X), Dataset(Y))
+    np.testing.assert_allclose(
+        np.asarray(model.alpha), np.asarray(full.alpha), atol=1e-5
+    )
+    # checkpoint removed after successful fit
+    import os
+
+    assert not any(f.endswith(".npz") for f in os.listdir(tmp_path))
+
+
+def test_vector_splitter_blocks():
+    X = np.arange(24, dtype=np.float32).reshape(4, 6)
+    blocks = VectorSplitter(4).apply_batch(Dataset(X))
+    assert [b.array.shape[1] for b in blocks] == [4, 2]
+    np.testing.assert_allclose(blocks[1].numpy(), X[:, 4:])
+
+
+def test_native_io_parity():
+    from keystone_tpu.utils import native_io
+
+    rng = np.random.default_rng(3)
+    rec = rng.integers(0, 256, size=(20, 3073), dtype=np.uint8)
+    imgs, labs = native_io.parse_cifar(rec)
+    ref = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32)
+    np.testing.assert_array_equal(imgs, ref)
+    np.testing.assert_array_equal(labs, rec[:, 0])
+
+
+def test_native_csv_parity(tmp_path):
+    from keystone_tpu.utils import native_io
+
+    X = np.random.default_rng(4).normal(size=(30, 5)).astype(np.float32)
+    path = str(tmp_path / "x.csv")
+    np.savetxt(path, X, delimiter=",", fmt="%.6f")
+    np.testing.assert_allclose(native_io.parse_csv(path), X, atol=1e-5)
+
+
+def test_annotators():
+    from keystone_tpu.nodes.nlp import NER, CoreNLPFeatureExtractor, POSTagger
+
+    pos = POSTagger().apply(["the", "cats", "ran", "quickly"])
+    assert pos[0][1] == "DT" and pos[3][1] == "RB"
+    ner = NER().apply(["Today", "Alice", "visited", "NASA"])
+    assert ner[1][1] == "ENTITY" and ner[3][1] == "ENTITY"
+    # note: sentence-initial TitleCase is deliberately demoted to O
+    feats = CoreNLPFeatureExtractor([1]).apply("yesterday Alice was running")
+    assert ("ENTITY",) in feats and ("run",) in feats
+
+
+def test_stats_helpers():
+    assert about_eq([1.0, 2.0], [1.0, 2.0 + 1e-10])
+    assert not about_eq([1.0], [1.1])
+    N = normalize_rows(np.array([[3.0, 4.0]]))
+    np.testing.assert_allclose(np.linalg.norm(N, axis=1), 1.0)
+
+
+def test_native_csv_rejects_empty_fields(tmp_path):
+    """',,' must error (fall back to loadtxt's ValueError), never shift
+    values across rows (review regression)."""
+    from keystone_tpu.utils import native_io
+
+    path = str(tmp_path / "bad.csv")
+    with open(path, "w") as f:
+        f.write("1.0,2.0,3.0\n4.0,,6.0\n7.0,8.0,9.0\n")
+    if native_io.available():
+        with pytest.raises(Exception):
+            native_io.parse_csv(path)
+
+
+def test_csv_loader_preserves_float64(tmp_path):
+    from keystone_tpu.loaders import csv_data_loader
+
+    path = str(tmp_path / "wide.csv")
+    with open(path, "w") as f:
+        f.write("1.0000000123,2.0\n3.0,4.0\n")
+    ds = csv_data_loader(path, dtype=np.float64)
+    assert ds.numpy()[0, 0] == 1.0000000123
+
+
+def test_krr_checkpoint_keyed_on_data(tmp_path):
+    """A checkpoint from dataset A must not resume a fit on same-shape
+    dataset B (review regression)."""
+    from keystone_tpu.nodes.learning import KernelRidgeRegression
+
+    rng = np.random.default_rng(9)
+    A = rng.normal(size=(32, 3)).astype(np.float32)
+    B = rng.normal(size=(32, 3)).astype(np.float32)
+    Y = rng.normal(size=(32, 2)).astype(np.float32)
+    est = KernelRidgeRegression(1.0, 0.5, block_size=32, num_epochs=1,
+                                checkpoint_dir=str(tmp_path))
+    pa = est._ckpt_path(Dataset(A), Dataset(Y))
+    pb = est._ckpt_path(Dataset(B), Dataset(Y))
+    assert pa != pb
