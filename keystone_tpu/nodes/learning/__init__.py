@@ -1,4 +1,9 @@
-from .linear import LinearMapEstimator, LinearMapper, LocalLeastSquaresEstimator
+from .linear import (
+    LinearMapEstimator,
+    LinearMapper,
+    LocalLeastSquaresEstimator,
+    SparseLinearMapper,
+)
 from .block_ls import BlockLeastSquaresEstimator, BlockLinearMapper
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
 from .least_squares import LeastSquaresEstimator

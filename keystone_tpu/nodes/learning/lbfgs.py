@@ -21,7 +21,7 @@ import optax
 
 from ...data.dataset import Dataset
 from ...workflow.pipeline import LabelEstimator
-from .linear import LinearMapper
+from .linear import LinearMapper, SparseLinearMapper
 
 
 @partial(jax.jit, static_argnames=("num_iters", "memory_size", "fit_intercept"))
@@ -167,12 +167,13 @@ class SparseLBFGSwithL2(LabelEstimator):
         self.block_rows = block_rows
         self.weight = 1  # one pass over the input
 
-    def fit(self, data, labels) -> LinearMapper:
+    def fit(self, data, labels) -> "LinearMapper | SparseLinearMapper":
         import numpy as np
 
         from ...data.sparse import SparseDataset
 
-        if isinstance(data, SparseDataset):
+        sparse_in = isinstance(data, SparseDataset)
+        if sparse_in:
             X = data.matrix
         else:
             X = data.numpy() if isinstance(data, Dataset) else np.asarray(data)
@@ -199,5 +200,5 @@ class SparseLBFGSwithL2(LabelEstimator):
         )
         if self.fit_intercept:
             b = jnp.asarray(ym) - jnp.asarray(xm) @ W
-            return LinearMapper(W, b)
-        return LinearMapper(W)
+            return SparseLinearMapper(W, b) if sparse_in else LinearMapper(W, b)
+        return SparseLinearMapper(W) if sparse_in else LinearMapper(W)

@@ -111,6 +111,50 @@ class LinearMapEstimator(LabelEstimator):
         return float(0.5 * jnp.sum(resid**2) + 0.5 * lam * jnp.sum(W**2))
 
 
+class SparseLinearMapper(Transformer):
+    """Apply a dense linear model to sparse inputs
+    (SparseLinearMapper.scala:13-50).
+
+    TPUs have no efficient sparse GEMM, so the product runs host-side as
+    CSR @ dense (the reference likewise keeps SparseVector dot products
+    on the JVM); the dense (n, k) result then moves to the device. For a
+    single datum the row's nonzeros index directly into W.
+    """
+
+    def __init__(self, W, b=None):
+        import numpy as np
+
+        self.W = np.asarray(W)
+        self.b = None if b is None else np.asarray(b)
+
+    def apply(self, x):
+        import numpy as np
+        import scipy.sparse as sp
+
+        if sp.issparse(x):
+            row = sp.csr_matrix(x)
+            if row.shape[0] == 1:
+                out = self.W[row.indices].T @ row.data
+            else:
+                out = np.asarray(row @ self.W)
+        else:
+            out = np.asarray(x) @ self.W
+        return out + self.b if self.b is not None else out
+
+    def apply_batch(self, data):
+        import numpy as np
+
+        from ...data.sparse import SparseDataset
+
+        if isinstance(data, SparseDataset):
+            out = np.asarray(data.matrix @ self.W, np.float32)
+            if self.b is not None:
+                out = out + self.b
+            return Dataset(out, mesh=data.mesh)
+        # Dense input: stay on device — same sharded GEMM as LinearMapper.
+        return LinearMapper(self.W, self.b).apply_batch(data)
+
+
 @jax.jit
 def _dual_solve(X, Y, mask, lam):
     with jax.default_matmul_precision("highest"):
