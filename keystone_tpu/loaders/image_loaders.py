@@ -37,7 +37,16 @@ def load_images_from_tar(
     max_images: Optional[int] = None,
 ) -> List[tuple]:
     """Stream a tar archive, decode images, attach label_fn(entry_name)
-    (ImageLoaderUtils.scala:56-94). Returns [(name, image, label)]."""
+    (ImageLoaderUtils.scala:56-94). Returns [(name, image, label)].
+
+    Fast path: for uncompressed tars of JPEGs, the native library indexes
+    the archive and decodes all entries across host threads
+    (native/keystone_io.cpp ks_tar_index/ks_jpeg_decode_batch); anything
+    it can't handle falls back to tarfile + PIL.
+    """
+    native = _load_tar_native(path, label_fn, max_images)
+    if native is not None:
+        return native
     out = []
     with tarfile.open(path, "r:*") as tar:
         for member in tar:
@@ -56,6 +65,55 @@ def load_images_from_tar(
             if max_images and len(out) >= max_images:
                 break
     return out
+
+
+def _load_tar_native(path, label_fn, max_images) -> Optional[List[tuple]]:
+    """Native tar index + threaded JPEG decode; None → fall back."""
+    from ..utils import native_io
+
+    import mmap
+
+    if not native_io.available():
+        return None
+    try:
+        with open(path, "rb") as f:
+            if f.read(2) == b"\x1f\x8b":  # gzip — let tarfile handle it
+                return None
+            # zero-copy view of the archive; decoded floats are the only
+            # large allocation
+            buf = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+    except (OSError, ValueError):
+        return None
+    try:
+        index = native_io.tar_index(buf)
+        if index is None:
+            return None
+        keep = []
+        for name, off, size in index:
+            label = label_fn(name)
+            if label is None or size < 4:
+                continue
+            if buf[off : off + 2] != b"\xff\xd8":  # not a JPEG
+                return None
+            keep.append((name, off, size, label))
+        out = []
+        # Decode in chunks so decode failures don't leave the result short
+        # of max_images while valid images remain (PIL-path parity).
+        chunk = max(2 * max_images, 256) if max_images else len(keep)
+        for start in range(0, len(keep), max(chunk, 1)):
+            part = keep[start : start + chunk]
+            images, _ = native_io.decode_jpeg_batch(
+                buf, [(o, s) for _, o, s, _ in part]
+            )
+            for (name, _, _, label), img in zip(part, images):
+                if img is None:
+                    continue
+                out.append((name, img, label))
+                if max_images and len(out) >= max_images:
+                    return out
+        return out
+    finally:
+        buf.close()
 
 
 def imagenet_loader(

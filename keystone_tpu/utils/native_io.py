@@ -51,8 +51,27 @@ def _lib() -> Optional[ctypes.CDLL]:
         lib.ks_tokenize_ws.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
         ]
+        lib.ks_tar_index.restype = ctypes.c_int64
+        lib.ks_tar_index.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.ks_jpeg_dims.restype = ctypes.c_int
+        lib.ks_jpeg_dims.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ks_jpeg_decode_batch.restype = ctypes.c_int64
+        lib.ks_jpeg_decode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: stale .so missing newer symbols — treat the
+        # whole native layer as unavailable rather than crash callers.
         _LIB = None
     return _LIB
 
@@ -107,6 +126,91 @@ def parse_csv(path: str, delimiter: str = ",") -> np.ndarray:
     if rc != 0:
         return np.loadtxt(path, delimiter=delimiter, dtype=np.float32, ndmin=2)
     return out
+
+
+_NAME_CAP = 512
+
+
+def tar_index(buf: bytes) -> Optional[list]:
+    """Index a tar archive held in memory: [(name, offset, size)] for
+    regular files. Offsets point into `buf` so entries slice zero-copy
+    (native analog of the reference's commons-compress streaming,
+    ImageLoaderUtils.scala:56-94). None → caller should use `tarfile`."""
+    lib = _lib()
+    if lib is None or not hasattr(lib, "ks_tar_index"):
+        return None
+    arr = np.frombuffer(buf, np.uint8)
+    cap = 1024
+    while True:
+        offsets = np.empty(cap, np.int64)
+        sizes = np.empty(cap, np.int64)
+        names = np.zeros((cap, _NAME_CAP), np.uint8)
+        n = lib.ks_tar_index(
+            arr.ctypes.data, arr.size, offsets.ctypes.data, sizes.ctypes.data,
+            names.ctypes.data, _NAME_CAP, cap,
+        )
+        if n < 0:
+            return None
+        if n <= cap:
+            break
+        cap = int(n)
+    out = []
+    for i in range(int(n)):
+        raw = names[i].tobytes().split(b"\0", 1)[0]
+        out.append((raw.decode("utf-8", errors="replace"), int(offsets[i]),
+                    int(sizes[i])))
+    return out
+
+
+def decode_jpeg_batch(buf, entries, num_threads: Optional[int] = None):
+    """Decode many JPEGs from one backing buffer in parallel.
+
+    `entries` is [(offset, size)] into `buf`. Returns (images, ok) where
+    images is a list of float32 HWC arrays (None where decode failed).
+    Returns None if the native library is unavailable.
+    """
+    lib = _lib()
+    if lib is None or not hasattr(lib, "ks_jpeg_decode_batch"):
+        return None
+    arr = np.frombuffer(buf, np.uint8)
+    n = len(entries)
+    if n == 0:
+        return [], 0
+    offsets = np.array([e[0] for e in entries], np.int64)
+    sizes = np.array([e[1] for e in entries], np.int64)
+    # Pass 1: header-only dims scan (cheap) to size the output exactly.
+    caps = np.empty(n, np.int64)
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    c = ctypes.c_int32()
+    for i in range(n):
+        rc = lib.ks_jpeg_dims(
+            arr.ctypes.data + int(offsets[i]), int(sizes[i]),
+            ctypes.byref(h), ctypes.byref(w), ctypes.byref(c),
+        )
+        caps[i] = h.value * w.value * 3 if rc == 0 else 0
+    out_offsets = np.zeros(n, np.int64)
+    np.cumsum(caps[:-1], out=out_offsets[1:])
+    out = np.empty(int(caps.sum()), np.float32)
+    dims = np.zeros((n, 3), np.int32)
+    status = np.full(n, 1, np.int32)
+    ok = lib.ks_jpeg_decode_batch(
+        arr.ctypes.data, offsets.ctypes.data, sizes.ctypes.data, n,
+        out.ctypes.data, out_offsets.ctypes.data, caps.ctypes.data,
+        dims.ctypes.data, status.ctypes.data,
+        num_threads or _threads(),
+    )
+    images = []
+    for i in range(n):
+        if status[i] == 0:
+            hh, ww, cc = (int(x) for x in dims[i])
+            images.append(
+                out[out_offsets[i] : out_offsets[i] + hh * ww * cc]
+                .reshape(hh, ww, cc).copy()
+            )
+        else:
+            images.append(None)
+    return images, int(ok)
 
 
 def tokenize_ws(text: str) -> list:

@@ -189,4 +189,230 @@ int64_t ks_tokenize_ws(const char* buf, int64_t len,
   return count;
 }
 
+// ------------------------------------------------------------- tar (ustar)
+//
+// The reference streams training archives with commons-compress
+// (loaders/ImageLoaderUtils.scala:56-94). Here: an in-memory ustar index
+// over an mmap'able buffer — offsets let Python slice entries zero-copy.
+
+static int64_t tar_octal(const uint8_t* p, int n) {
+  // GNU base-256 extension: high bit of first byte set.
+  if (p[0] & 0x80) {
+    int64_t v = p[0] & 0x7f;
+    for (int i = 1; i < n; ++i) v = (v << 8) | p[i];
+    return v;
+  }
+  int64_t v = 0;
+  for (int i = 0; i < n; ++i) {
+    const uint8_t c = p[i];
+    if (c == 0 || c == ' ') { if (v) break; else continue; }
+    if (c < '0' || c > '7') return -1;
+    v = v * 8 + (c - '0');
+  }
+  return v;
+}
+
+// Scan a tar buffer. Fills up to `cap` entries: data offset, data size,
+// and the entry name (NUL-terminated, truncated to name_cap incl. NUL).
+// Returns the total number of regular-file entries (may exceed cap), or
+// -1 on a malformed archive.
+int64_t ks_tar_index(const uint8_t* buf, int64_t len, int64_t* out_offsets,
+                     int64_t* out_sizes, char* out_names, int64_t name_cap,
+                     int64_t cap) {
+  if (!buf || len < 0) return -1;
+  int64_t pos = 0, count = 0;
+  char longname[4096];
+  bool have_longname = false;
+  while (pos + 512 <= len) {
+    const uint8_t* h = buf + pos;
+    bool empty = true;
+    for (int i = 0; i < 512 && empty; ++i) empty = (h[i] == 0);
+    if (empty) break;  // end-of-archive marker
+    const int64_t size = tar_octal(h + 124, 12);
+    // overflow-safe bounds check (size can be attacker-controlled)
+    if (size < 0 || size > len - 512 - pos) return -1;
+    const uint8_t type = h[156];
+    const int64_t data = pos + 512;
+    if (type == 'L') {  // GNU longname: data block holds the real name
+      int64_t m = size < (int64_t)sizeof(longname) - 1
+                      ? size : (int64_t)sizeof(longname) - 1;
+      memcpy(longname, buf + data, m);
+      longname[m] = 0;
+      have_longname = true;
+    } else if (type == 'x' || type == 'X') {
+      // PAX extended header (Python tarfile's default format): records are
+      // "<len> key=value\n"; a "path" record overrides the next entry's name.
+      const uint8_t* p = buf + data;
+      int64_t rem = size;
+      while (rem > 0) {
+        int64_t rl = 0, di = 0;
+        while (di < rem && p[di] >= '0' && p[di] <= '9') {
+          rl = rl * 10 + (p[di] - '0');
+          ++di;
+        }
+        if (di >= rem || p[di] != ' ' || rl <= 0 || rl > rem) break;
+        const uint8_t* kv = p + di + 1;
+        const int64_t kvlen = rl - di - 1;
+        if (kvlen > 5 && memcmp(kv, "path=", 5) == 0) {
+          int64_t m = kvlen - 5;
+          if (m > 0 && kv[5 + m - 1] == '\n') --m;
+          if (m > (int64_t)sizeof(longname) - 1) m = sizeof(longname) - 1;
+          memcpy(longname, kv + 5, m);
+          longname[m] = 0;
+          have_longname = true;
+        }
+        p += rl;
+        rem -= rl;
+      }
+    } else if (type == 0 || type == '0') {  // regular file
+      if (count < cap) {
+        out_offsets[count] = data;
+        out_sizes[count] = size;
+        char* dst = out_names + count * name_cap;
+        if (have_longname) {
+          strncpy(dst, longname, name_cap - 1);
+          dst[name_cap - 1] = 0;
+        } else {
+          // POSIX ustar ("ustar\0"): optional 155-byte prefix at 345.
+          // Old-GNU ("ustar  ") reuses that region for atime — skip it.
+          char name[101], prefix[156];
+          memcpy(name, h, 100); name[100] = 0;
+          memcpy(prefix, h + 345, 155); prefix[155] = 0;
+          const bool posix_ustar = memcmp(h + 257, "ustar\0", 6) == 0;
+          if (posix_ustar && prefix[0])
+            snprintf(dst, name_cap, "%s/%s", prefix, name);
+          else {
+            strncpy(dst, name, name_cap - 1);
+            dst[name_cap - 1] = 0;
+          }
+        }
+      }
+      have_longname = false;
+      ++count;
+    } else if (type != 'g') {
+      // 'g' (pax global) keeps any pending longname; others consume it
+      have_longname = false;
+    }
+    pos = data + ((size + 511) / 512) * 512;
+  }
+  return count;
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------ JPEG decode
+
+#include <csetjmp>
+#include <jpeglib.h>
+
+namespace {
+struct KsJpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+void ks_jpeg_error_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<KsJpegErr*>(cinfo->err)->jump, 1);
+}
+
+// Decode one JPEG to float32 RGB HWC into out (capacity out_cap floats).
+// Writes dims; returns 0 ok, 1 decode error, 2 capacity exceeded.
+int decode_one(const uint8_t* data, int64_t len, float* out, int64_t out_cap,
+               int32_t* h, int32_t* w, int32_t* c) {
+  jpeg_decompress_struct cinfo;
+  KsJpegErr jerr;
+  // Declared before setjmp: a longjmp from mid-decode must not skip the
+  // destructor of a live vector (UB + leak per corrupt image).
+  std::vector<uint8_t> row;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = ks_jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int W = cinfo.output_width, H = cinfo.output_height;
+  const int C = cinfo.output_components;  // 3 after JCS_RGB
+  *h = H; *w = W; *c = C;
+  if (static_cast<int64_t>(H) * W * C > out_cap) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  row.resize(static_cast<size_t>(W) * C);
+  uint8_t* rowp = row.data();
+  while (cinfo.output_scanline < cinfo.output_height) {
+    const int y = cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &rowp, 1);
+    float* o = out + static_cast<int64_t>(y) * W * C;
+    for (int i = 0; i < W * C; ++i) o[i] = static_cast<float>(rowp[i]);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+}  // namespace
+
+extern "C" {
+
+// Header-only scan: dims of one JPEG without full decode.
+int ks_jpeg_dims(const uint8_t* data, int64_t len, int32_t* h, int32_t* w,
+                 int32_t* c) {
+  jpeg_decompress_struct cinfo;
+  KsJpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = ks_jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  *c = 3;  // decoded as JCS_RGB
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Multithreaded batch decode from one backing buffer (e.g. a tar file):
+// image i lives at buf[offsets[i] : offsets[i]+sizes[i]] and decodes into
+// out[out_offsets[i] : out_offsets[i]+out_caps[i]] (float32 RGB HWC).
+// Per-image status in out_status (0 ok / 1 bad jpeg / 2 overflow); dims in
+// out_dims (n x 3: h, w, c). Returns count of successful decodes.
+int64_t ks_jpeg_decode_batch(const uint8_t* buf, const int64_t* offsets,
+                             const int64_t* sizes, int64_t n, float* out,
+                             const int64_t* out_offsets,
+                             const int64_t* out_caps, int32_t* out_dims,
+                             int32_t* out_status, int num_threads) {
+  if (!buf || !offsets || !sizes || !out || n < 0) return -1;
+  if (num_threads < 1) num_threads = 1;
+  std::atomic<int64_t> next(0), ok(0);
+  auto worker = [&]() {
+    for (;;) {
+      const int64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      int32_t h = 0, w = 0, c = 0;
+      const int rc = decode_one(buf + offsets[i], sizes[i],
+                                out + out_offsets[i], out_caps[i], &h, &w, &c);
+      out_dims[3 * i] = h; out_dims[3 * i + 1] = w; out_dims[3 * i + 2] = c;
+      out_status[i] = rc;
+      if (rc == 0) ok.fetch_add(1);
+    }
+  };
+  if (num_threads == 1 || n < 2) {
+    worker();
+  } else {
+    std::vector<std::thread> ts;
+    const int t = static_cast<int>(std::min<int64_t>(num_threads, n));
+    for (int k = 0; k < t; ++k) ts.emplace_back(worker);
+    for (auto& th : ts) th.join();
+  }
+  return ok.load();
+}
+
 }  // extern "C"

@@ -1,0 +1,163 @@
+"""Native host data-plane tests (model: reference VLFeatSuite/EncEvalSuite,
+which exercise the JNI boundary against real fixtures — here the ctypes
+boundary of native/keystone_io.cpp, with pure-Python paths as the oracle).
+
+Skip cleanly when the native build is absent (`make -C native`).
+"""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.utils import native_io
+
+pytestmark = pytest.mark.skipif(
+    not native_io.available(), reason="native library not built"
+)
+
+
+def _jpeg_bytes(rng, h, w):
+    from PIL import Image
+
+    img = Image.fromarray(rng.integers(0, 255, (h, w, 3), dtype=np.uint8))
+    bio = io.BytesIO()
+    img.save(bio, format="JPEG", quality=95)
+    return bio.getvalue()
+
+
+@pytest.fixture
+def jpeg_tar(tmp_path):
+    """A tar of JPEGs in class subdirectories + one non-image entry."""
+    rng = np.random.default_rng(0)
+    path = tmp_path / "imgs.tar"
+    blobs = {}
+    with tarfile.open(path, "w") as tar:
+        for i, (cls, h, w) in enumerate(
+            [("cat", 24, 32), ("cat", 40, 40), ("dog", 32, 24), ("dog", 28, 36)]
+        ):
+            data = _jpeg_bytes(rng, h, w)
+            name = f"{cls}/img{i}.jpg"
+            blobs[name] = data
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tar.addfile(ti, io.BytesIO(data))
+        meta = b"not an image"
+        ti = tarfile.TarInfo("README.txt")
+        ti.size = len(meta)
+        tar.addfile(ti, io.BytesIO(meta))
+    return path, blobs
+
+
+def test_tar_index_matches_tarfile(jpeg_tar):
+    path, blobs = jpeg_tar
+    buf = path.read_bytes()
+    index = native_io.tar_index(buf)
+    assert index is not None
+    names = [n for n, _, _ in index]
+    assert names == list(blobs.keys()) + ["README.txt"]
+    for name, off, size in index:
+        expected = blobs.get(name, b"not an image")
+        assert buf[off : off + size] == expected
+
+
+def test_tar_index_pax_long_names(tmp_path):
+    """Python tarfile writes PAX; names >100 chars live in 'x' headers."""
+    long_name = "a" * 80 + "/" + "b" * 80 + "/img.jpg"
+    rng = np.random.default_rng(3)
+    data = _jpeg_bytes(rng, 16, 16)
+    path = tmp_path / "long.tar"
+    with tarfile.open(path, "w", format=tarfile.PAX_FORMAT) as tar:
+        ti = tarfile.TarInfo(long_name)
+        ti.size = len(data)
+        tar.addfile(ti, io.BytesIO(data))
+    buf = path.read_bytes()
+    index = native_io.tar_index(buf)
+    assert index is not None and len(index) == 1
+    name, off, size = index[0]
+    assert name == long_name
+    assert buf[off : off + size] == data
+
+
+def test_tar_index_gnu_long_names(tmp_path):
+    long_name = "g" * 120 + "/img.bin"
+    path = tmp_path / "gnu.tar"
+    payload = b"\xff" * 100
+    with tarfile.open(path, "w", format=tarfile.GNU_FORMAT) as tar:
+        ti = tarfile.TarInfo(long_name)
+        ti.size = len(payload)
+        tar.addfile(ti, io.BytesIO(payload))
+    index = native_io.tar_index(path.read_bytes())
+    assert index is not None and len(index) == 1
+    assert index[0][0] == long_name
+
+
+def test_jpeg_batch_decode_matches_pil(jpeg_tar):
+    from PIL import Image
+
+    path, blobs = jpeg_tar
+    buf = path.read_bytes()
+    index = {n: (o, s) for n, o, s in native_io.tar_index(buf)}
+    entries = [index[n] for n in blobs]
+    images, ok = native_io.decode_jpeg_batch(buf, entries)
+    assert ok == len(blobs)
+    for img, data in zip(images, blobs.values()):
+        ref = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"), np.float32)
+        assert img.shape == ref.shape
+        # libjpeg and PIL share the decode path; allow tiny IDCT drift
+        assert np.abs(img - ref).max() <= 1.0
+
+
+def test_jpeg_batch_flags_corrupt_entry(jpeg_tar):
+    path, blobs = jpeg_tar
+    buf = bytearray(path.read_bytes())
+    index = {n: (o, s) for n, o, s in native_io.tar_index(bytes(buf))}
+    entries = [index[n] for n in blobs]
+    # corrupt the second image's entropy data (past the SOI marker)
+    off, size = entries[1]
+    buf[off + size // 2 : off + size // 2 + 64] = b"\0" * 64
+    images, ok = native_io.decode_jpeg_batch(bytes(buf), entries)
+    assert ok >= len(blobs) - 1
+    assert images[0] is not None
+
+
+def test_load_images_from_tar_native_path(jpeg_tar):
+    from keystone_tpu.loaders.image_loaders import load_images_from_tar
+
+    path, blobs = jpeg_tar
+
+    def label_fn(name):
+        return name.split("/")[0] if name.endswith(".jpg") else None
+
+    out = load_images_from_tar(str(path), label_fn)
+    assert [n for n, _, _ in out] == list(blobs.keys())
+    assert all(img.dtype == np.float32 and img.ndim == 3 for _, img, _ in out)
+    assert [lab for _, _, lab in out] == ["cat", "cat", "dog", "dog"]
+
+
+def test_cifar_native_matches_numpy():
+    rng = np.random.default_rng(1)
+    records = rng.integers(0, 256, (64, 3073), dtype=np.uint8)
+    imgs, labels = native_io.parse_cifar(records)
+    ref_labels = records[:, 0].astype(np.int32)
+    ref_imgs = (
+        records[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32)
+    )
+    np.testing.assert_array_equal(labels, ref_labels)
+    np.testing.assert_array_equal(imgs, ref_imgs)
+
+
+def test_csv_native_matches_loadtxt(tmp_path):
+    rng = np.random.default_rng(2)
+    ref = rng.normal(size=(50, 7)).astype(np.float32)
+    p = tmp_path / "m.csv"
+    np.savetxt(p, ref, delimiter=",", fmt="%.6f")
+    out = native_io.parse_csv(str(p))
+    np.testing.assert_allclose(out, np.loadtxt(p, delimiter=",", dtype=np.float32), atol=1e-5)
+
+
+def test_tokenize_ws_matches_split():
+    text = "  the quick\nbrown\tfox  jumps \r\n over  "
+    assert native_io.tokenize_ws(text) == text.split()
