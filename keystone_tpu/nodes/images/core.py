@@ -117,6 +117,14 @@ class Convolver(Transformer):
             imgs, self.kernel, self.colsum, self.bias, self.normalize_patches
         )
 
+    def fuse(self):
+        normalize = self.normalize_patches
+        return (
+            ("Convolver", normalize),
+            (self.kernel, self.colsum, self.bias),
+            lambda p, xb: _convolve.__wrapped__(xb, p[0], p[1], p[2], normalize),
+        )
+
     def apply_batch(self, data: Dataset):
         return data.map_batches(self.batch_fn(), jitted=False)
 
@@ -142,6 +150,17 @@ class SymmetricRectifier(Transformer):
 
     def batch_fn(self):
         return self.apply  # elementwise: batched arrays work directly
+
+    def fuse(self):
+        max_val, alpha = self.max_val, self.alpha
+        return (
+            ("SymmetricRectifier", max_val, alpha),
+            (),
+            lambda p, x: jnp.concatenate(
+                [jnp.maximum(max_val, x - alpha), jnp.maximum(max_val, -x - alpha)],
+                axis=-1,
+            ),
+        )
 
 
 class Pooler(Transformer):
@@ -184,6 +203,16 @@ class Pooler(Transformer):
 
         return fn
 
+    def fuse(self):
+        # arbitrary pixel_fn callables get no shared key (instance-cached)
+        key = (
+            ("opaque", id(self))
+            if self.pixel_fn is not None
+            else ("Pooler", self.stride, self.pool_size, self.pool_fn)
+        )
+        fn = self.batch_fn()
+        return (key, (), lambda p, x: fn(x))
+
 
 class ImageVectorizer(Transformer):
     """(H, W, C) → flat vector (ImageVectorizer.scala:12)."""
@@ -196,6 +225,9 @@ class ImageVectorizer(Transformer):
     def batch_fn(self):
         return lambda x: x.reshape(x.shape[0], -1)
 
+    def fuse(self):
+        return (("ImageVectorizer",), (), lambda p, x: x.reshape(x.shape[0], -1))
+
 
 class PixelScaler(Transformer):
     """x / 255 (PixelScaler.scala:9)."""
@@ -207,6 +239,13 @@ class PixelScaler(Transformer):
 
     def batch_fn(self):
         return self.apply
+
+    def fuse(self):
+        return (
+            ("PixelScaler",),
+            (),
+            lambda p, x: jnp.asarray(x, jnp.float32) / 255.0,
+        )
 
 
 class GrayScaler(Transformer):

@@ -37,6 +37,15 @@ class ZCAWhitener(Transformer):
         )
 
 
+def zca_from_covariance(cov: np.ndarray, eps: float) -> np.ndarray:
+    """Whitening matrix from a D×D covariance: V diag((λ+ε)^-½) Vᵀ
+    (ZCAWhitener.scala:53-60). Shared by the sample-matrix fit below and
+    the moments-based on-device path (pipelines/random_patch_cifar.py)."""
+    lams, V = np.linalg.eigh(cov)
+    scale = 1.0 / np.sqrt(np.maximum(lams, 0.0) + eps)
+    return ((V * scale) @ V.T).astype(np.float32)
+
+
 def _fit_zca_np(X: np.ndarray, eps: float):
     """Host eigendecomposition (D×D is small; the reference also fits on
     the driver via LAPACK, ZCAWhitener.scala:53-60)."""
@@ -44,10 +53,7 @@ def _fit_zca_np(X: np.ndarray, eps: float):
     mu = X.mean(axis=0)
     Xc = X - mu
     cov = (Xc.T @ Xc) / max(n - 1.0, 1.0)
-    lams, V = np.linalg.eigh(cov)
-    scale = 1.0 / np.sqrt(np.maximum(lams, 0.0) + eps)
-    W = (V * scale) @ V.T
-    return W.astype(np.float32), mu.astype(np.float32)
+    return zca_from_covariance(cov, eps), mu.astype(np.float32)
 
 
 class ZCAWhitenerEstimator(Estimator):

@@ -36,6 +36,29 @@ def _stage_batch_fn(stage: Transformer):
     return jax.vmap(stage.apply)
 
 
+def _stage_fuse(stage: Transformer):
+    """Decompose a stage into (static_key, params_pytree, pure_fn) where
+    ``pure_fn(params, xb) -> yb``.
+
+    Stages implementing ``fuse()`` get cross-instance program caching:
+    two pipelines with the same structure but different parameter VALUES
+    share one compiled XLA program (params are traced arguments, not
+    baked constants). Stages without it fall back to a closure keyed on
+    object identity — correct, but compiled per instance.
+    """
+    f = getattr(stage, "fuse", None)
+    if f is not None:
+        return f()
+    fn = _stage_batch_fn(stage)
+    return (("opaque", id(stage)), (), lambda params, xb: fn(xb))
+
+
+# (structure key) -> jitted program. Programs take (flat_params, xs) so
+# rebuilding a pipeline — the bench re-fits from scratch — never
+# recompiles the featurizer.
+_PROGRAM_CACHE: dict = {}
+
+
 class FusedBatchTransformer(Transformer):
     """Compose device transformer stages into one microbatched program.
 
@@ -57,33 +80,46 @@ class FusedBatchTransformer(Transformer):
             x = s.apply(x)
         return x
 
-    def _fused_chunk_fn(self):
-        fns = [_stage_batch_fn(s) for s in self.stages]
-
-        def chunk_fn(xb):
-            for f in fns:
-                xb = f(xb)
-            return xb
-
-        return chunk_fn
-
     def apply_batch(self, data):
-        from ...data.dataset import HostDataset
-
         if not isinstance(data, Dataset):
             # host/object datasets: run the stages' own batch paths
             for s in self.stages:
                 data = s.apply_batch(data)
             return data
-        key = ("_fused_program", data.padded_count, data.n_shards)
-        program = self.__dict__.get("_program_cache", {}).get(key)
-        if program is None:
-            program = self._build_program(data)
-            self.__dict__.setdefault("_program_cache", {})[key] = program
-        return data.with_data(program(data.array))
 
-    def _build_program(self, data: Dataset):
-        chunk_fn = self._fused_chunk_fn()
+        fused = [_stage_fuse(s) for s in self.stages]
+        statics = tuple(f[0] for f in fused)
+        params = tuple(f[1] for f in fused)
+        fns = tuple(f[2] for f in fused)
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        key = (
+            statics,
+            treedef,
+            tuple((tuple(p.shape), jnp.asarray(p).dtype.name) for p in flat),
+            tuple(data.array.shape),
+            data.array.dtype.name,
+            data.padded_count,
+            data.n_shards,
+            min(self.microbatch, data.padded_count // data.n_shards),
+            data.mesh,
+        )
+        # Opaque stages are keyed on object identity: caching those
+        # globally would pin the stage (and its captured arrays) forever
+        # and make the id-keyed entry unsafe after GC reuses the id. Keep
+        # such programs on THIS instance instead.
+        opaque = any(s[0] == "opaque" for s in statics)
+        cache = (
+            self.__dict__.setdefault("_instance_programs", {})
+            if opaque
+            else _PROGRAM_CACHE
+        )
+        program = cache.get(key)
+        if program is None:
+            program = self._build_program(data, treedef, fns)
+            cache[key] = program
+        return data.with_data(program(flat, data.array))
+
+    def _build_program(self, data: Dataset, treedef, fns):
         mesh = data.mesh
         shards = data.n_shards
         local_n = data.padded_count // shards
@@ -91,30 +127,38 @@ class FusedBatchTransformer(Transformer):
         n_chunks = -(-local_n // chunk)
         padded_local = n_chunks * chunk
 
-        def per_shard(xs):  # xs: (local_n, ...) — this shard's rows
+        def chunk_fn(params, xb):
+            for f, p in zip(fns, params):
+                xb = f(p, xb)
+            return xb
+
+        def per_shard(flat_params, xs):  # xs: (local_n, ...) — shard rows
+            params = jax.tree_util.tree_unflatten(treedef, flat_params)
             if padded_local != local_n:
                 pad = [(0, padded_local - local_n)] + [(0, 0)] * (xs.ndim - 1)
                 xs = jnp.pad(xs, pad)
             xs = xs.reshape((n_chunks, chunk) + xs.shape[1:])
-            ys = lax.map(chunk_fn, xs)  # sequential chunks: bounded HBM
+            # sequential chunks: bounded HBM
+            ys = lax.map(lambda xb: chunk_fn(params, xb), xs)
             ys = ys.reshape((padded_local,) + ys.shape[2:])
             return ys[:local_n]
 
         if shards > 1:
             spec = P(meshlib.DATA_AXIS)
+            flat_specs = [P()] * treedef.num_leaves
             try:
                 from jax import shard_map
 
                 fn = shard_map(
-                    per_shard, mesh=mesh, in_specs=(spec,), out_specs=spec,
-                    check_vma=False,
+                    per_shard, mesh=mesh, in_specs=(flat_specs, spec),
+                    out_specs=spec, check_vma=False,
                 )
             except ImportError:  # older jax: experimental API, check_rep kwarg
                 from jax.experimental.shard_map import shard_map
 
                 fn = shard_map(
-                    per_shard, mesh=mesh, in_specs=(spec,), out_specs=spec,
-                    check_rep=False,
+                    per_shard, mesh=mesh, in_specs=(flat_specs, spec),
+                    out_specs=spec, check_rep=False,
                 )
         else:
             fn = per_shard

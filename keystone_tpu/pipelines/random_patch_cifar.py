@@ -36,11 +36,10 @@ from ..nodes.images.core import (
     SymmetricRectifier,
 )
 from ..nodes.learning import BlockLeastSquaresEstimator
-from ..nodes.learning.zca import ZCAWhitenerEstimator
+from ..nodes.learning.zca import ZCAWhitener, zca_from_covariance
 from ..nodes.stats import StandardScaler
 from ..nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
 from ..nodes.util.fusion import FusedBatchTransformer
-from ..utils.images import extract_patches
 from ..workflow import Pipeline
 
 
@@ -65,39 +64,98 @@ class RandomPatchCifarConfig:
     synth_test: int = 500
 
 
+def _sampled_patch_moments(images, idx, sub_idx, patch: int, step: int):
+    """On-device: gather sampled images, extract normalized patches, and
+    return (patches, sum, Gram) so only D-sized stats cross the tunnel."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    sel = jnp.take(images, idx, axis=0) / 255.0
+    c = sel.shape[-1]
+    pats = lax.conv_general_dilated_patches(
+        sel, (patch, patch), (step, step), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=lax.Precision.HIGHEST,  # identity conv must be exact
+    )
+    # feature dim is (C, ph, pw); reorder to the (ph, pw, C) flat layout
+    # used everywhere else (utils.images.extract_patches)
+    gy, gx = pats.shape[1], pats.shape[2]
+    pats = pats.reshape(-1, c, patch, patch).transpose(0, 2, 3, 1)
+    flat = pats.reshape(idx.shape[0] * gy * gx, patch * patch * c)
+    flat = jnp.take(flat, sub_idx, axis=0)
+    # normalizeRows(_, 10.0): subtract patch mean, divide by max(norm, 10/255)
+    flat = flat - flat.mean(axis=1, keepdims=True)
+    norms = jnp.linalg.norm(flat, axis=1, keepdims=True)
+    flat = flat / jnp.maximum(norms, 10.0 / 255.0)
+    # true-f32 Gram: TPU default matmul precision is bf16-based, which
+    # would corrupt the small eigenvalues the ZCA whitener depends on
+    gram = jnp.matmul(flat.T, flat, precision=lax.Precision.HIGHEST)
+    return flat, flat.sum(axis=0), gram
+
+
+_sampled_patch_moments_jit = None
+
+
+def _whiten_and_select(flat, W, mu, filter_idx):
+    import jax.numpy as jnp
+    from jax import lax
+
+    whitened = jnp.matmul(flat - mu, W, precision=lax.Precision.HIGHEST)
+    wnorms = jnp.linalg.norm(whitened, axis=1, keepdims=True)
+    whitened = whitened / jnp.maximum(wnorms, 1e-8)
+    return jnp.take(whitened, filter_idx, axis=0)
+
+
+_whiten_and_select_jit = None
+
+
 def learn_filters(train_data: Dataset, config) -> tuple:
     """Whitened random-patch filter learning (reference :45-57).
 
-    Runs entirely host-side on a small image sample — only the sampled
-    images cross the device boundary (the full dataset stays sharded on
-    the mesh; collects are expensive, especially over a TPU tunnel).
-    This mirrors the reference's driver-side LAPACK filter learning.
+    TPU-first: patch extraction, normalization, and the patch Gram matrix
+    all run on-device; only the D×D covariance (for the host eigh — the
+    reference's driver-side LAPACK step, ZCAWhitener.scala:53-60) and the
+    final (num_filters × D) filter bank cross the device boundary.
     """
+    global _sampled_patch_moments_jit, _whiten_and_select_jit
+    import jax
     import jax.numpy as jnp
+
+    if _sampled_patch_moments_jit is None:
+        _sampled_patch_moments_jit = jax.jit(
+            _sampled_patch_moments, static_argnames=("patch", "step")
+        )
+        _whiten_and_select_jit = jax.jit(_whiten_and_select)
 
     rng = np.random.default_rng(config.seed)
     n = train_data.count
     n_sample = min(n, max(config.sample_patches // 100, 64))
     idx = np.sort(rng.choice(n, size=n_sample, replace=False))
-    sample_imgs = np.asarray(jnp.take(train_data.array, idx, axis=0)) / 255.0
+    h, w = train_data.array.shape[1:3]
+    gy = (h - config.patch_size) // config.patch_steps + 1
+    gx = (w - config.patch_size) // config.patch_steps + 1
+    total = n_sample * gy * gx
+    m = min(total, config.sample_patches)
+    sub_idx = rng.choice(total, size=m, replace=False)
 
-    patches = extract_patches(sample_imgs, config.patch_size, config.patch_steps)
-    if patches.shape[0] > config.sample_patches:
-        patches = patches[
-            rng.choice(patches.shape[0], config.sample_patches, replace=False)
-        ]
-    # normalizeRows(_, 10.0): subtract patch mean, divide by max(norm, 10/255)
-    patches = patches - patches.mean(axis=1, keepdims=True)
-    norms = np.linalg.norm(patches, axis=1, keepdims=True)
-    patches = (patches / np.maximum(norms, 10.0 / 255.0)).astype(np.float32)
+    flat, psum, gram = _sampled_patch_moments_jit(
+        train_data.array, jnp.asarray(idx), jnp.asarray(sub_idx),
+        patch=config.patch_size, step=config.patch_steps,
+    )
+    psum = np.asarray(psum, np.float64)
+    gram = np.asarray(gram, np.float64)
+    mu = psum / m
+    cov = (gram - m * np.outer(mu, mu)) / max(m - 1.0, 1.0)
+    W = zca_from_covariance(cov, eps=0.1)
+    mu = mu.astype(np.float32)
+    whitener = ZCAWhitener(W, mu)
 
-    whitener = ZCAWhitenerEstimator(eps=0.1).fit_single(patches)
-    whitened = (patches - whitener.means_np) @ whitener.whitener_np
-    wnorms = np.linalg.norm(whitened, axis=1, keepdims=True)
-    whitened = whitened / np.maximum(wnorms, 1e-8)
-    filters = whitened[
-        rng.choice(whitened.shape[0], config.num_filters, replace=False)
-    ]
+    filter_idx = rng.choice(m, size=config.num_filters, replace=False)
+    filters = np.asarray(
+        _whiten_and_select_jit(
+            flat, whitener.whitener, whitener.means, jnp.asarray(filter_idx)
+        )
+    )
     return filters, whitener
 
 

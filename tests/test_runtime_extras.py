@@ -173,3 +173,66 @@ def test_krr_checkpoint_keyed_on_data(tmp_path):
     pa = est._ckpt_path(Dataset(A), Dataset(Y))
     pb = est._ckpt_path(Dataset(B), Dataset(Y))
     assert pa != pb
+
+
+def test_fused_program_shared_across_instances():
+    """Two pipelines with the same structure but different parameter
+    values must share ONE compiled program (params are traced arguments,
+    not baked constants) — rebuilding a pipeline never recompiles."""
+    from keystone_tpu.nodes.images.core import Convolver, SymmetricRectifier
+    from keystone_tpu.nodes.util import fusion
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, size=(16, 12, 12, 3)).astype(np.float32)
+
+    def build(seed):
+        filters = np.random.default_rng(seed).normal(size=(8, 6 * 6 * 3)).astype(np.float32)
+        return FusedBatchTransformer(
+            [
+                PixelScaler(),
+                Convolver(filters, 12, 12, 3, normalize_patches=True),
+                SymmetricRectifier(alpha=0.1),
+                Pooler(3, 4, pool_fn="sum"),
+                ImageVectorizer(),
+            ],
+            microbatch=8,
+        )
+
+    fusion._PROGRAM_CACHE.clear()
+    out1 = build(1).apply_batch(Dataset(imgs)).numpy()
+    assert len(fusion._PROGRAM_CACHE) == 1
+    out2 = build(2).apply_batch(Dataset(imgs)).numpy()
+    assert len(fusion._PROGRAM_CACHE) == 1  # cache hit, no new program
+    assert not np.allclose(out1, out2)  # different params flowed through
+    out1_again = build(1).apply_batch(Dataset(imgs)).numpy()
+    np.testing.assert_allclose(out1, out1_again, atol=1e-5)
+
+
+def test_device_filter_learning_matches_host_reference():
+    """learn_filters' on-device patch/moments path must reproduce the
+    host-side extract_patches + ZCA math (reference driver-side filter
+    learning, RandomPatchCifar.scala:45-57)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.pipelines.random_patch_cifar import (
+        RandomPatchCifarConfig,
+        learn_filters,
+    )
+    from keystone_tpu.utils.images import extract_patches
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 255, size=(64, 16, 16, 3)).astype(np.float32)
+    train = Dataset(imgs)
+    config = RandomPatchCifarConfig(
+        num_filters=8, patch_size=6, sample_patches=6400
+    )
+    filters, whitener = learn_filters(train, config)
+    assert filters.shape == (8, 6 * 6 * 3)
+    # whitener decorrelates: covariance of whitened sample ≈ scaled identity
+    pats = extract_patches(np.asarray(train.array) / 255.0, 6, 1)
+    pats = pats - pats.mean(axis=1, keepdims=True)
+    pats = pats / np.maximum(np.linalg.norm(pats, axis=1, keepdims=True), 10 / 255)
+    wh = (pats - whitener.means_np) @ whitener.whitener_np
+    cov = np.cov(wh.T)
+    off = cov - np.diag(np.diag(cov))
+    assert np.abs(off).max() < 0.1 * np.abs(np.diag(cov)).max()
