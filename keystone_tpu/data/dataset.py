@@ -98,8 +98,13 @@ class Dataset:
 
     @property
     def mask(self):
-        """Boolean validity mask over the padded leading axis."""
-        return (jnp.arange(self.padded_count) < self.count)
+        """Boolean validity mask over the padded leading axis (cached:
+        eager re-dispatch per access costs a device round trip)."""
+        m = self.__dict__.get("_mask_cache")
+        if m is None:
+            m = jnp.arange(self.padded_count) < self.count
+            self.__dict__["_mask_cache"] = m
+        return m
 
     def numpy(self):
         """Unpadded host copy (≈ `collect`)."""

@@ -12,7 +12,6 @@
 
 from __future__ import annotations
 
-from functools import cached_property
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +19,11 @@ import numpy as np
 
 from ...data.dataset import Dataset
 from ...workflow.pipeline import Transformer
+
+
+@jax.jit
+def _cosine_rf(X, W, b):
+    return jnp.cos(X @ W + b)
 
 
 class CosineRandomFeatures(Transformer):
@@ -48,16 +52,17 @@ class CosineRandomFeatures(Transformer):
             rng.uniform(0, 2 * np.pi, size=(num_features,)), dtype=jnp.float32
         )
 
-    @cached_property
-    def _batch_fn(self):
-        W, b = self.W, self.b
-        return jax.jit(lambda X: jnp.cos(X @ W + b))
-
     def apply(self, x):
         return jnp.cos(x @ self.W + self.b)
 
+    def fuse(self):
+        return (("CosineRandomFeatures",), (self.W, self.b),
+                lambda p, X: jnp.cos(X @ p[0] + p[1]))
+
     def apply_batch(self, data: Dataset):
-        return data.with_data(self._batch_fn(data.array))
+        # module-level jit: W/b are traced args, so rebuilding a pipeline
+        # (fresh weights, same shapes) reuses the compiled program
+        return data.with_data(_cosine_rf(data.array, self.W, self.b))
 
 
 class RandomSignNode(Transformer):

@@ -12,13 +12,37 @@
 
 from __future__ import annotations
 
-from functools import cached_property
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 from ...data.dataset import Dataset, HostDataset, zip_datasets
 from ...workflow.pipeline import Transformer
+
+
+# Module-level jits (shape/static-keyed): per-instance jits recompile on
+# every pipeline rebuild, which costs far more than these tiny kernels.
+@partial(jax.jit, static_argnames=("k",))
+def _int_indicators(y, mask, k: int):
+    return (2.0 * jax.nn.one_hot(y, k) - 1.0) * mask[:, None]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _int_array_indicators(Y, mask, k: int):
+    onehots = jax.nn.one_hot(Y, k)  # (n, L, k); -1 rows are 0
+    ind = 2.0 * jnp.clip(jnp.sum(onehots, axis=1), 0.0, 1.0) - 1.0
+    return ind * mask[:, None]
+
+
+@jax.jit
+def _argmax_last(x):
+    return jnp.argmax(x, axis=-1)
+
+
+@jax.jit
+def _concat_last(parts):
+    return jnp.concatenate(parts, axis=-1)
 
 
 class ClassLabelIndicatorsFromInt(Transformer):
@@ -32,15 +56,8 @@ class ClassLabelIndicatorsFromInt(Transformer):
     def apply(self, y):
         return 2.0 * jax.nn.one_hot(y, self.num_classes) - 1.0
 
-    @cached_property
-    def _batch_fn(self):
-        k = self.num_classes
-        return jax.jit(
-            lambda y, mask: (2.0 * jax.nn.one_hot(y, k) - 1.0) * mask[:, None]
-        )
-
     def apply_batch(self, data: Dataset):
-        return data.with_data(self._batch_fn(data.array, data.mask))
+        return data.with_data(_int_indicators(data.array, data.mask, k=self.num_classes))
 
 
 class ClassLabelIndicatorsFromIntArray(Transformer):
@@ -54,14 +71,10 @@ class ClassLabelIndicatorsFromIntArray(Transformer):
         onehots = jax.nn.one_hot(ys, self.num_classes)  # (L, k); -1 rows are 0
         return 2.0 * jnp.clip(jnp.sum(onehots, axis=0), 0.0, 1.0) - 1.0
 
-    @cached_property
-    def _batch_fn(self):
-        return jax.jit(
-            lambda Y, mask: jax.vmap(self.apply)(Y) * mask[:, None]
-        )
-
     def apply_batch(self, data: Dataset):
-        return data.with_data(self._batch_fn(data.array, data.mask))
+        return data.with_data(
+            _int_array_indicators(data.array, data.mask, k=self.num_classes)
+        )
 
 
 class MaxClassifier(Transformer):
@@ -71,6 +84,14 @@ class MaxClassifier(Transformer):
 
     def apply(self, x):
         return jnp.argmax(x, axis=-1)
+
+    def fuse(self):
+        return (("MaxClassifier",), (), lambda p, x: jnp.argmax(x, axis=-1))
+
+    def apply_batch(self, data):
+        if isinstance(data, Dataset):
+            return data.with_data(_argmax_last(data.array))
+        return super().apply_batch(data)
 
 
 class TopKClassifier(Transformer):
@@ -88,13 +109,9 @@ class VectorCombiner(Transformer):
     def apply(self, xs):
         return jnp.concatenate([jnp.asarray(x) for x in xs], axis=-1)
 
-    @cached_property
-    def _batch_fn(self):
-        return jax.jit(lambda parts: jnp.concatenate(parts, axis=-1))
-
     def apply_batch(self, data):
         if isinstance(data, Dataset) and isinstance(data.data, tuple):
-            return data.with_data(self._batch_fn(data.data))
+            return data.with_data(_concat_last(data.data))
         return super().apply_batch(data)
 
 
