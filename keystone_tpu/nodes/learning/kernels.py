@@ -26,20 +26,30 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...data.dataset import Dataset
+from ...ops import use_pallas as _use_pallas_now
 from ...workflow.pipeline import Estimator, LabelEstimator, Transformer
 
 
-@jax.jit
-def _rbf_block(X, Xb, gamma):
+@partial(jax.jit, static_argnames=("gamma", "use_pal"))
+def _rbf_block_jit(X, Xb, gamma: float, use_pal: bool):
+    from ...ops import rbf_block_pallas, rbf_block_reference
+
+    if use_pal:
+        return rbf_block_pallas(X, Xb, gamma)
+    return rbf_block_reference(X, Xb, gamma)
+
+
+def _rbf_block(X, Xb, gamma: float):
     """K(X, Xb) = exp(-γ‖x−y‖²) via the dot-product trick
-    (KernelGenerator.scala:18-206)."""
-    with jax.default_matmul_precision("highest"):
-        d2 = (
-            jnp.sum(X * X, axis=1, keepdims=True)
-            - 2.0 * X @ Xb.T
-            + jnp.sum(Xb * Xb, axis=1)
-        )
-        return jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+    (KernelGenerator.scala:18-206). gamma is static: the Pallas kernel
+    fuses the distance/exp epilogue into the Gram GEMM (ops/), and one
+    estimator has one gamma, so this costs no extra compiles. The
+    backend choice is part of the jit key so toggling
+    KEYSTONE_ENABLE_PALLAS mid-process cannot reuse the other path's
+    compiled program."""
+    from ...ops import use_pallas
+
+    return _rbf_block_jit(X, Xb, gamma, use_pallas())
 
 
 class GaussianKernelTransformer(Transformer):
@@ -51,12 +61,12 @@ class GaussianKernelTransformer(Transformer):
 
     def apply(self, x):
         return _rbf_block(
-            jnp.atleast_2d(jnp.asarray(x)), self.anchors, jnp.float32(self.gamma)
+            jnp.atleast_2d(jnp.asarray(x)), self.anchors, float(self.gamma)
         )[0]
 
     def apply_batch(self, data: Dataset):
         return data.map_batches(
-            lambda X: _rbf_block(X, self.anchors, jnp.float32(self.gamma)),
+            lambda X: _rbf_block(X, self.anchors, float(self.gamma)),
             jitted=False,
         )
 
@@ -75,7 +85,7 @@ class BlockKernelMatrix:
 
     def __init__(self, X, gamma: float, cache_blocks: bool = False):
         self.X = X  # (n_pad, d) sharded
-        self.gamma = jnp.float32(gamma)
+        self.gamma = float(gamma)
         self.cache_blocks = cache_blocks
         self._cache = {}
 
@@ -90,8 +100,8 @@ class BlockKernelMatrix:
         return Kb
 
 
-@jax.jit
-def _krr_step(X, Y, mask, alpha, KA, lam, gamma, block_ids):
+@partial(jax.jit, static_argnames=("gamma", "use_pal"))
+def _krr_step(X, Y, mask, alpha, KA, lam, gamma, block_ids, use_pal):
     """One Gauss-Seidel block update of dual KRR (K + λI)α = Y.
 
     KA tracks K @ alpha. For block b: solve
@@ -101,7 +111,7 @@ def _krr_step(X, Y, mask, alpha, KA, lam, gamma, block_ids):
     with jax.default_matmul_precision("highest"):
         B = block_ids.shape[0]
         Xb = jnp.take(X, block_ids, axis=0)
-        Kb = _rbf_block(X, Xb, gamma) * mask[:, None]  # (n, B) masked rows
+        Kb = _rbf_block_jit(X, Xb, gamma, use_pal) * mask[:, None]  # (n, B) masked rows
         Kbb = jnp.take(Kb, block_ids, axis=0)  # (B, B)
         alpha_b = jnp.take(alpha, block_ids, axis=0)
         resid_b = (
@@ -129,7 +139,7 @@ class KernelBlockLinearMapper(Transformer):
 
     def apply(self, x):
         K = _rbf_block(
-            jnp.atleast_2d(jnp.asarray(x)), self.train_X, jnp.float32(self.gamma)
+            jnp.atleast_2d(jnp.asarray(x)), self.train_X, float(self.gamma)
         )
         return (K @ self.alpha)[0]
 
@@ -139,7 +149,7 @@ class KernelBlockLinearMapper(Transformer):
         out = jnp.zeros((X.shape[0], self.alpha.shape[1]), X.dtype)
         for start in range(0, n_train, self.block_size):
             end = min(start + self.block_size, n_train)
-            Kb = _rbf_block(X, self.train_X[start:end], jnp.float32(self.gamma))
+            Kb = _rbf_block(X, self.train_X[start:end], float(self.gamma))
             out = out + Kb @ self.alpha[start:end]
         return data.with_data(out)
 
@@ -207,7 +217,7 @@ class KernelRidgeRegression(LabelEstimator):
             KA = jnp.asarray(state["KA"])
             start_epoch, start_block = int(state["epoch"]), int(state["block"])
         lam = jnp.asarray(self.lam, X.dtype)
-        gamma = jnp.asarray(self.gamma, X.dtype)
+        gamma = float(self.gamma)
         done = 0
         for epoch in range(start_epoch, self.num_epochs):
             # per-epoch seed so a resumed run replays identical block orders
@@ -217,7 +227,10 @@ class KernelRidgeRegression(LabelEstimator):
             first = start_block if epoch == start_epoch else 0
             for b in range(first, n_blocks):
                 block_ids = jnp.asarray(ids[b * B : (b + 1) * B], jnp.int32)
-                alpha, KA = _krr_step(X, Y, mask, alpha, KA, lam, gamma, block_ids)
+                alpha, KA = _krr_step(
+                    X, Y, mask, alpha, KA, lam, gamma, block_ids,
+                    use_pal=_use_pallas_now(),
+                )
                 done += 1
                 if ckpt and done % self.blocks_before_checkpoint == 0:
                     # atomic write: a crash mid-save must not corrupt the

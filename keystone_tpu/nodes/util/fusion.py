@@ -36,6 +36,66 @@ def _stage_batch_fn(stage: Transformer):
     return jax.vmap(stage.apply)
 
 
+class _RectifyPoolStage(Transformer):
+    """Peephole-fused SymmetricRectifier >> Pooler(sum): lowers to the
+    Pallas one-pass kernel on TPU (ops/pallas_kernels.py), XLA elsewhere."""
+
+    fusable = True
+
+    def __init__(self, alpha: float, max_val: float, pool: int, stride: int):
+        self.alpha = alpha
+        self.max_val = max_val
+        self.pool = pool
+        self.stride = stride
+
+    def apply(self, x):
+        from ...ops import rectify_pool_reference
+
+        return rectify_pool_reference(
+            x[None], self.alpha, self.max_val, self.pool, self.stride
+        )[0]
+
+    def fuse(self):
+        from ...ops import use_pallas
+
+        a, mv, p, s = self.alpha, self.max_val, self.pool, self.stride
+        pal = use_pallas()  # part of the key: flag flips must not reuse
+        # the other path's cached program
+
+        def fn(params, x):
+            from ...ops import rectify_pool_pallas, rectify_pool_reference
+
+            if pal:
+                return rectify_pool_pallas(x, a, mv, p, s)
+            return rectify_pool_reference(x, a, mv, p, s)
+
+        return (("RectifyPool", a, mv, p, s, pal), (), fn)
+
+
+def _peephole(stages):
+    """Merge adjacent (SymmetricRectifier, Pooler[sum]) stage pairs so the
+    channel-doubled rectified tensor never materializes (see ops/)."""
+    from ..images.core import Pooler, SymmetricRectifier
+
+    out, i = [], 0
+    while i < len(stages):
+        s = stages[i]
+        if (
+            isinstance(s, SymmetricRectifier)
+            and i + 1 < len(stages)
+            and isinstance(stages[i + 1], Pooler)
+            and stages[i + 1].pool_fn == "sum"
+            and stages[i + 1].pixel_fn is None
+        ):
+            p = stages[i + 1]
+            out.append(_RectifyPoolStage(s.alpha, s.max_val, p.pool_size, p.stride))
+            i += 2
+        else:
+            out.append(s)
+            i += 1
+    return out
+
+
 def _stage_fuse(stage: Transformer):
     """Decompose a stage into (static_key, params_pytree, pure_fn) where
     ``pure_fn(params, xb) -> yb``.
@@ -87,7 +147,7 @@ class FusedBatchTransformer(Transformer):
                 data = s.apply_batch(data)
             return data
 
-        fused = [_stage_fuse(s) for s in self.stages]
+        fused = [_stage_fuse(s) for s in _peephole(self.stages)]
         statics = tuple(f[0] for f in fused)
         params = tuple(f[1] for f in fused)
         fns = tuple(f[2] for f in fused)

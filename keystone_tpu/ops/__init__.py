@@ -1,0 +1,21 @@
+"""Pallas TPU kernels for hot ops (with XLA fallbacks)."""
+
+from .pallas_kernels import (
+    rbf_block,
+    rbf_block_pallas,
+    rbf_block_reference,
+    rectify_pool,
+    rectify_pool_pallas,
+    rectify_pool_reference,
+    use_pallas,
+)
+
+__all__ = [
+    "rbf_block",
+    "rbf_block_pallas",
+    "rbf_block_reference",
+    "rectify_pool",
+    "rectify_pool_pallas",
+    "rectify_pool_reference",
+    "use_pallas",
+]

@@ -1,0 +1,114 @@
+"""Pallas kernel correctness (interpret mode on CPU) and fusion peephole.
+
+The reference implementations (`*_reference`) are the XLA paths the
+dispatchers use off-TPU; the Pallas kernels must match them bit-for-bit
+in structure and numerically to f32 tolerance. The peephole test mirrors
+the reference's single-vs-batch parity style (PipelineSuite): the fused
+RectifyPool stage must equal running SymmetricRectifier then Pooler
+stage-by-stage.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops import (
+    rbf_block,
+    rbf_block_pallas,
+    rbf_block_reference,
+    rectify_pool,
+    rectify_pool_pallas,
+    rectify_pool_reference,
+)
+
+
+@pytest.mark.parametrize(
+    "n,h,w,k,pool,stride,alpha,max_val",
+    [
+        (3, 27, 27, 16, 14, 13, 0.25, 0.0),  # CIFAR north-star geometry
+        (5, 12, 12, 8, 4, 4, 0.0, 0.0),  # non-overlapping windows
+        (2, 10, 14, 4, 5, 3, 0.1, 0.05),  # rectangular, overlap, floor
+    ],
+)
+def test_rectify_pool_pallas_matches_reference(n, h, w, k, pool, stride, alpha, max_val):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, h, w, k)).astype(np.float32))
+    want = rectify_pool_reference(x, alpha, max_val, pool, stride)
+    got = rectify_pool_pallas(
+        x, alpha, max_val, pool, stride, block_n=2, interpret=True
+    )
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "m,n,d",
+    [
+        (70, 33, 50),  # forces padding on every axis
+        (128, 128, 128),  # exactly tiled
+        (9, 200, 513),  # k-loop with ragged last step
+    ],
+)
+def test_rbf_block_pallas_matches_reference(m, n, d):
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.normal(size=(m, d)).astype(np.float32))
+    Y = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    gamma = 0.07
+    want = rbf_block_reference(X, Y, gamma)
+    got = rbf_block_pallas(X, Y, gamma, bm=64, bn=128, bk=256, interpret=True)
+    assert got.shape == (m, n)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_dispatchers_fall_back_off_tpu():
+    # on the CPU test mesh the dispatcher must route to the XLA path and
+    # agree with it exactly
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 4)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(rectify_pool(x, 0.1, 0.0, 4, 2)),
+        np.asarray(rectify_pool_reference(x, 0.1, 0.0, 4, 2)),
+    )
+    X = jnp.asarray(rng.normal(size=(6, 5)).astype(np.float32))
+    Y = jnp.asarray(rng.normal(size=(3, 5)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(rbf_block(X, Y, 0.3)), np.asarray(rbf_block_reference(X, Y, 0.3))
+    )
+
+
+def test_fusion_peephole_matches_stagewise():
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.core import Pooler, SymmetricRectifier
+    from keystone_tpu.nodes.util.fusion import FusedBatchTransformer, _peephole
+
+    rng = np.random.default_rng(3)
+    imgs = rng.normal(size=(16, 27, 27, 8)).astype(np.float32)
+    rect = SymmetricRectifier(alpha=0.25)
+    pool = Pooler(13, 14, pool_fn="sum")
+
+    stages = _peephole([rect, pool])
+    assert len(stages) == 1 and type(stages[0]).__name__ == "_RectifyPoolStage"
+    # max-pool / pixel_fn poolers must NOT be fused
+    assert len(_peephole([rect, Pooler(13, 14, pool_fn="max")])) == 2
+
+    data = Dataset(imgs)
+    fused_out = FusedBatchTransformer([rect, pool], microbatch=8).apply_batch(data)
+    want = pool.apply_batch(rect.apply_batch(data))
+    np.testing.assert_allclose(
+        fused_out.numpy(), want.numpy(), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_krr_still_learns_with_static_gamma():
+    # XOR learnability, mirroring the reference KernelModelSuite.scala:13-39
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning.kernels import KernelRidgeRegression
+
+    rng = np.random.default_rng(4)
+    X = rng.uniform(-1, 1, size=(256, 2)).astype(np.float32)
+    y = np.where(X[:, 0] * X[:, 1] > 0, 1.0, -1.0).astype(np.float32)[:, None]
+    model = KernelRidgeRegression(gamma=4.0, lam=1e-3, block_size=64).fit(
+        Dataset(X), Dataset(y)
+    )
+    preds = np.sign(model.apply_batch(Dataset(X)).numpy()[:, 0])
+    assert (preds == y[:, 0]).mean() > 0.95
