@@ -32,6 +32,43 @@ REGISTRY = {
 _SHORT = {name.rsplit(".", 1)[-1]: v for name, v in REGISTRY.items()}
 
 
+def _pop_multihost_flags(argv):
+    """Launcher-level multi-host flags (≈ the reference launcher's
+    cluster args living outside the app's own scopt flags):
+
+        python -m keystone_tpu --coordinator host:port --num-processes 4 \\
+            --process-id $I pipelines.images.cifar.RandomPatchCifar ...
+    """
+    names = ("--coordinator", "--num-processes", "--process-id")
+    opts, rest = {}, []
+    it = iter(argv)
+    for a in it:
+        flag, eq, inline = a.partition("=")
+        if flag in names:
+            val = inline if eq else next(it, None)
+            if not val:
+                raise SystemExit(f"{flag} requires a value")
+            opts[flag.lstrip("-").replace("-", "_")] = val
+        else:
+            rest.append(a)
+    if opts:
+        if "coordinator" not in opts:
+            raise SystemExit(
+                "--num-processes/--process-id require --coordinator "
+                "(single-host runs need none of these flags)"
+            )
+        from .parallel import init_multihost
+
+        init_multihost(
+            coordinator_address=opts["coordinator"],
+            num_processes=(
+                int(opts["num_processes"]) if "num_processes" in opts else None
+            ),
+            process_id=int(opts["process_id"]) if "process_id" in opts else None,
+        )
+    return rest
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
@@ -40,6 +77,7 @@ def main(argv=None):
         for name in sorted(REGISTRY):
             print(f"  {name}")
         return 0
+    argv = _pop_multihost_flags(argv)
     name, rest = argv[0], argv[1:]
     entry = REGISTRY.get(name) or _SHORT.get(name)
     if entry is None:

@@ -1,0 +1,49 @@
+"""Mesh, collectives, and multi-host layers (SURVEY.md §2.7)."""
+
+from . import mesh
+from .collectives import (
+    all_gather_rows,
+    broadcast,
+    co_sharded,
+    reshard,
+    tree_aggregate,
+    tree_reduce_sum,
+)
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    current_mesh,
+    data_sharding,
+    make_mesh,
+    replicate,
+    replicated_sharding,
+    use_mesh,
+)
+from .multihost import (
+    barrier,
+    dataset_from_process_local,
+    global_data_mesh,
+    init_multihost,
+)
+
+__all__ = [
+    "mesh",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "current_mesh",
+    "data_sharding",
+    "make_mesh",
+    "replicate",
+    "replicated_sharding",
+    "use_mesh",
+    "all_gather_rows",
+    "broadcast",
+    "co_sharded",
+    "reshard",
+    "tree_aggregate",
+    "tree_reduce_sum",
+    "barrier",
+    "dataset_from_process_local",
+    "global_data_mesh",
+    "init_multihost",
+]

@@ -1,0 +1,135 @@
+"""The distributed communication backend, stated explicitly.
+
+The reference's comm backend is Spark's driver-coordinated BSP: torrent
+broadcast, depth-log(P) `treeReduce`/`treeAggregate` to the driver,
+co-partitioned `zip`, and hash shuffles (SURVEY.md §2.7; e.g.
+LBFGS.scala:97-103 gradient treeReduce, LinearMapper.scala:48 model
+broadcast). On TPU the backend is XLA collectives over ICI (and DCN
+between hosts), reached two ways:
+
+  1. **GSPMD (implicit)** — most code paths: arrays carry shardings and
+     `jit` inserts all-reduce/all-gather where the math requires them.
+     `Xᵀ X` on a data-sharded X *is* the treeReduce of per-shard Grams.
+  2. **shard_map (explicit)** — the helpers here, for algorithms whose
+     per-shard step is not expressible as plain sharded math (TSQR's
+     per-shard QR, per-shard sketches).
+
+This module gives the explicit spelling of each reference collective so
+solver code (and readers coming from the reference) can name them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import mesh as meshlib
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+    except ImportError:  # older jax spells it differently
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_rep=False)
+
+
+# jitted programs keyed on (kind, mesh, axis[, seq_op]) — rebuilding the
+# closure per call would retrace/recompile every invocation, turning a
+# per-iteration solver reduce into a per-iteration compile
+_COLLECTIVE_CACHE: dict = {}
+
+
+def _cached(key, build):
+    fn = _COLLECTIVE_CACHE.get(key)
+    if fn is None:
+        fn = _COLLECTIVE_CACHE[key] = jax.jit(build())
+    return fn
+
+
+def tree_reduce_sum(x, mesh=None, axis: str = meshlib.DATA_AXIS):
+    """≈ `rdd.treeReduce(_ + _)` of per-shard partial sums.
+
+    ``x`` is sharded over ``axis`` on its leading dim; returns the
+    replicated total (summed over the leading dim). Spark's branching
+    factor / depth knobs have no analog: the ICI all-reduce schedule is
+    the hardware's, and is strictly better than tree-to-driver.
+    """
+    mesh = mesh or meshlib.current_mesh()
+
+    def build():
+        def local(xs):
+            return lax.psum(jnp.sum(xs, axis=0), axis)
+
+        return _shard_map(local, mesh, in_specs=(P(axis),), out_specs=P())
+
+    return _cached(("tree_reduce_sum", mesh, axis), build)(x)
+
+
+def tree_aggregate(x, seq_op, mesh=None, axis: str = meshlib.DATA_AXIS):
+    """≈ `treeAggregate(zero)(seqOp, combOp)` where combOp is `+`:
+    ``seq_op`` maps one shard's rows to a partial aggregate, psum
+    combines. (StandardScaler.scala:46's moment aggregation shape.)
+
+    The compiled program is cached per (mesh, axis, seq_op) — pass a
+    stable (module-level) ``seq_op`` in loops to reuse it."""
+    mesh = mesh or meshlib.current_mesh()
+
+    def build():
+        def local(xs):
+            return jax.tree_util.tree_map(lambda v: lax.psum(v, axis), seq_op(xs))
+
+        return _shard_map(local, mesh, in_specs=(P(axis),), out_specs=P())
+
+    return _cached(("tree_aggregate", mesh, axis, seq_op), build)(x)
+
+
+def broadcast(x, mesh=None):
+    """≈ `sc.broadcast(model)` — replicate across the mesh. GSPMD keeps
+    replicated operands resident per-chip; no torrent protocol needed."""
+    return meshlib.replicate(x, mesh)
+
+
+def co_sharded(a, b):
+    """≈ `rddA.zip(rddB)` precondition: identically sharded leading axes.
+
+    Spark zip requires equal partitioning; here the check is that both
+    arrays carry the same NamedSharding, which makes any elementwise
+    combination collective-free."""
+    sa = getattr(a, "sharding", None)
+    sb = getattr(b, "sharding", None)
+    if sa is None or sb is None:
+        return a.shape[0] == b.shape[0]
+    return a.shape[0] == b.shape[0] and sa.is_equivalent_to(sb, a.ndim)
+
+
+def all_gather_rows(x, mesh=None, axis: str = meshlib.DATA_AXIS):
+    """≈ `rdd.collect()` onto every executor (the reference instead
+    collects to the driver; on TPU gathering to all chips over ICI is
+    the cheap direction). Returns the full leading axis, replicated."""
+    mesh = mesh or meshlib.current_mesh()
+
+    def build():
+        def local(xs):
+            return lax.all_gather(xs, axis, axis=0, tiled=True)
+
+        return _shard_map(local, mesh, in_specs=(P(axis),), out_specs=P())
+
+    return _cached(("all_gather_rows", mesh, axis), build)(x)
+
+
+def reshard(x, spec: P, mesh=None):
+    """≈ shuffle/repartition: move data to a new layout. XLA lowers the
+    transfer to all-to-all/collective-permute over ICI (or DCN across
+    hosts) — the analog of Shuffler.scala:16-19 without a sort key."""
+    mesh = mesh or meshlib.current_mesh()
+    return jax.device_put(x, NamedSharding(mesh, spec))

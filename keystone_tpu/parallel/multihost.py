@@ -1,0 +1,139 @@
+"""Multi-host (multi-process) execution: the DCN-scale analog of the
+reference's Spark cluster (driver + executors over the network).
+
+The reference scales out by adding Spark executors; the driver ships
+closures and collects tree-reductions (SURVEY.md §2.7). The TPU-native
+equivalent is JAX multi-controller SPMD: one Python process per host,
+`jax.distributed.initialize` to form the job, a global `Mesh` spanning
+every host's chips, and the SAME jitted programs — XLA routes
+collectives over ICI within a slice and DCN between slices. No new
+solver code is needed at multi-host scale; that is the point of
+designing every solver against sharded global arrays.
+
+What this module adds on top of raw JAX:
+
+  - `init_multihost()` — idempotent process-group setup (no-op for the
+    common single-process case, so library code can call it
+    unconditionally).
+  - `global_data_mesh()` — a mesh over ALL devices in the job with the
+    standard ``data``(×``model``) axes.
+  - `dataset_from_process_local()` — assemble a global `Dataset` from
+    each host's locally-loaded rows (the analog of executors reading
+    their own HDFS splits: loaders stay host-local, the logical dataset
+    is global).
+  - `barrier()` — a cross-host sync point (≈ a Spark stage boundary).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as meshlib
+
+_initialized = False
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> int:
+    """Join (or skip joining) the multi-controller job; returns
+    ``jax.process_count()``.
+
+    Single-process runs (tests, one-host benches) pass nothing and this
+    is a no-op — the same pipeline scripts then work unchanged when the
+    launcher provides coordinator/process args on a pod."""
+    global _initialized
+    if coordinator_address is None:
+        # no-op path: deliberately does NOT latch, so a later call with
+        # real coordinator args still initializes the process group
+        return jax.process_count()
+    if _initialized:
+        return jax.process_count()
+    kwargs = {}
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(coordinator_address, **kwargs)
+    _initialized = True
+    return jax.process_count()
+
+
+def global_data_mesh(model_shards: int = 1) -> Mesh:
+    """Mesh over every device in the job. With ``model_shards`` > 1 the
+    trailing axis is ``model`` (feature blocking ≈ VectorSplitter);
+    devices are laid out so the model axis stays within a host's chips
+    (ICI) and the data axis spans hosts (DCN) — gradients/Grams
+    all-reduce over the slow links only once per step, the layout the
+    scaling-book recipe prescribes."""
+    devs = np.asarray(jax.devices())
+    if model_shards == 1:
+        return Mesh(devs, (meshlib.DATA_AXIS,))
+    if len(devs) % model_shards:
+        raise ValueError(
+            f"{len(devs)} devices not divisible by model_shards={model_shards}"
+        )
+    grid = devs.reshape(len(devs) // model_shards, model_shards)
+    return Mesh(grid, (meshlib.DATA_AXIS, meshlib.MODEL_AXIS))
+
+
+def dataset_from_process_local(
+    local_rows, global_count: Optional[int] = None, mesh: Optional[Mesh] = None
+):
+    """Build a global data-sharded `Dataset` from this process's rows.
+
+    Each host loads its own split (tar shards, CSV ranges — the loaders
+    in `keystone_tpu.loaders` are all host-local by design); this
+    assembles the single logical array without any host ever
+    materializing the whole dataset. Row padding: every process must
+    pass the same number of rows (pad the last split; padded rows are
+    masked out exactly like single-host `Dataset` padding via
+    ``global_count``)."""
+    from ..data.dataset import Dataset  # deferred: dataset imports parallel
+
+    mesh = mesh or meshlib.current_mesh()
+    local_rows = np.asarray(local_rows)
+    sharding = NamedSharding(mesh, P(meshlib.DATA_AXIS))
+    if jax.process_count() == 1:
+        n = local_rows.shape[0] if global_count is None else global_count
+        return Dataset(local_rows, count=n, mesh=mesh)
+    global_shape = (
+        local_rows.shape[0] * jax.process_count(),
+    ) + local_rows.shape[1:]
+    arr = jax.make_array_from_process_local_data(sharding, local_rows, global_shape)
+    n = global_shape[0] if global_count is None else global_count
+    # multi-process arrays are not host-indexable, so the assembled shape
+    # must already be Dataset's padded shape: ceil(n / data_shards) ·
+    # data_shards == total rows (pad each host's split before calling)
+    shards = mesh.shape.get(meshlib.DATA_AXIS, 1)
+    if -(-n // shards) * shards != global_shape[0]:
+        raise ValueError(
+            f"global rows {global_shape[0]} must equal ceil({n}/{shards})·{shards}; "
+            "pad per-host splits evenly"
+        )
+    return Dataset(arr, count=n, mesh=mesh, _placed=True)
+
+
+_barrier_count = 0
+
+
+def barrier() -> None:
+    """Cross-host sync (≈ Spark stage boundary): every process must
+    reach it before any can pass. Single-process: trivially a no-op."""
+    if jax.process_count() == 1:
+        return
+    global _barrier_count
+    _barrier_count += 1
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(f"keystone_barrier_{_barrier_count}")

@@ -1,0 +1,114 @@
+"""Collectives + multi-host layers on the 8-device CPU mesh.
+
+Mirrors the reference's approach of exercising 'distributed' semantics
+in local mode (PipelineContext, SURVEY.md §4): every collective here
+runs over 8 real (virtual CPU) devices, so psum/all_gather/shard layout
+bugs surface without a pod.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from keystone_tpu.parallel import (
+    DATA_AXIS,
+    all_gather_rows,
+    broadcast,
+    co_sharded,
+    current_mesh,
+    dataset_from_process_local,
+    global_data_mesh,
+    init_multihost,
+    reshard,
+    tree_aggregate,
+    tree_reduce_sum,
+)
+from keystone_tpu.parallel.mesh import shard_leading_axis
+
+
+def test_tree_reduce_sum_matches_numpy():
+    x = np.arange(64 * 5, dtype=np.float32).reshape(64, 5)
+    xs = shard_leading_axis(jnp.asarray(x))
+    got = tree_reduce_sum(xs)
+    np.testing.assert_allclose(np.asarray(got), x.sum(axis=0), rtol=1e-6)
+
+
+def test_tree_aggregate_moments():
+    # the StandardScaler shape: per-shard (sum, sumsq, n) then psum
+    x = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+    xs = shard_leading_axis(jnp.asarray(x))
+    agg = tree_aggregate(
+        xs,
+        lambda rows: {
+            "sum": rows.sum(axis=0),
+            "sumsq": (rows * rows).sum(axis=0),
+            "n": jnp.asarray(rows.shape[0], jnp.float32),
+        },
+    )
+    np.testing.assert_allclose(np.asarray(agg["sum"]), x.sum(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(agg["sumsq"]), (x * x).sum(axis=0), rtol=1e-5)
+    assert float(agg["n"]) == 64.0
+
+
+def test_broadcast_is_replicated():
+    w = jnp.ones((4, 4))
+    wb = broadcast(w)
+    assert wb.sharding.is_fully_replicated
+
+
+def test_co_sharded_and_reshard():
+    a = shard_leading_axis(jnp.ones((16, 2)))
+    b = shard_leading_axis(jnp.zeros((16, 2)))
+    assert co_sharded(a, b)
+    rep = reshard(a, P())
+    assert rep.sharding.is_fully_replicated
+    assert not co_sharded(a, rep)
+
+
+def test_all_gather_rows_replicates_full_axis():
+    x = np.arange(32, dtype=np.float32).reshape(32, 1)
+    xs = shard_leading_axis(jnp.asarray(x))
+    g = all_gather_rows(xs)
+    assert g.shape == (32, 1)
+    assert g.sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(g), x)
+
+
+def test_init_multihost_single_process_noop():
+    assert init_multihost() == 1
+    assert init_multihost() == 1  # idempotent
+
+
+def test_global_data_mesh_axes():
+    m = global_data_mesh()
+    assert m.shape == {DATA_AXIS: 8}
+    m2 = global_data_mesh(model_shards=2)
+    assert m2.shape == {DATA_AXIS: 4, "model": 2}
+
+
+def test_dataset_from_process_local_single_process():
+    rows = np.arange(24, dtype=np.float32).reshape(12, 2)
+    ds = dataset_from_process_local(rows, mesh=current_mesh())
+    assert ds.count == 12
+    np.testing.assert_array_equal(ds.numpy(), rows)
+    # padded + sharded over data axis
+    assert ds.array.sharding.spec == P(DATA_AXIS)
+
+
+def test_solver_agrees_across_mesh_shapes():
+    # the 'same program, different cluster size' property the reference
+    # gets from partition-count independence: fitting on a 1-device vs
+    # 8-device mesh must give the same model
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+    from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(96, 6)).astype(np.float32)
+    W = rng.normal(size=(6, 3)).astype(np.float32)
+    Y = X @ W
+    with use_mesh(make_mesh(jax.devices()[:1])):
+        m1 = LinearMapEstimator(lam=0.0).fit(Dataset(X), Dataset(Y))
+    m8 = LinearMapEstimator(lam=0.0).fit(Dataset(X), Dataset(Y))
+    np.testing.assert_allclose(np.asarray(m1.W), np.asarray(m8.W), atol=1e-3)
