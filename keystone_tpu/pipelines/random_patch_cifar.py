@@ -36,7 +36,7 @@ from ..nodes.images.core import (
     SymmetricRectifier,
 )
 from ..nodes.learning import BlockLeastSquaresEstimator
-from ..nodes.learning.zca import ZCAWhitener, zca_from_covariance
+from ..nodes.learning.zca import ZCAWhitener
 from ..nodes.stats import StandardScaler
 from ..nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
 from ..nodes.util.fusion import FusedBatchTransformer
@@ -64,9 +64,13 @@ class RandomPatchCifarConfig:
     synth_test: int = 500
 
 
-def _sampled_patch_moments(images, idx, sub_idx, patch: int, step: int):
-    """On-device: gather sampled images, extract normalized patches, and
-    return (patches, sum, Gram) so only D-sized stats cross the tunnel."""
+def _learn_filters_device(images, idx, sub_idx, filter_idx, eps, patch: int, step: int):
+    """The WHOLE filter-learning computation in one XLA program: sampled
+    patch extraction + normalization, covariance, ZCA eigendecomposition,
+    whitening, and filter selection. One dispatch, one packed transfer —
+    per-call latency (not FLOPs) dominates this phase, so fusing the
+    reference's driver-side LAPACK step (ZCAWhitener.scala:53-60) into
+    the device program is the win."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -90,73 +94,64 @@ def _sampled_patch_moments(images, idx, sub_idx, patch: int, step: int):
     # true-f32 Gram: TPU default matmul precision is bf16-based, which
     # would corrupt the small eigenvalues the ZCA whitener depends on
     gram = jnp.matmul(flat.T, flat, precision=lax.Precision.HIGHEST)
-    return flat, flat.sum(axis=0), gram
-
-
-_sampled_patch_moments_jit = None
-
-
-def _whiten_and_select(flat, W, mu, filter_idx):
-    import jax.numpy as jnp
-    from jax import lax
-
+    m = flat.shape[0]
+    mu = flat.sum(axis=0) / m
+    cov = (gram - m * jnp.outer(mu, mu)) / max(m - 1.0, 1.0)
+    # ZCA: V diag((λ+ε)^-½) Vᵀ — f32 eigh is safe because eps floors the
+    # spectrum far above f32 eigensolver error (zca.zca_from_covariance
+    # is the host/f64 twin used by ZCAWhitenerEstimator)
+    lams, V = jnp.linalg.eigh(cov)
+    scale = 1.0 / jnp.sqrt(jnp.maximum(lams, 0.0) + eps)
+    W = jnp.matmul(V * scale, V.T, precision=lax.Precision.HIGHEST)
     whitened = jnp.matmul(flat - mu, W, precision=lax.Precision.HIGHEST)
     wnorms = jnp.linalg.norm(whitened, axis=1, keepdims=True)
     whitened = whitened / jnp.maximum(wnorms, 1e-8)
-    return jnp.take(whitened, filter_idx, axis=0)
+    filters = jnp.take(whitened, filter_idx, axis=0)
+    # pack: one host transfer instead of three (tunnel latency)
+    return jnp.concatenate([filters.ravel(), W.ravel(), mu])
 
 
-_whiten_and_select_jit = None
+_learn_filters_device_jit = None
 
 
 def learn_filters(train_data: Dataset, config) -> tuple:
-    """Whitened random-patch filter learning (reference :45-57).
-
-    TPU-first: patch extraction, normalization, and the patch Gram matrix
-    all run on-device; only the D×D covariance (for the host eigh — the
-    reference's driver-side LAPACK step, ZCAWhitener.scala:53-60) and the
-    final (num_filters × D) filter bank cross the device boundary.
-    """
-    global _sampled_patch_moments_jit, _whiten_and_select_jit
+    """Whitened random-patch filter learning (reference :45-57), fully
+    on-device — only the packed (filters, whitener, means) result crosses
+    the device boundary."""
+    global _learn_filters_device_jit
     import jax
     import jax.numpy as jnp
 
-    if _sampled_patch_moments_jit is None:
-        _sampled_patch_moments_jit = jax.jit(
-            _sampled_patch_moments, static_argnames=("patch", "step")
+    if _learn_filters_device_jit is None:
+        _learn_filters_device_jit = jax.jit(
+            _learn_filters_device, static_argnames=("patch", "step")
         )
-        _whiten_and_select_jit = jax.jit(_whiten_and_select)
 
     rng = np.random.default_rng(config.seed)
     n = train_data.count
     n_sample = min(n, max(config.sample_patches // 100, 64))
     idx = np.sort(rng.choice(n, size=n_sample, replace=False))
-    h, w = train_data.array.shape[1:3]
+    h, w, c = train_data.array.shape[1:]
     gy = (h - config.patch_size) // config.patch_steps + 1
     gx = (w - config.patch_size) // config.patch_steps + 1
     total = n_sample * gy * gx
     m = min(total, config.sample_patches)
     sub_idx = rng.choice(total, size=m, replace=False)
-
-    flat, psum, gram = _sampled_patch_moments_jit(
-        train_data.array, jnp.asarray(idx), jnp.asarray(sub_idx),
-        patch=config.patch_size, step=config.patch_steps,
-    )
-    psum = np.asarray(psum, np.float64)
-    gram = np.asarray(gram, np.float64)
-    mu = psum / m
-    cov = (gram - m * np.outer(mu, mu)) / max(m - 1.0, 1.0)
-    W = zca_from_covariance(cov, eps=0.1)
-    mu = mu.astype(np.float32)
-    whitener = ZCAWhitener(W, mu)
-
     filter_idx = rng.choice(m, size=config.num_filters, replace=False)
-    filters = np.asarray(
-        _whiten_and_select_jit(
-            flat, whitener.whitener, whitener.means, jnp.asarray(filter_idx)
+
+    packed = np.asarray(
+        _learn_filters_device_jit(
+            train_data.array, jnp.asarray(idx), jnp.asarray(sub_idx),
+            jnp.asarray(filter_idx), jnp.float32(0.1),
+            patch=config.patch_size, step=config.patch_steps,
         )
     )
-    return filters, whitener
+    D = config.patch_size * config.patch_size * c
+    K = config.num_filters
+    filters = packed[: K * D].reshape(K, D)
+    W = packed[K * D : K * D + D * D].reshape(D, D)
+    mu = packed[K * D + D * D :]
+    return filters, ZCAWhitener(W, mu)
 
 
 def build_pipeline(train, config):
