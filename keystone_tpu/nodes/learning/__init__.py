@@ -7,6 +7,7 @@ from .linear import (
 from .block_ls import BlockLeastSquaresEstimator, BlockLinearMapper
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
 from .least_squares import LeastSquaresEstimator
+from .calibrate import CostWeights, calibrate_cost_weights
 from .cost_model import (
     BlockSolverCostModel,
     CostModel,

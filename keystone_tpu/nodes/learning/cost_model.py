@@ -12,8 +12,9 @@ FLOPs, memory cost is HBM-resident bytes, and network cost is ICI
 collective bytes (Gram all-reduces, model replication). The default
 weights below are normalized per-chip rates for a v5e-class chip
 (~2e14 bf16 FLOP/s MXU, ~8e11 B/s HBM, ~1e11 B/s ICI all-reduce
-effective) so costs come out in seconds — re-fit them with
-`scripts/fit_cost_model.py`-style sweeps when hardware changes.
+effective) so costs come out in seconds — or measure them on the
+attached mesh with `calibrate.calibrate_cost_weights()` /
+`LeastSquaresEstimator.calibrated(...)`.
 """
 
 from __future__ import annotations

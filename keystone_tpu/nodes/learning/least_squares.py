@@ -55,6 +55,26 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         self.mem_weight = MEM_WEIGHT if mem_weight is None else mem_weight
         self.network_weight = NETWORK_WEIGHT if network_weight is None else network_weight
 
+    @classmethod
+    def calibrated(
+        cls, lam: float = 0.0, probe_kwargs: Optional[dict] = None, **kwargs
+    ) -> "LeastSquaresEstimator":
+        """Construct with cost weights MEASURED on the attached mesh
+        (calibrate.py) instead of the baked v5e defaults — the library
+        analog of the reference re-fitting its constants per cluster
+        (LeastSquaresEstimator.scala:17). ``probe_kwargs`` forwards to
+        `calibrate_cost_weights` (e.g. smaller probes for tests)."""
+        from .calibrate import calibrate_cost_weights
+
+        w = calibrate_cost_weights(**(probe_kwargs or {}))
+        return cls(
+            lam=lam,
+            cpu_weight=w.cpu_weight,
+            mem_weight=w.mem_weight,
+            network_weight=w.network_weight,
+            **kwargs,
+        )
+
     @property
     def default(self) -> LabelEstimator:
         return DenseLBFGSwithL2(self.lam, num_iters=self.num_iters)

@@ -259,3 +259,29 @@ def test_routing_big_d_prefers_block_or_lbfgs():
 
 def test_routing_sparse_prefers_sparse_lbfgs():
     assert _route(n=5_000_000, d=16384, k=2, sparsity=0.004) == "sparse-lbfgs"
+
+
+def test_calibrate_cost_weights_on_mesh():
+    # measured weights must be positive, finite, and usable for routing;
+    # on the 8-device CPU mesh the ICI probe actually runs a psum
+    from keystone_tpu.nodes.learning.calibrate import calibrate_cost_weights
+    from keystone_tpu.nodes.learning.cost_model import CostProfile, ExactSolverCostModel
+
+    w = calibrate_cost_weights(gemm_dim=256, mem_mb=4, iters=2)
+    for v in (w.cpu_weight, w.mem_weight, w.network_weight):
+        assert np.isfinite(v) and v > 0
+    p = CostProfile(n=10_000, d=128, k=4, sparsity=1.0, num_chips=8)
+    cost = ExactSolverCostModel().cost(
+        p, cpu_weight=w.cpu_weight, mem_weight=w.mem_weight,
+        network_weight=w.network_weight,
+    )
+    assert np.isfinite(cost) and cost > 0
+
+
+def test_least_squares_calibrated_constructor():
+    from keystone_tpu.nodes.learning import LeastSquaresEstimator
+
+    est = LeastSquaresEstimator.calibrated(
+        lam=1.0, probe_kwargs=dict(gemm_dim=256, mem_mb=4, iters=2)
+    )
+    assert est.cpu_weight > 0 and est.mem_weight > 0 and est.network_weight > 0
