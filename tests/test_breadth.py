@@ -6,6 +6,7 @@ import pytest
 
 from keystone_tpu import Dataset, HostDataset
 from keystone_tpu.evaluation import (
+    MulticlassClassifierEvaluator,
     AugmentedExamplesEvaluator,
     MeanAveragePrecisionEvaluator,
 )
@@ -20,6 +21,7 @@ from keystone_tpu.nodes.learning import (
     PerClassWeightedLeastSquares,
 )
 from keystone_tpu.nodes.nlp import (
+    NGramsHashingTF,
     HashingTF,
     NaiveBitPackIndexer,
     NGramsCounts,
@@ -264,3 +266,74 @@ def test_sparse_vectorizer_single_batch_duplicate_parity():
     batch = vec.apply_batch(HostDataset(docs)).matrix.toarray().ravel()
     np.testing.assert_allclose(single, batch)
     assert single[0] == 3.0
+
+
+def test_bwls_single_class():
+    """Degenerate one-class problem must not NaN or diverge
+    (BlockWeightedLeastSquaresSuite.scala:168)."""
+    rng = np.random.default_rng(5)
+    n, d = 48, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = np.ones((n, 1), np.float32)  # every example positive, k=1
+    m = BlockWeightedLeastSquaresEstimator(d, 4, lam=1.0, mixture_weight=0.3).fit(
+        Dataset(X), Dataset(Y)
+    )
+    W = np.asarray(m.W)
+    assert np.all(np.isfinite(W))
+    assert np.linalg.norm(W) < 1e3  # bounded, not merely finite
+    preds = X @ W + np.asarray(m.b)
+    # every training label is +1: the ridge fit must predict positive
+    assert np.all(preds > 0)
+
+
+def test_bwls_nondivisible_blocksize():
+    """d % block_size != 0 pads the trailing block
+    (BlockWeightedLeastSquaresSuite.scala:188): result must agree with
+    the single-block solve."""
+    rng = np.random.default_rng(6)
+    n, d, k = 160, 10, 3  # block 4 -> blocks of 4,4,2
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, k, n)
+    Y = 2.0 * np.eye(k, dtype=np.float32)[y] - 1.0
+    blocked = BlockWeightedLeastSquaresEstimator(4, 20, 1.0, mixture_weight=0.2).fit(
+        Dataset(X), Dataset(Y)
+    )
+    single = BlockWeightedLeastSquaresEstimator(d, 20, 1.0, mixture_weight=0.2).fit(
+        Dataset(X), Dataset(Y)
+    )
+    np.testing.assert_allclose(
+        np.asarray(blocked.W), np.asarray(single.W), atol=5e-2, rtol=5e-2
+    )
+
+
+def test_bwls_count_smaller_than_shards():
+    """n < mesh shards leaves some shards all-padding (the reference's
+    empty-partition case, BlockWeightedLeastSquaresSuite.scala:72)."""
+    rng = np.random.default_rng(7)
+    n, d, k = 5, 4, 2  # 8-device mesh -> shards with zero valid rows
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, k, n)
+    Y = 2.0 * np.eye(k, dtype=np.float32)[y] - 1.0
+    m = BlockWeightedLeastSquaresEstimator(d, 2, 1.0, mixture_weight=0.0).fit(
+        Dataset(X), Dataset(Y)
+    )
+    assert np.all(np.isfinite(np.asarray(m.W)))
+
+
+def test_ngrams_hashing_tf_equivalence():
+    """NGramsHashingTF ≡ NGramsFeaturizer ∘ HashingTF — the reference
+    proves its rolling hash matches the composed pair
+    (NGramsHashingTF.scala:25-118)."""
+    tokens = "the quick brown fox jumps over the lazy dog the quick".split()
+    fused = NGramsHashingTF([1, 2, 3], 64).apply(tokens)
+    composed = HashingTF(64).apply(NGramsFeaturizer([1, 2, 3]).apply(tokens))
+    np.testing.assert_array_equal(fused, composed)
+
+
+def test_multiclass_summary_pretty_printer():
+    """Mahout-style summary block (MulticlassClassifierEvaluator.scala:
+    123-167): spot-check headline metrics appear."""
+    preds = Dataset(np.array([0, 1, 2, 1, 0], np.int32))
+    actual = Dataset(np.array([0, 1, 1, 1, 0], np.int32))
+    s = MulticlassClassifierEvaluator(3).evaluate(preds, actual).summary()
+    assert "Confusion matrix" in s and "accuracy" in s.lower()

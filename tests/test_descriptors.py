@@ -77,3 +77,49 @@ def test_daisy_shapes_and_norm():
     assert out.shape == (49, 200)
     norms = np.linalg.norm(out, axis=1)
     np.testing.assert_allclose(norms[norms > 1e-6], 1.0, atol=1e-4)
+
+
+def test_hog_orientation_selectivity():
+    """Known-value property: a pure vertical-edge grating has all its
+    gradient energy in one orientation; HOG's dominant orientation bin
+    must carry (nearly) all the per-cell contrast energy (the reference
+    validates descriptors against known images,
+    test/scala/nodes/images/HogExtractorSuite)."""
+    # vertical stripes -> horizontal gradients, constant orientation
+    x = np.arange(64, dtype=np.float32)
+    img = np.tile(np.sin(x * np.pi / 4)[None, :, None], (64, 1, 3)) * 0.5 + 0.5
+    out = np.asarray(HogExtractor(cell_size=8).apply(img))
+    # contrast-insensitive block (features 18..27): one dominant bin
+    interior = out.reshape(8, 8, 31)[2:6, 2:6].reshape(-1, 31)
+    ci = interior[:, 18:27]
+    dominant = ci.max(axis=1)
+    total = ci.sum(axis=1)
+    assert np.all(dominant / np.maximum(total, 1e-8) > 0.45)
+    # rotating the image 90 deg moves the energy to a different bin
+    out_r = np.asarray(HogExtractor(cell_size=8).apply(img.transpose(1, 0, 2)))
+    ci_r = out_r.reshape(8, 8, 31)[2:6, 2:6].reshape(-1, 31)[:, 18:27]
+    assert not np.allclose(ci.mean(axis=0).argmax(), ci_r.mean(axis=0).argmax())
+
+
+def test_daisy_constant_image_is_zero():
+    """A constant image has zero gradients everywhere -> DAISY histograms
+    are all ~0 (normalization must not divide by zero)."""
+    img = np.full((48, 48, 3), 0.5, np.float32)
+    out = np.asarray(DaisyExtractor().apply(img))
+    assert np.isfinite(out).all()
+    assert np.abs(out).max() < 1e-3
+
+
+def test_lcs_constant_image_stats():
+    """LCS on a constant image: sub-patch means equal the constant and
+    stds are zero (LCSExtractor.scala:25-130 semantics)."""
+    img = np.full((64, 64, 3), 0.25, np.float32)
+    out = np.asarray(LCSExtractor().apply(img))
+    assert np.isfinite(out).all()
+    # keypoints at the image boundary see zero-padded sub-patches, so
+    # check an interior keypoint: all means == constant, all stds == 0
+    g = int(round(np.sqrt(out.shape[0])))
+    center = out.reshape(g, g, -1)[g // 2, g // 2]
+    nz = center[np.abs(center) > 1e-6]
+    assert np.allclose(nz, 0.25, atol=1e-5)
+    assert (np.abs(center) > 1e-6).sum() == center.size // 2  # stds are 0
