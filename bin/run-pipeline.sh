@@ -12,11 +12,9 @@ set -euo pipefail
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
 export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
 
-if [[ "${KEYSTONE_BACKEND:-}" == "cpu" ]]; then
-  export JAX_PLATFORMS=cpu
-  if [[ -n "${KEYSTONE_CPU_DEVICES:-}" ]]; then
-    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${KEYSTONE_CPU_DEVICES}"
-  fi
-fi
+# Backend forcing happens programmatically inside keystone_tpu.__main__
+# (jax.config updates) — env-var-only forcing breaks under site hooks
+# that snapshot/consume JAX_PLATFORMS/XLA_FLAGS. KEYSTONE_BACKEND and
+# KEYSTONE_CPU_DEVICES are read there.
 
 exec python -m keystone_tpu "$@"

@@ -69,8 +69,27 @@ def _pop_multihost_flags(argv):
     return rest
 
 
+def _apply_backend_env():
+    """Honor KEYSTONE_BACKEND/KEYSTONE_CPU_DEVICES programmatically.
+
+    jax.config updates are applied before any backend initializes, which
+    keeps working even in environments where plugin site hooks consume
+    or interfere with JAX_PLATFORMS/XLA_FLAGS env vars (the conftest
+    uses the same pattern for the test mesh)."""
+    import os
+
+    if os.environ.get("KEYSTONE_BACKEND") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        n = os.environ.get("KEYSTONE_CPU_DEVICES")
+        if n:
+            jax.config.update("jax_num_cpu_devices", int(n))
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    _apply_backend_env()
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print("Available pipelines:")

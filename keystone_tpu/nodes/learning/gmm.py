@@ -71,10 +71,14 @@ class GaussianMixtureModel(Transformer):
 
     @staticmethod
     def load_csv(means_path, variances_path, weights_path) -> "GaussianMixtureModel":
-        """Sideband CSV loading (GaussianMixtureModel.scala:97-105)."""
+        """Sideband CSV loading (GaussianMixtureModel.scala:97-105).
+
+        Reference on-disk layout is dims × clusters ("# of Dims by # of
+        Cluster", GaussianMixtureModel.scala:19); this class stores
+        (k, d), so means/variances transpose on load."""
         return GaussianMixtureModel(
-            np.loadtxt(means_path, delimiter=",", ndmin=2),
-            np.loadtxt(variances_path, delimiter=",", ndmin=2),
+            np.loadtxt(means_path, delimiter=",", ndmin=2).T,
+            np.loadtxt(variances_path, delimiter=",", ndmin=2).T,
             np.loadtxt(weights_path, delimiter=","),
         )
 

@@ -44,6 +44,13 @@ class VOCSIFTFisherConfig:
     mixture_weight: float = 0.5
     n_synth: int = 60
     seed: int = 0
+    # sideband model files (reference --pcaFile / --gmmMeanFile /
+    # --gmmVarFile / --gmmWtsFile, VOCSIFTFisher.scala:49-67): when set,
+    # the corresponding fit is skipped and the model loaded from CSV
+    pca_file: Optional[str] = None
+    gmm_mean_file: Optional[str] = None
+    gmm_var_file: Optional[str] = None
+    gmm_wts_file: Optional[str] = None
 
 
 def _synthetic_voc(n, num_classes, noise_seed, class_seed=1234):
@@ -79,18 +86,42 @@ def run(config: VOCSIFTFisherConfig):
         >> SIFTExtractor(step=6, num_scales=2)
     )
     # PCA fit on subsampled descriptors (reference :53-55 uses withData on
-    # the already-featurized sample, not and_then)
-    sampled = (sift >> ColumnSampler(config.descriptor_samples)).apply(train)
-    pca_featurizer = sift.and_then(
-        ColumnPCAEstimator(config.pca_dims).with_data(sampled)
-    )
-    fisher_sample = (
-        pca_featurizer >> ColumnSampler(config.descriptor_samples)
-    ).apply(train)
-    featurizer = (
-        pca_featurizer.and_then(
-            GMMFisherVectorEstimator(config.gmm_k).with_data(fisher_sample)
+    # the already-featurized sample, not and_then) — or loaded from the
+    # sideband file (reference :49-56)
+    if config.pca_file:
+        from ..nodes.learning.pca import BatchPCATransformer
+
+        # reference sideband layout is (k × d): csvread(fname).t
+        # (VOCSIFTFisher.scala:52); PCATransformer wants (d, k)
+        pca_featurizer = sift >> BatchPCATransformer(
+            np.loadtxt(config.pca_file, delimiter=",", ndmin=2).T
         )
+    else:
+        sampled = (sift >> ColumnSampler(config.descriptor_samples)).apply(train)
+        pca_featurizer = sift.and_then(
+            ColumnPCAEstimator(config.pca_dims).with_data(sampled)
+        )
+    if config.gmm_mean_file:
+        from ..nodes.images import FisherVector
+        from ..nodes.learning import GaussianMixtureModel
+
+        if not (config.gmm_var_file and config.gmm_wts_file):
+            raise ValueError(
+                "--gmm-mean-file requires --gmm-var-file and --gmm-wts-file"
+            )
+
+        fisher = FisherVector(
+            GaussianMixtureModel.load_csv(
+                config.gmm_mean_file, config.gmm_var_file, config.gmm_wts_file
+            )
+        ).to_pipeline()
+    else:
+        fisher_sample = (
+            pca_featurizer >> ColumnSampler(config.descriptor_samples)
+        ).apply(train)
+        fisher = GMMFisherVectorEstimator(config.gmm_k).with_data(fisher_sample)
+    featurizer = (
+        pca_featurizer.and_then(fisher)
         >> MatrixVectorizer()
         >> SignedHellingerMapper()
         >> NormalizeRows()
@@ -146,6 +177,10 @@ def main(argv=None):
     p.add_argument("--gmm-k", type=int, default=16)
     p.add_argument("--lam", type=float, default=0.5)
     p.add_argument("--n-synth", type=int, default=60)
+    p.add_argument("--pca-file")
+    p.add_argument("--gmm-mean-file")
+    p.add_argument("--gmm-var-file")
+    p.add_argument("--gmm-wts-file")
     args = p.parse_args(argv)
     config = VOCSIFTFisherConfig(
         **{k: v for k, v in vars(args).items() if v is not None}

@@ -102,3 +102,32 @@ def test_cli_registry_lists_and_dispatches(capsys):
     out = capsys.readouterr().out
     assert "pipelines.images.cifar.RandomPatchCifar" in out
     assert main(["NoSuchPipeline"]) == 2
+
+
+def test_voc_sideband_model_files(tmp_path):
+    """Reference --pcaFile/--gmm*File flags (VOCSIFTFisher.scala:49-67):
+    precomputed PCA + GMM load from CSV and skip fitting."""
+    import numpy as np
+
+    from keystone_tpu.pipelines.voc_sift_fisher import VOCSIFTFisherConfig, run
+
+    d, p, k = 128, 8, 4  # SIFT dim, PCA dims, GMM components
+    rng = np.random.default_rng(0)
+    # reference on-disk layouts: PCA is (k x d) (csvread(...).t at
+    # VOCSIFTFisher.scala:52), GMM means/vars are dims x clusters
+    pca = rng.normal(size=(p, d)).astype(np.float32)
+    np.savetxt(tmp_path / "pca.csv", pca, delimiter=",")
+    np.savetxt(tmp_path / "m.csv", rng.normal(size=(p, k)), delimiter=",")
+    np.savetxt(tmp_path / "v.csv", rng.uniform(0.5, 1.5, size=(p, k)), delimiter=",")
+    np.savetxt(tmp_path / "w.csv", np.full(k, 1.0 / k), delimiter=",")
+
+    cfg = VOCSIFTFisherConfig(
+        num_classes=3, n_synth=9, gmm_k=k, pca_dims=p,
+        pca_file=str(tmp_path / "pca.csv"),
+        gmm_mean_file=str(tmp_path / "m.csv"),
+        gmm_var_file=str(tmp_path / "v.csv"),
+        gmm_wts_file=str(tmp_path / "w.csv"),
+    )
+    result = run(cfg)
+    assert np.isfinite(result["map"])
+    assert len(result["aps"]) == 3
