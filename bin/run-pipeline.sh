@@ -4,9 +4,12 @@
 #
 #   ./bin/run-pipeline.sh pipelines.images.cifar.RandomPatchCifar --num-filters 256
 #
+#   ./bin/run-pipeline.sh --backend=tpu pipelines.speech.TimitPipeline ...
+#
+# Flags:
+#   --backend tpu|cpu          (anywhere on the line; also via env
+#                               KEYSTONE_BACKEND)
 # Env:
-#   KEYSTONE_BACKEND=tpu|cpu   (default: whatever jax picks; cpu forces
-#                               JAX_PLATFORMS=cpu)
 #   KEYSTONE_CPU_DEVICES=N     (virtual device count when backend=cpu)
 set -euo pipefail
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"

@@ -69,6 +69,41 @@ def _pop_multihost_flags(argv):
     return rest
 
 
+def _normalize_flags(argv):
+    """Accept the reference apps' scopt camelCase flags verbatim:
+    `--numFFTs 4 --blockSize 2048` → `--num-ffts 4 --block-size 2048`
+    (the reference CLI contract, e.g. MnistRandomFFT.scala:80-97)."""
+    import re
+
+    out = []
+    for a in argv:
+        if a.startswith("--"):
+            flag, eq, val = a.partition("=")
+            flag = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "-", flag).lower()
+            a = flag + eq + val
+        out.append(a)
+    return out
+
+
+def _pop_backend_flag(argv):
+    """`--backend tpu|cpu` anywhere on the command line (the north-star
+    launcher contract: run-pipeline.sh --backend=tpu) → KEYSTONE_BACKEND."""
+    import os
+
+    out = []
+    it = iter(argv)
+    for a in it:
+        flag, eq, inline = a.partition("=")
+        if flag == "--backend":
+            val = inline if eq else next(it, None)
+            if not val:
+                raise SystemExit("--backend requires a value (tpu|cpu)")
+            os.environ["KEYSTONE_BACKEND"] = val
+        else:
+            out.append(a)
+    return out
+
+
 def _apply_backend_env():
     """Honor KEYSTONE_BACKEND/KEYSTONE_CPU_DEVICES programmatically.
 
@@ -88,7 +123,7 @@ def _apply_backend_env():
 
 
 def main(argv=None):
-    argv = list(sys.argv[1:] if argv is None else argv)
+    argv = _pop_backend_flag(list(sys.argv[1:] if argv is None else argv))
     _apply_backend_env()
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
@@ -97,7 +132,7 @@ def main(argv=None):
             print(f"  {name}")
         return 0
     argv = _pop_multihost_flags(argv)
-    name, rest = argv[0], argv[1:]
+    name, rest = argv[0], _normalize_flags(argv[1:])
     entry = REGISTRY.get(name) or _SHORT.get(name)
     if entry is None:
         print(f"unknown pipeline {name!r}; run with --help to list", file=sys.stderr)
