@@ -1,6 +1,6 @@
 """Benchmark: RandomPatchCifar featurize+solve throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Baseline: the driver-defined north star is RandomPatchCifar over 50 000
 CIFAR images reaching >=84% accuracy in <60 s on a v5e-16 pod, i.e.
@@ -9,26 +9,230 @@ single-chip warm throughput against the full-pod 833 img/s target, so
 vs_baseline > 1.0 means one chip alone already beats the whole-pod
 reference rate.
 
+Wedge resilience: the TPU here sits behind the axon tunnel, which can
+wedge for hours (any device op hangs until killed). This driver-facing
+entry therefore NEVER touches the device in-process. It
+  1. probes device liveness in a subprocess with a hard timeout,
+  2. runs the workload in a killable child process (``--child``) that
+     emits phase markers as it progresses,
+  3. retries within a deadline, and
+  4. ALWAYS prints valid JSON — on persistent failure the record carries
+     an "error" plus the last-known-good measurement from
+     BENCH_LAST_GOOD.json (marked "stale": true) instead of a traceback.
+
 Uses the learnable synthetic CIFAR task (no dataset egress in this
-environment); pass --train-path to run on real CIFAR binaries.
+environment — see BENCH notes); pass --train-path for real CIFAR binaries.
 """
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+LAST_GOOD = os.path.join(REPO, "BENCH_LAST_GOOD.json")
+BASELINE_IMGS_PER_SEC = 833.0  # north-star pod rate: 50k imgs / 60 s on v5e-16
+
+PROBE_SRC = (
+    "import os, jax;"
+    "jax.config.update('jax_platforms', 'cpu') "
+    "if os.environ.get('KEYSTONE_BACKEND') == 'cpu' else None;"
+    "import jax.numpy as jnp;"
+    "print('devices', jax.devices());"
+    "print('probe_sum', float(jnp.ones((2, 2)).sum()))"
+)
+
+
+def log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_device(timeout_s: float) -> bool:
+    """True iff a trivial device op completes within timeout_s (run in a
+    subprocess so a wedged tunnel cannot hang this process)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-u", "-c", PROBE_SRC],
+            timeout=timeout_s, capture_output=True, text=True, cwd=REPO,
+        )
+        ok = r.returncode == 0 and "probe_sum" in r.stdout
+        log(f"liveness probe: {'ok' if ok else 'failed'}"
+            + ("" if ok else f" (rc={r.returncode}, {r.stderr.strip()[-200:]})"))
+        return ok
+    except subprocess.TimeoutExpired:
+        log(f"liveness probe: timed out after {timeout_s:.0f}s (tunnel wedged)")
+        return False
+
+
+def run_child(args, timeout_s: float):
+    """Run the measured workload in a child; returns (detail dict | None,
+    phases list). Phase markers let a killed run report partial progress."""
+    cmd = [
+        sys.executable, "-u", os.path.abspath(__file__), "--child",
+        "--n-train", str(args.n_train), "--n-test", str(args.n_test),
+        "--num-filters", str(args.num_filters),
+    ]
+    if args.train_path:
+        cmd += ["--train-path", args.train_path]
+    if args.test_path:
+        cmd += ["--test-path", args.test_path]
+    import threading
+
+    phases = []
+    detail = [None]
+
+    def consume(pipe):
+        # Reader thread: a wedged child stops producing output without
+        # exiting, so the parent must never block on readline itself.
+        for line in pipe:
+            line = line.strip()
+            try:
+                if line.startswith("BENCH_PHASE "):
+                    phases.append(json.loads(line[len("BENCH_PHASE "):]))
+                    log(f"phase: {phases[-1]}")
+                elif line.startswith("BENCH_DETAIL "):
+                    detail[0] = json.loads(line[len("BENCH_DETAIL "):])
+            except ValueError as e:
+                log(f"unparseable child line {line[:120]!r}: {e}")
+
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, text=True, cwd=REPO
+        )
+        reader = threading.Thread(target=consume, args=(proc.stdout,), daemon=True)
+        reader.start()
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log(f"child timed out after {timeout_s:.0f}s; killing")
+            return None, phases
+        reader.join(timeout=10.0)
+        if proc.returncode != 0:
+            log(f"child exited rc={proc.returncode}")
+            return None, phases
+        return detail[0], phases
+    except Exception as e:  # never let an exception skip the JSON record
+        log(f"child failed: {e!r}")
+        return None, phases
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def emit(record):
+    print(json.dumps(record), flush=True)
+
+
+def result_record(detail, extra=None):
+    imgs_per_sec = detail["images_per_sec"]
+    rec = {
+        "metric": "cifar_randompatch_train_images_per_sec",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec (1 chip, warm)",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 4),
+        "detail": detail,
+    }
+    if extra:
+        rec.update(extra)
+    return rec
 
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--train-path")
     p.add_argument("--test-path")
-    p.add_argument("--n-train", type=int, default=10_000)
-    p.add_argument("--n-test", type=int, default=2_000)
+    p.add_argument("--n-train", type=int, default=50_000)
+    p.add_argument("--n-test", type=int, default=10_000)
     p.add_argument("--num-filters", type=int, default=256)
+    p.add_argument("--liveness-timeout", type=float, default=90.0)
+    p.add_argument("--run-timeout", type=float, default=1500.0)
+    p.add_argument("--retry-wait", type=float, default=120.0)
+    p.add_argument("--attempts", type=int, default=3)
+    p.add_argument("--deadline", type=float, default=2700.0,
+                   help="total seconds before giving up and emitting the "
+                        "error record")
     args = p.parse_args()
 
+    if args.child:
+        return child_main(args)
+
+    t_start = time.monotonic()
+    error = None
+    for attempt in range(1, args.attempts + 1):
+        remaining = args.deadline - (time.monotonic() - t_start)
+        if remaining <= args.liveness_timeout:
+            error = error or "deadline exhausted before a live-device attempt"
+            break
+        log(f"attempt {attempt}/{args.attempts} "
+            f"({remaining:.0f}s of deadline left)")
+        if not probe_device(min(args.liveness_timeout, remaining)):
+            error = "device liveness probe failed (axon tunnel wedged)"
+            if attempt < args.attempts:
+                time.sleep(min(args.retry_wait,
+                               max(0.0, args.deadline - (time.monotonic() - t_start))))
+            continue
+        remaining = args.deadline - (time.monotonic() - t_start)
+        detail, phases = run_child(args, min(args.run_timeout, remaining))
+        if detail is not None:
+            rec = result_record(detail)
+            if detail.get("platform") != "cpu":  # only real-device runs
+                # qualify as the stale-fallback record
+                try:
+                    with open(LAST_GOOD, "w") as f:
+                        json.dump(rec, f, indent=1)
+                except OSError as e:
+                    log(f"could not persist last-good record: {e}")
+            emit(rec)
+            return 0
+        error = ("workload run failed/timed out"
+                 + (f"; last phase: {phases[-1]}" if phases else " before any phase"))
+        if attempt < args.attempts:
+            time.sleep(max(0.0, min(args.retry_wait,
+                                    args.deadline - (time.monotonic() - t_start))))
+
+    # Persistent failure: valid JSON with the last-known-good measurement.
+    stale = None
+    if os.path.exists(LAST_GOOD):
+        try:
+            with open(LAST_GOOD) as f:
+                stale = json.load(f)
+        except (OSError, ValueError):
+            stale = None
+    if stale is not None:
+        stale.setdefault("detail", {})
+        stale["detail"]["stale"] = True
+        stale["error"] = error
+        emit(stale)
+    else:
+        emit({
+            "metric": "cifar_randompatch_train_images_per_sec",
+            "value": 0.0,
+            "unit": "images/sec (1 chip, warm)",
+            "vs_baseline": 0.0,
+            "error": error,
+        })
+    return 0
+
+
+def phase(name, **kw):
+    print("BENCH_PHASE " + json.dumps({"phase": name, **kw}), flush=True)
+
+
+def child_main(args):
+    """The measured workload. Runs in a killable subprocess; prints phase
+    markers and finally one BENCH_DETAIL line."""
+    if os.environ.get("KEYSTONE_BACKEND") == "cpu":  # debug/test path; the
+        # programmatic override works where env-var platform forcing can
+        # hang under the axon sitecustomize (see keystone_tpu/__main__.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    phase("import")
     from keystone_tpu.pipelines.random_patch_cifar import (
         RandomPatchCifarConfig,
         build_pipeline,
@@ -36,48 +240,56 @@ def main():
     from keystone_tpu.loaders.cifar_loader import cifar_loader, synthetic_cifar
     from keystone_tpu.evaluation import MulticlassClassifierEvaluator
     from keystone_tpu.workflow import PipelineEnv
+    import jax
+
+    phase("devices", platform=jax.devices()[0].platform,
+          n=len(jax.devices()))
 
     config = RandomPatchCifarConfig(num_filters=args.num_filters)
     if args.train_path:
         train = cifar_loader(args.train_path)
         test = cifar_loader(args.test_path or args.train_path)
+        synthetic = False
     else:
         train, test = synthetic_cifar(args.n_train, args.n_test)
+        synthetic = True
+    phase("data", n_train=train.data.count, n_test=test.data.count,
+          synthetic=synthetic)
 
     # Warm-up at the SAME shapes (jit caches are shape-keyed): run the
     # full workload once untimed so the measured run reflects steady-state
-    # TPU throughput, not compile time.
+    # TPU throughput, not compile time. This also places the training
+    # arrays on device once; the timed run reuses them.
     evaluator = MulticlassClassifierEvaluator(config.num_classes)
     warm_pipe = build_pipeline(train, config)
     evaluator(warm_pipe(train.data), train.labels)
+    phase("warm_done")
+
     PipelineEnv.reset()
     t0 = time.perf_counter()
     predictor = build_pipeline(train, config)
     train_metrics = evaluator(predictor(train.data), train.labels)
     elapsed = time.perf_counter() - t0
+    phase("timed_done", seconds=round(elapsed, 3))
     test_metrics = evaluator(predictor(test.data), test.labels)
 
-    imgs_per_sec = train.data.count / elapsed
-    baseline = 833.0  # north-star pod rate: 50k imgs / 60 s on v5e-16
-    print(
-        json.dumps(
-            {
-                "metric": "cifar_randompatch_train_images_per_sec",
-                "value": round(imgs_per_sec, 2),
-                "unit": "images/sec (1 chip, warm)",
-                "vs_baseline": round(imgs_per_sec / baseline, 4),
-                "detail": {
-                    "n_train": train.data.count,
-                    "train_seconds": round(elapsed, 3),
-                    "train_error": round(train_metrics.error, 4),
-                    "test_accuracy": round(test_metrics.accuracy, 4),
-                    "num_filters": config.num_filters,
-                    "synthetic": not bool(args.train_path),
-                },
-            }
-        )
-    )
+    detail = {
+        "n_train": train.data.count,
+        "train_seconds": round(elapsed, 3),
+        "images_per_sec": round(train.data.count / elapsed, 2),
+        "train_error": round(train_metrics.error, 4),
+        "test_accuracy": round(test_metrics.accuracy, 4),
+        "num_filters": config.num_filters,
+        "synthetic": synthetic,
+        "platform": jax.devices()[0].platform,
+        "data_note": (None if not synthetic else
+                      "real CIFAR-10 binaries are not obtainable in this "
+                      "zero-egress environment; synthetic learnable task at "
+                      "identical shapes/scale (see BENCH notes in README)"),
+    }
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

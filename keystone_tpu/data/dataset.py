@@ -64,10 +64,21 @@ class Dataset:
             if n < self.count:
                 raise ValueError("count exceeds data length")
             data = jax.tree_util.tree_map(lambda x: _pad_to(x[: self.count], padded), data)
-            sharding = NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
-            self.data = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sharding), data
-            )
+            # On a ('data', 'model') mesh, 2-D (n, d) leaves also shard
+            # their feature axis over 'model' — the library-level analog
+            # of the reference's VectorSplitter feature blocking. Other
+            # ranks (images, label vectors of odd widths) stay data-only
+            # and replicate over the model axis.
+            row_sh = NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
+
+            def place(x):
+                feat_sh = (
+                    meshlib.feature_sharding(self.mesh, x.shape[1])
+                    if x.ndim == 2 else None
+                )
+                return jax.device_put(x, feat_sh if feat_sh is not None else row_sh)
+
+            self.data = jax.tree_util.tree_map(place, data)
 
     # ------------------------------------------------------------- factories
 

@@ -36,17 +36,24 @@ from ...data.dataset import Dataset
 from ...workflow.pipeline import LabelEstimator, Transformer
 
 
-@partial(jax.jit, static_argnames=("block_size", "num_blocks", "num_iter", "center"))
+@partial(
+    jax.jit,
+    static_argnames=("block_size", "num_blocks", "num_iter", "center", "x_sharding"),
+)
 def _bcd_fit(
-    X, Y, mask, lam, block_size: int, num_blocks: int, num_iter: int, center: bool
+    X, Y, mask, lam, block_size: int, num_blocks: int, num_iter: int, center: bool,
+    x_sharding=None,
 ):
     # Solver numerics need true f32 Gram matrices: on TPU the default
     # matmul precision is bf16, which caps BCD's convergence floor.
     with jax.default_matmul_precision("highest"):
-        return _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center)
+        return _bcd_fit_impl(
+            X, Y, mask, lam, block_size, num_blocks, num_iter, center, x_sharding
+        )
 
 
-def _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center):
+def _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center,
+                  x_sharding=None):
     n_pad, d_pad = X.shape
     k = Y.shape[1]
     dtype = X.dtype
@@ -62,6 +69,13 @@ def _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center):
         ym = jnp.zeros((k,), dtype)
         Xc = X * mask[:, None]
         Yc = Y * mask[:, None]
+
+    if x_sharding is not None:
+        # dp × tp layout on a ('data', 'model') mesh: the feature axis of
+        # X is model-sharded (reference VectorSplitter → SURVEY §2.7);
+        # per-block Grams then all-reduce over 'data' while block slices
+        # move over 'model' via XLA-inserted collectives.
+        Xc = jax.lax.with_sharding_constraint(Xc, x_sharding)
 
     eye = lam * jnp.eye(block_size, dtype=dtype)
 
@@ -148,6 +162,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         self.weight = 3 * num_iter + 1
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
+        from ...parallel import mesh as meshlib
+
         X, Y = data.array, labels.array
         d = X.shape[1]
         bs = min(self.block_size, d)
@@ -164,5 +180,6 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             num_blocks,
             self.num_iter,
             self.fit_intercept,
+            x_sharding=meshlib.feature_sharding(data.mesh, d_pad),
         )
         return BlockLinearMapper(W, b if self.fit_intercept else None, self.block_size)

@@ -63,7 +63,27 @@ def _time_chained(build_step, x0, iters: int) -> float:
     run(iters), run(2 * iters)  # warm both compiles
     t1 = np.median([run(iters) for _ in range(3)])
     t2 = np.median([run(2 * iters) for _ in range(3)])
-    return max(float(t2 - t1), 1e-9) / iters
+    return float(t2 - t1) / iters
+
+
+def _probe(build_step, x0, iters: int, fallback: float, name: str) -> float:
+    """Differenced timing with a noise guard: a ~0 or negative difference
+    (fast probes, shared hosts) means the measurement is noise — clamping
+    it would produce an absurdly small per-unit weight that silently
+    skews solver routing. Retry once with 4× the work; if still not
+    cleanly positive, keep the baked default and warn."""
+    import logging
+
+    t = _time_chained(build_step, x0, iters)
+    if t <= 0.0:
+        t = _time_chained(build_step, x0, 4 * iters)
+    if t <= 0.0:
+        logging.getLogger(__name__).warning(
+            "cost-model %s probe was noise (differenced time <= 0); "
+            "keeping default weight", name,
+        )
+        return fallback
+    return t
 
 
 def calibrate_cost_weights(
@@ -78,14 +98,18 @@ def calibrate_cost_weights(
 
     # --- MXU: square GEMM, 2·D³ flops/iter ----------------------------
     a = jnp.ones((gemm_dim, gemm_dim), jnp.float32)
-    t = _time_chained(lambda x: x @ a / jnp.float32(gemm_dim), a, iters)
-    cpu_weight = t / (2.0 * gemm_dim**3)
+    flops = 2.0 * gemm_dim**3
+    t = _probe(lambda x: x @ a / jnp.float32(gemm_dim), a, iters,
+               fallback=CPU_WEIGHT * flops, name="cpu")
+    cpu_weight = t / flops
 
     # --- HBM: elementwise pass over a large buffer (read + write) -----
     n = mem_mb * (1 << 20) // 4
     v = jnp.ones((n,), jnp.float32)
-    t = _time_chained(lambda x: x * 1.000001 + 1e-9, v, iters)
-    mem_weight = t / (2.0 * 4.0 * n)
+    hbm_bytes = 2.0 * 4.0 * n
+    t = _probe(lambda x: x * 1.000001 + 1e-9, v, iters,
+               fallback=MEM_WEIGHT * hbm_bytes, name="mem")
+    mem_weight = t / hbm_bytes
 
     # --- ICI: psum of a sharded buffer over the data axis -------------
     rows = meshlib.n_data_shards(mesh)
@@ -115,9 +139,11 @@ def calibrate_cost_weights(
                 return shard_map(local, mesh=mesh, in_specs=P(axis),
                                  out_specs=P(axis), check_rep=False)(x)
 
-        t = _time_chained(step, xs, iters)
+        ici_bytes = 4.0 * m * 2.0 * (rows - 1) / rows
         # ring all-reduce moves ~2·(p−1)/p of the buffer per chip
-        network_weight = t / (4.0 * m * 2.0 * (rows - 1) / rows)
+        t = _probe(step, xs, iters, fallback=NETWORK_WEIGHT * ici_bytes,
+                   name="network")
+        network_weight = t / ici_bytes
 
     return CostWeights(cpu_weight, mem_weight, network_weight)
 

@@ -79,7 +79,8 @@ class GaussianMixtureModel(Transformer):
         return GaussianMixtureModel(
             np.loadtxt(means_path, delimiter=",", ndmin=2).T,
             np.loadtxt(variances_path, delimiter=",", ndmin=2).T,
-            np.loadtxt(weights_path, delimiter=","),
+            # k=1 yields a 0-d array from loadtxt; posteriors need (k,)
+            np.atleast_1d(np.loadtxt(weights_path, delimiter=",")),
         )
 
 

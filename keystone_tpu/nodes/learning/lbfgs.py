@@ -24,19 +24,27 @@ from ...workflow.pipeline import LabelEstimator
 from .linear import LinearMapper, SparseLinearMapper
 
 
-@partial(jax.jit, static_argnames=("num_iters", "memory_size", "fit_intercept"))
+@partial(
+    jax.jit,
+    static_argnames=("num_iters", "memory_size", "fit_intercept", "x_sharding"),
+)
 def _lbfgs_fit(
-    X, Y, mask, lam, count, num_iters: int, memory_size: int, fit_intercept: bool
+    X, Y, mask, lam, count, num_iters: int, memory_size: int, fit_intercept: bool,
+    x_sharding=None,
 ):
     with jax.default_matmul_precision("highest"):
         return _lbfgs_fit_impl(
-            X, Y, mask, lam, count, num_iters, memory_size, fit_intercept
+            X, Y, mask, lam, count, num_iters, memory_size, fit_intercept, x_sharding
         )
 
 
-def _lbfgs_fit_impl(X, Y, mask, lam, count, num_iters, memory_size, fit_intercept):
+def _lbfgs_fit_impl(X, Y, mask, lam, count, num_iters, memory_size, fit_intercept,
+                    x_sharding=None):
     d, k = X.shape[1], Y.shape[1]
     dtype = X.dtype
+
+    if x_sharding is not None:  # dp × tp layout on a ('data','model') mesh
+        X = jax.lax.with_sharding_constraint(X, x_sharding)
 
     if fit_intercept:
         xm = jnp.sum(X, axis=0) / count
@@ -94,6 +102,8 @@ class DenseLBFGSwithL2(LabelEstimator):
         self.weight = num_iters  # passes over the input
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        from ...parallel import mesh as meshlib
+
         X, Y = data.array, labels.array
         W, b, self.loss_history = _lbfgs_fit(
             X,
@@ -104,6 +114,7 @@ class DenseLBFGSwithL2(LabelEstimator):
             self.num_iters,
             self.memory_size,
             self.fit_intercept,
+            x_sharding=meshlib.feature_sharding(data.mesh, X.shape[1]),
         )
         return LinearMapper(W, b if self.fit_intercept else None)
 

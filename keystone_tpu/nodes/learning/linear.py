@@ -59,13 +59,15 @@ class LinearMapper(Transformer):
         return data.map_batches(lambda X: _gemm_bias(X, self.W, b), jitted=False)
 
 
-@partial(jax.jit, static_argnames=("fit_intercept",))
-def _normal_equations(X, Y, count, lam, fit_intercept: bool):
+@partial(jax.jit, static_argnames=("fit_intercept", "x_sharding"))
+def _normal_equations(X, Y, count, lam, fit_intercept: bool, x_sharding=None):
     with jax.default_matmul_precision("highest"):
-        return _normal_equations_impl(X, Y, count, lam, fit_intercept)
+        return _normal_equations_impl(X, Y, count, lam, fit_intercept, x_sharding)
 
 
-def _normal_equations_impl(X, Y, count, lam, fit_intercept):
+def _normal_equations_impl(X, Y, count, lam, fit_intercept, x_sharding=None):
+    if x_sharding is not None:  # dp × tp Gram on a ('data','model') mesh
+        X = jax.lax.with_sharding_constraint(X, x_sharding)
     # Raw sums are exact under zero-padding.
     A = X.T @ X
     B = X.T @ Y
@@ -93,12 +95,15 @@ class LinearMapEstimator(LabelEstimator):
         self.fit_intercept = fit_intercept
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        from ...parallel import mesh as meshlib
+
         W, b = _normal_equations(
             data.array,
             labels.array,
             jnp.float32(data.count),
             jnp.float32(self.lam),
             self.fit_intercept,
+            x_sharding=meshlib.feature_sharding(data.mesh, data.array.shape[1]),
         )
         return LinearMapper(W, b if self.fit_intercept else None)
 

@@ -14,6 +14,7 @@ from .mesh import (
     MODEL_AXIS,
     current_mesh,
     data_sharding,
+    feature_sharding,
     make_mesh,
     replicate,
     replicated_sharding,
@@ -32,6 +33,7 @@ __all__ = [
     "MODEL_AXIS",
     "current_mesh",
     "data_sharding",
+    "feature_sharding",
     "make_mesh",
     "replicate",
     "replicated_sharding",

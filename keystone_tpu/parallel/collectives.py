@@ -45,15 +45,58 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 
 # jitted programs keyed on (kind, mesh, axis[, seq_op]) — rebuilding the
 # closure per call would retrace/recompile every invocation, turning a
-# per-iteration solver reduce into a per-iteration compile
-_COLLECTIVE_CACHE: dict = {}
+# per-iteration solver reduce into a per-iteration compile. The cache is
+# a bounded LRU so pathological callers (fresh unhashable closures every
+# call) can't grow it without limit.
+from collections import OrderedDict
+
+_COLLECTIVE_CACHE: OrderedDict = OrderedDict()
+_COLLECTIVE_CACHE_MAX = 128
 
 
 def _cached(key, build):
     fn = _COLLECTIVE_CACHE.get(key)
     if fn is None:
-        fn = _COLLECTIVE_CACHE[key] = jax.jit(build())
+        fn = jax.jit(build())
+        _COLLECTIVE_CACHE[key] = fn
+        if len(_COLLECTIVE_CACHE) > _COLLECTIVE_CACHE_MAX:
+            _COLLECTIVE_CACHE.popitem(last=False)
+    else:
+        _COLLECTIVE_CACHE.move_to_end(key)
     return fn
+
+
+def _fn_key(fn):
+    """Cache identity for a user callback: two lambdas with identical
+    code, closure values, and defaults share one compiled program, so
+    inline ``lambda``s in loops reuse instead of recompiling every
+    iteration. Values are keyed with their types (1 vs 1.0 vs True hash
+    equal but trace differently). Bound methods and anything whose
+    captured state can't be hashed fall back to object identity."""
+    import types
+
+    if isinstance(fn, types.MethodType):
+        return fn  # state lives on __self__; identity is the safe key
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn
+
+    def typed(v):
+        return (type(v), v)
+
+    try:
+        cells = tuple(
+            typed(c.cell_contents) for c in (getattr(fn, "__closure__", None) or ())
+        )
+        defaults = tuple(typed(v) for v in (fn.__defaults__ or ()))
+        kwdefaults = tuple(
+            sorted((k, typed(v)) for k, v in (fn.__kwdefaults__ or {}).items())
+        )
+        key = (code, cells, defaults, kwdefaults)
+        hash(key)
+    except (ValueError, TypeError):  # unfilled cell / unhashable value
+        return fn
+    return key
 
 
 def tree_reduce_sum(x, mesh=None, axis: str = meshlib.DATA_AXIS):
@@ -90,7 +133,7 @@ def tree_aggregate(x, seq_op, mesh=None, axis: str = meshlib.DATA_AXIS):
 
         return _shard_map(local, mesh, in_specs=(P(axis),), out_specs=P())
 
-    return _cached(("tree_aggregate", mesh, axis, seq_op), build)(x)
+    return _cached(("tree_aggregate", mesh, axis, _fn_key(seq_op)), build)(x)
 
 
 def broadcast(x, mesh=None):

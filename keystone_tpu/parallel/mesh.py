@@ -94,6 +94,25 @@ def data_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     return NamedSharding(mesh or current_mesh(), P(DATA_AXIS))
 
 
+def feature_sharding(
+    mesh: Optional[Mesh] = None, d: Optional[int] = None
+) -> Optional[NamedSharding]:
+    """P("data", "model") for (n, d) solver matrices — the feature-axis
+    scale-out that replaces the reference's VectorSplitter feature
+    blocking over Seq[RDD] (VectorSplitter.scala:10-36, SURVEY §2.7).
+    Returns None on meshes without a model axis (plain data sharding is
+    the whole story there), or when ``d`` is given and not divisible by
+    the model-axis size (explicit shardings require even shards; such
+    arrays stay model-replicated)."""
+    mesh = mesh or current_mesh()
+    shards = mesh.shape.get(MODEL_AXIS, 1)
+    if shards <= 1:
+        return None
+    if d is not None and d % shards != 0:
+        return None
+    return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+
+
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     return NamedSharding(mesh or current_mesh(), P())
 

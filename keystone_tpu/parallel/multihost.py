@@ -83,6 +83,13 @@ def global_data_mesh(model_shards: int = 1) -> Mesh:
         raise ValueError(
             f"{len(devs)} devices not divisible by model_shards={model_shards}"
         )
+    if jax.process_count() > 1 and jax.local_device_count() % model_shards:
+        raise ValueError(
+            f"model_shards={model_shards} does not divide "
+            f"local_device_count={jax.local_device_count()}: the model axis "
+            "would straddle hosts and its collectives would ride DCN, "
+            "defeating the ICI-local layout this mesh promises"
+        )
     grid = devs.reshape(len(devs) // model_shards, model_shards)
     return Mesh(grid, (meshlib.DATA_AXIS, meshlib.MODEL_AXIS))
 

@@ -51,6 +51,32 @@ def test_tree_aggregate_moments():
     assert float(agg["n"]) == 64.0
 
 
+def test_collective_cache_fn_key():
+    # distinct callbacks sharing a code object must NOT collide (defaults,
+    # closures, value types); identical ones must (reuse, not recompile)
+    from keystone_tpu.parallel.collectives import _fn_key
+
+    def by_default(s):
+        return lambda a, scale=s: a * scale
+
+    def by_closure(s):
+        return lambda a: a * s
+
+    assert _fn_key(by_default(2.0)) != _fn_key(by_default(3.0))
+    assert _fn_key(by_default(2.0)) == _fn_key(by_default(2.0))
+    assert _fn_key(by_closure(2.0)) != _fn_key(by_closure(3.0))
+    assert _fn_key(by_closure(2.0)) == _fn_key(by_closure(2.0))
+    assert _fn_key(by_closure(1)) != _fn_key(by_closure(1.0))  # 1 == 1.0 but
+    # traces to a different program
+
+    class T:
+        def m(self, x):
+            return x
+
+    t1, t2 = T(), T()
+    assert _fn_key(t1.m) != _fn_key(t2.m)  # state lives on self
+
+
 def test_broadcast_is_replicated():
     w = jnp.ones((4, 4))
     wb = broadcast(w)
@@ -94,6 +120,70 @@ def test_dataset_from_process_local_single_process():
     np.testing.assert_array_equal(ds.numpy(), rows)
     # padded + sharded over data axis
     assert ds.array.sharding.spec == P(DATA_AXIS)
+
+
+def _mesh_2d(data_shards=4, model_shards=2):
+    from keystone_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(
+        jax.devices()[: data_shards * model_shards],
+        shape=(data_shards, model_shards),
+        axis_names=(DATA_AXIS, "model"),
+    )
+
+
+def test_dataset_feature_axis_sharded_on_2d_mesh():
+    # (n, d) leaves shard d over 'model' — the library-level analog of
+    # VectorSplitter feature blocking (SURVEY §2.7 row 2)
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.parallel.mesh import use_mesh
+
+    X = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    with use_mesh(_mesh_2d()):
+        ds = Dataset(X)
+        assert ds.array.sharding.spec == P(DATA_AXIS, "model")
+        # images (4-D) stay data-sharded / model-replicated
+        imgs = Dataset(np.zeros((16, 4, 4, 3), np.float32))
+        assert imgs.array.sharding.spec == P(DATA_AXIS)
+    np.testing.assert_array_equal(ds.numpy(), X)
+
+
+def test_bcd_on_2d_mesh():
+    # fitting over a ('data','model') mesh must give the same model as a
+    # single device: the tp sharding changes layout, not math
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(96, 24)).astype(np.float32)
+    W = rng.normal(size=(24, 3)).astype(np.float32)
+    Y = X @ W + 0.01 * rng.normal(size=(96, 3)).astype(np.float32)
+    est = lambda: BlockLeastSquaresEstimator(block_size=8, num_iter=4, lam=0.1)
+    with use_mesh(make_mesh(jax.devices()[:1])):
+        m1 = est().fit(Dataset(X), Dataset(Y))
+    with use_mesh(_mesh_2d()):
+        m2d = est().fit(Dataset(X), Dataset(Y))
+    np.testing.assert_allclose(np.asarray(m1.W), np.asarray(m2d.W), atol=2e-3)
+    np.testing.assert_allclose(np.asarray(m1.b), np.asarray(m2d.b), atol=2e-3)
+
+
+def test_exact_and_lbfgs_on_2d_mesh():
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import DenseLBFGSwithL2, LinearMapEstimator
+    from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(64, 16)).astype(np.float32)
+    Y = (X @ rng.normal(size=(16, 2)).astype(np.float32))
+    with use_mesh(make_mesh(jax.devices()[:1])):
+        exact1 = LinearMapEstimator(lam=0.5).fit(Dataset(X), Dataset(Y))
+        lbfgs1 = DenseLBFGSwithL2(lam=0.5, num_iters=15).fit(Dataset(X), Dataset(Y))
+    with use_mesh(_mesh_2d()):
+        exact2 = LinearMapEstimator(lam=0.5).fit(Dataset(X), Dataset(Y))
+        lbfgs2 = DenseLBFGSwithL2(lam=0.5, num_iters=15).fit(Dataset(X), Dataset(Y))
+    np.testing.assert_allclose(np.asarray(exact1.W), np.asarray(exact2.W), atol=2e-3)
+    np.testing.assert_allclose(np.asarray(lbfgs1.W), np.asarray(lbfgs2.W), atol=2e-3)
 
 
 def test_solver_agrees_across_mesh_shapes():
