@@ -237,6 +237,18 @@ class PixelScaler(Transformer):
     def apply(self, x):
         return jnp.asarray(x, jnp.float32) / 255.0
 
+    def apply_batch(self, data):
+        from ...data.dataset import HostDataset
+
+        if isinstance(data, HostDataset):
+            # stay host-resident: variable-size images reach the device
+            # only at the bucketed extractor dispatch, not one round
+            # trip per item here
+            import numpy as np
+
+            return data.map(lambda x: np.asarray(x, np.float32) / 255.0)
+        return super().apply_batch(data)
+
     def batch_fn(self):
         return self.apply
 
@@ -257,6 +269,19 @@ class GrayScaler(Transformer):
         from ...utils.images import grayscale
 
         return grayscale(x)
+
+    def apply_batch(self, data):
+        from ...data.dataset import HostDataset
+
+        if isinstance(data, HostDataset):  # host-resident (see PixelScaler)
+            import numpy as np
+
+            w = np.asarray([0.299, 0.587, 0.114], np.float32)
+            return data.map(
+                lambda x: x if x.shape[-1] == 1
+                else np.sum(np.asarray(x, np.float32) * w, -1, keepdims=True)
+            )
+        return super().apply_batch(data)
 
 
 class Cropper(Transformer):

@@ -22,7 +22,9 @@ from .sift import _gaussian_kernel
 
 
 class _GridDescriptorExtractor(Transformer):
-    """Shared batch plumbing: jit per item fn, vmap for device batches."""
+    """Shared batch plumbing: jit per item fn, vmap for device batches.
+    HostDataset items (variable-size images) are bucketed by shape and
+    dispatched one vmapped program per bucket chunk, not per item."""
 
     def _fn(self):
         raise NotImplementedError
@@ -34,14 +36,21 @@ class _GridDescriptorExtractor(Transformer):
             self.__dict__["_jitted"] = fn
         return fn(jnp.asarray(image, jnp.float32))
 
-    def apply_batch(self, data):
-        if isinstance(data, HostDataset):
-            return HostDataset([np.asarray(self.apply(x)) for x in data.items])
+    def _batch_fn(self):
         fn = self.__dict__.get("_jitted_batch")
         if fn is None:
             fn = jax.jit(jax.vmap(self._fn()))
             self.__dict__["_jitted_batch"] = fn
-        return data.map_batches(fn, jitted=False)
+        return fn
+
+    def apply_batch(self, data):
+        if isinstance(data, HostDataset):
+            from ...utils import batching
+
+            return HostDataset(
+                batching.map_host_batched(data.items, self._batch_fn())
+            )
+        return data.map_batches(self._batch_fn(), jitted=False)
 
 
 class LCSExtractor(_GridDescriptorExtractor):

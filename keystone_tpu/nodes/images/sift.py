@@ -144,11 +144,14 @@ class SIFTExtractor(SIFTExtractorInterface):
         return fn(jnp.asarray(image, jnp.float32))
 
     def apply_batch(self, data):
-        if isinstance(data, HostDataset):
-            return HostDataset([np.asarray(self.apply(x)) for x in data.items])
         fn = self.__dict__.get("_jitted_batch")
         if fn is None:
             single = self._fn()
             fn = jax.jit(jax.vmap(single))
             self.__dict__["_jitted_batch"] = fn
+        if isinstance(data, HostDataset):
+            # bucket-by-shape: one dispatch per (shape, chunk), not per image
+            from ...utils import batching
+
+            return HostDataset(batching.map_host_batched(data.items, fn))
         return data.map_batches(fn, jitted=False)

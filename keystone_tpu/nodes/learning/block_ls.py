@@ -104,6 +104,23 @@ def _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center,
     return W_full, b
 
 
+@partial(jax.jit, static_argnames=("block_size", "n_chunk"))
+def _partial_preds_scan(X, W, b, acc0, start, block_size: int, n_chunk: int):
+    """Cumulative partial predictions for ``n_chunk`` consecutive feature
+    blocks beginning at block ``start``: one dispatch per chunk, stacked
+    (n_chunk, n, k) + the carried accumulator (BlockLinearMapper.
+    scala:96-137)."""
+
+    def body(acc, i):
+        Xb = jax.lax.dynamic_slice_in_dim(X, i * block_size, block_size, axis=1)
+        Wb = jax.lax.dynamic_slice_in_dim(W, i * block_size, block_size, axis=0)
+        acc = acc + Xb @ Wb
+        return acc, acc + b
+
+    acc, stacked = jax.lax.scan(body, acc0, start + jnp.arange(n_chunk))
+    return stacked, acc
+
+
 class BlockLinearMapper(Transformer):
     """Apply a blocked linear model. The model is stored full-width; for
     very large d the apply GEMM itself can be sharded over the ``model``
@@ -132,16 +149,34 @@ class BlockLinearMapper(Transformer):
 
         return data.map_batches(fn, jitted=False)
 
-    def apply_and_evaluate(self, data: Dataset, eval_fn):
+    def apply_and_evaluate(self, data: Dataset, eval_fn,
+                           blocks_per_dispatch: Optional[int] = None):
         """Incremental per-block evaluation (BlockLinearMapper.scala:96-137):
-        yields eval_fn(partial prediction) after each feature block."""
-        bs = self.block_size or self.W.shape[0]
-        X = data.array
-        acc = jnp.zeros((X.shape[0], self.W.shape[1]), dtype=self.W.dtype)
-        for start in range(0, self.W.shape[0], bs):
-            end = min(start + bs, self.W.shape[0])
-            acc = acc + X[:, start:end] @ self.W[start:end]
-            yield eval_fn(data.with_data(acc + self.b))
+        yields eval_fn(partial prediction) after each feature block.
+        Blocks are scanned in chunks — one dispatch per chunk instead of
+        one per block (a ~69 ms round trip each on the tunnel), while the
+        stacked (chunk, n, k) partials stay memory-bounded and a consumer
+        that stops early skips the remaining chunks entirely."""
+        d = self.W.shape[0]
+        bs = min(self.block_size or d, d)
+        n_blocks = -(-d // bs)
+        X, W = data.array, self.W
+        pad = n_blocks * bs - d
+        if pad:  # zero feature/weight padding leaves partial sums exact
+            X = jnp.pad(X, [(0, 0), (0, pad)])
+            W = jnp.pad(W, [(0, pad), (0, 0)])
+        n, k = X.shape[0], W.shape[1]
+        if blocks_per_dispatch is None:  # bound stacked partials to ~64 MB
+            budget = 64 << 20
+            blocks_per_dispatch = max(1, min(n_blocks, budget // max(4 * n * k, 1)))
+        acc = jnp.zeros((n, k), W.dtype)
+        for c0 in range(0, n_blocks, blocks_per_dispatch):
+            m = min(blocks_per_dispatch, n_blocks - c0)
+            stacked, acc = _partial_preds_scan(
+                X, W, self.b, acc, jnp.int32(c0), bs, m
+            )
+            for i in range(m):
+                yield eval_fn(data.with_data(stacked[i]))
 
 
 class BlockLeastSquaresEstimator(LabelEstimator):

@@ -14,17 +14,37 @@ tags) and ship honest lightweight built-ins:
   - CoreNLPFeatureExtractor: tokenize → suffix-stripping lemmatizer →
     NER-replace → n-grams, mirroring the reference's pipeline shape.
 
-Swap in a real tagger by passing ``model=``; the node API and pipeline
-position match the reference exactly.
+Swap in a real tagger by passing ``model=`` — `POSTagger.trained()` /
+`NER.trained()` build one: an averaged-perceptron sequence model
+(`perceptron_tagger.AveragedPerceptronTagger`) trained on the bundled
+hand-tagged corpora under ``data/``, the self-contained stand-in for the
+reference's downloaded Epic CRF artifacts.
 """
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ...workflow.pipeline import Transformer
 from .text import NGramsFeaturizer, Tokenizer
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+_TRAINED_CACHE: dict = {}
+
+
+def bundled_tagger(corpus: str):
+    """Train (once per process) the averaged perceptron on a bundled
+    corpus under ``nlp/data/``; returns the callable tagger."""
+    tagger = _TRAINED_CACHE.get(corpus)
+    if tagger is None:
+        from .perceptron_tagger import AveragedPerceptronTagger, load_tagged_corpus
+
+        sentences = load_tagged_corpus(os.path.join(_DATA_DIR, corpus))
+        tagger = AveragedPerceptronTagger().train(sentences)
+        _TRAINED_CACHE[corpus] = tagger
+    return tagger
 
 _DETERMINERS = {"the", "a", "an", "this", "that", "these", "those"}
 _PREPOSITIONS = {"in", "on", "at", "by", "for", "with", "to", "from", "of"}
@@ -82,6 +102,11 @@ class POSTagger(Transformer):
     def __init__(self, model: Optional[Callable] = None):
         self.model = model or _heuristic_pos
 
+    @classmethod
+    def trained(cls) -> "POSTagger":
+        """Tagger backed by the trained averaged-perceptron model."""
+        return cls(model=bundled_tagger("pos_corpus.txt"))
+
     def apply(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
         return list(zip(tokens, self.model(tokens)))
 
@@ -91,6 +116,11 @@ class NER(Transformer):
 
     def __init__(self, model: Optional[Callable] = None):
         self.model = model or _heuristic_ner
+
+    @classmethod
+    def trained(cls) -> "NER":
+        """Tagger backed by the trained averaged-perceptron model."""
+        return cls(model=bundled_tagger("ner_corpus.txt"))
 
     def apply(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
         return list(zip(tokens, self.model(tokens)))

@@ -1,0 +1,169 @@
+"""Averaged-perceptron sequence tagger (Collins 2002).
+
+The reference's POS/NER nodes wrap trained Epic CRF/SemiCRF models it
+downloads at build time (POSTagger.scala:24-36, NER.scala:20-32). This
+is the self-contained analog: a real trainable tagger — greedy
+left-to-right decoding over perceptron scores with weight averaging —
+that trains in well under a second on the bundled mini-corpora and
+plugs into `POSTagger`/`NER` via their ``model=`` hook.
+
+Tagging is host-side sequential work over ragged token lists, exactly
+like the reference's JVM-side annotators; nothing here needs the MXU,
+so it deliberately stays off-device (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from collections import defaultdict
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+
+def _shape(word: str) -> str:
+    out = []
+    for ch in word:
+        if ch.isupper():
+            c = "X"
+        elif ch.islower():
+            c = "x"
+        elif ch.isdigit():
+            c = "d"
+        else:
+            c = ch
+        if not out or out[-1] != c:
+            out.append(c)
+    return "".join(out)
+
+
+def _features(
+    tokens: Sequence[str], i: int, prev_tag: str, prev2_tag: str
+) -> List[str]:
+    w = tokens[i]
+    low = w.lower()
+    prev_w = tokens[i - 1].lower() if i > 0 else "<s>"
+    next_w = tokens[i + 1].lower() if i + 1 < len(tokens) else "</s>"
+    return [
+        "bias",
+        "w=" + low,
+        "suf3=" + low[-3:],
+        "suf2=" + low[-2:],
+        "pre1=" + low[:1],
+        "shape=" + _shape(w),
+        "isdigit=" + str(w.replace(".", "").replace(",", "").isdigit()),
+        "istitle=" + str(w.istitle()),
+        "first=" + str(i == 0),
+        "pt=" + prev_tag,
+        "pt2=" + prev_tag + "|" + prev2_tag,
+        "pw=" + prev_w,
+        "nw=" + next_w,
+        "pw+w=" + prev_w + "|" + low,
+    ]
+
+
+class AveragedPerceptronTagger:
+    """Greedy averaged-perceptron tagger; callable as token list → tags
+    so it slots directly into POSTagger/NER ``model=``."""
+
+    def __init__(self):
+        self.weights: Dict[str, Dict[str, float]] = {}
+        self.tags: List[str] = []
+
+    # ------------------------------------------------------------- inference
+
+    def _score(self, feats: Sequence[str]) -> Dict[str, float]:
+        scores: Dict[str, float] = defaultdict(float)
+        for f in feats:
+            for tag, w in self.weights.get(f, {}).items():
+                scores[tag] += w
+        return scores
+
+    def predict(self, tokens: Sequence[str]) -> List[str]:
+        prev, prev2 = "<s>", "<s>"
+        out = []
+        for i in range(len(tokens)):
+            scores = self._score(_features(tokens, i, prev, prev2))
+            tag = max(self.tags, key=lambda t: (scores.get(t, 0.0), t))
+            out.append(tag)
+            prev2, prev = prev, tag
+        return out
+
+    __call__ = predict
+
+    # -------------------------------------------------------------- training
+
+    def train(
+        self,
+        sentences: Iterable[Sequence[Tuple[str, str]]],
+        n_iter: int = 8,
+        seed: int = 0,
+    ) -> "AveragedPerceptronTagger":
+        sentences = [list(s) for s in sentences]
+        self.tags = sorted({t for s in sentences for _, t in s})
+        totals: Dict[Tuple[str, str], float] = defaultdict(float)
+        stamps: Dict[Tuple[str, str], int] = defaultdict(int)
+        raw: Dict[str, Dict[str, float]] = defaultdict(lambda: defaultdict(float))
+        self.weights = raw
+        rng = random.Random(seed)
+        step = 0
+        for _ in range(n_iter):
+            rng.shuffle(sentences)
+            for sent in sentences:
+                tokens = [w for w, _ in sent]
+                prev, prev2 = "<s>", "<s>"
+                for i, (_, gold) in enumerate(sent):
+                    feats = _features(tokens, i, prev, prev2)
+                    scores = self._score(feats)
+                    guess = max(self.tags, key=lambda t: (scores.get(t, 0.0), t))
+                    if guess != gold:
+                        for f in feats:
+                            for tag, delta in ((gold, 1.0), (guess, -1.0)):
+                                key = (f, tag)
+                                # accumulate the area under the weight
+                                # curve since last touch (lazy averaging)
+                                totals[key] += (step - stamps[key]) * raw[f][tag]
+                                stamps[key] = step
+                                raw[f][tag] += delta
+                    prev2, prev = prev, gold  # teacher-forced history
+                    step += 1
+        averaged: Dict[str, Dict[str, float]] = {}
+        for (f, tag), total in totals.items():
+            total += (step - stamps[(f, tag)]) * raw[f][tag]
+            avg = total / step
+            if abs(avg) > 1e-12:
+                averaged.setdefault(f, {})[tag] = avg
+        self.weights = averaged
+        return self
+
+    # ------------------------------------------------------------ persistence
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"tags": self.tags, "weights": self.weights}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "AveragedPerceptronTagger":
+        with open(path) as f:
+            blob = json.load(f)
+        t = cls()
+        t.tags = blob["tags"]
+        t.weights = blob["weights"]
+        return t
+
+
+def load_tagged_corpus(path: str) -> List[List[Tuple[str, str]]]:
+    """One sentence per line, ``token/TAG`` entries separated by spaces
+    (the classic slash format; slashes inside tokens are not supported
+    by the bundled corpora)."""
+    sentences = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            pairs = []
+            for item in line.split():
+                tok, _, tag = item.rpartition("/")
+                pairs.append((tok, tag))
+            sentences.append(pairs)
+    return sentences

@@ -1,0 +1,44 @@
+"""Host→device batching for variable-shape item collections.
+
+The reference amortizes JVM→native costs by processing images
+per-partition (ImageLoaderUtils.scala:56-94). The TPU analog: group a
+`HostDataset`'s items by shape into buckets, stack each bucket, and run
+ONE vmapped XLA dispatch per (shape, chunk) instead of one dispatch per
+item — on a high-latency link the per-item path costs a full round trip
+per image (VERDICT r1 item 8).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+def map_host_batched(
+    items: Sequence,
+    batch_fn: Callable,
+    chunk: Optional[int] = 256,
+) -> List[np.ndarray]:
+    """Apply a batched (leading-axis) function to variable-shape items.
+
+    Items are bucketed by shape; each bucket is stacked and dispatched
+    through ``batch_fn`` in chunks of ``chunk`` (bounding peak host+device
+    memory). Results come back in the original item order. Dispatch count
+    is Σ_buckets ceil(bucket_size / chunk), independent of item count
+    within a chunk.
+    """
+    arrays = [np.asarray(x, np.float32) for x in items]
+    buckets: dict = {}
+    for i, a in enumerate(arrays):
+        buckets.setdefault(a.shape, []).append(i)
+    out: List = [None] * len(arrays)
+    for shape, idxs in buckets.items():
+        step = chunk or len(idxs)
+        for start in range(0, len(idxs), step):
+            part = idxs[start : start + step]
+            stacked = np.stack([arrays[i] for i in part])
+            res = np.asarray(batch_fn(stacked))
+            for j, i in enumerate(part):
+                out[i] = res[j]
+    return out

@@ -1,0 +1,218 @@
+"""Independent numpy reference implementations of the dense descriptors.
+
+These are deliberately written WITHOUT jax and without the library's
+conv/gather helpers — plain numpy with explicit loops where practical —
+so they cross-check the XLA programs in `keystone_tpu.nodes.images`
+the way the reference's `pyconv.py` scipy script cross-checks its
+Convolver (src/test/python/images/pyconv.py:1-29). Any indexing,
+padding, or binning bug in the fused TPU formulations shows up as a
+numeric mismatch against these.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def gaussian_kernel(sigma: float) -> np.ndarray:
+    radius = max(int(np.ceil(3 * sigma)), 1)
+    x = np.arange(-radius, radius + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return k / k.sum()
+
+
+def corr1d_same(a: np.ndarray, k: np.ndarray, axis: int) -> np.ndarray:
+    """Cross-correlation along `axis` with XLA 'SAME' zero padding
+    (pad_lo = (len-1)//2, remainder high)."""
+    a = np.moveaxis(np.asarray(a, np.float64), axis, 0)
+    kl = len(k)
+    lo = (kl - 1) // 2
+    hi = kl - 1 - lo
+    zlo = np.zeros((lo,) + a.shape[1:])
+    zhi = np.zeros((hi,) + a.shape[1:])
+    ap = np.concatenate([zlo, a, zhi], axis=0)
+    out = np.zeros_like(a)
+    for j in range(kl):
+        out += k[j] * ap[j : j + a.shape[0]]
+    return np.moveaxis(out, 0, axis)
+
+
+def sep_filter(img: np.ndarray, k: np.ndarray) -> np.ndarray:
+    return corr1d_same(corr1d_same(img, k, 0), k, 1)
+
+
+def central_gradients(gray: np.ndarray):
+    dy = np.zeros_like(gray)
+    dx = np.zeros_like(gray)
+    dy[1:-1, :] = (gray[2:, :] - gray[:-2, :]) * 0.5
+    dx[:, 1:-1] = (gray[:, 2:] - gray[:, :-2]) * 0.5
+    return dy, dx
+
+
+def orientation_maps(mag, ang, n_bins):
+    """Soft-assigned orientation histogram maps, (H, W, n_bins)."""
+    t = np.mod(ang / (2.0 * np.pi) * n_bins, n_bins)
+    lo = np.floor(t)
+    frac = t - lo
+    lo = lo.astype(np.int64) % n_bins
+    hi = (lo + 1) % n_bins
+    h, w = mag.shape
+    maps = np.zeros((h, w, n_bins))
+    for y in range(h):
+        for x in range(w):
+            maps[y, x, lo[y, x]] += mag[y, x] * (1.0 - frac[y, x])
+            maps[y, x, hi[y, x]] += mag[y, x] * frac[y, x]
+    return maps
+
+
+def dense_sift_one_scale(gray, bin_size: int, step: int, sigma: float):
+    """Reference for sift._sift_one_scale: (num_desc, 128)."""
+    gray = np.asarray(gray, np.float64)
+    if sigma > 0.01:
+        gray = sep_filter(gray, gaussian_kernel(sigma))
+    dy, dx = central_gradients(gray)
+    mag = np.sqrt(dx * dx + dy * dy)
+    ang = np.arctan2(dy, dx)
+    maps = orientation_maps(mag, ang, 8)
+    agg = sep_filter(maps, np.ones(bin_size))
+
+    h, w = gray.shape
+    span = 4 * bin_size
+    n_y = max((h - span) // step + 1, 0)
+    n_x = max((w - span) // step + 1, 0)
+    off = bin_size // 2
+    descs = np.zeros((n_y * n_x, 128))
+    i = 0
+    for iy in range(n_y):
+        for ix in range(n_x):
+            y0 = iy * step + off
+            x0 = ix * step + off
+            d = []
+            for by in range(4):
+                for bx in range(4):
+                    d.extend(agg[y0 + by * bin_size, x0 + bx * bin_size, :])
+            descs[i] = d
+            i += 1
+    norm = np.linalg.norm(descs, axis=1, keepdims=True)
+    descs = descs / np.maximum(norm, 1e-8)
+    descs = np.minimum(descs, 0.2)
+    norm2 = np.linalg.norm(descs, axis=1, keepdims=True)
+    return descs / np.maximum(norm2, 1e-8) * 512.0
+
+
+def hog(img, cell_size: int):
+    """Reference for descriptors.HogExtractor: (cy*cx, 31)."""
+    img = np.asarray(img, np.float64)
+    cs = cell_size
+    dy = np.zeros_like(img)
+    dx = np.zeros_like(img)
+    dy[1:-1] = (img[2:] - img[:-2]) * 0.5
+    dx[:, 1:-1] = (img[:, 2:] - img[:, :-2]) * 0.5
+    mag2 = dx * dx + dy * dy
+    cidx = np.argmax(mag2, axis=-1)
+    yy, xx = np.indices(cidx.shape)
+    gx, gy = dx[yy, xx, cidx], dy[yy, xx, cidx]
+    mag = np.sqrt(mag2[yy, xx, cidx])
+    ang = np.arctan2(gy, gx)
+    omaps = orientation_maps(mag, ang, 18)
+    agg = sep_filter(omaps, np.ones(cs))
+    off = cs // 2
+    cells = agg[off::cs, off::cs, :]
+    cy, cx = cells.shape[:2]
+    unsigned = cells[..., :9] + cells[..., 9:]
+    energy = np.sum(unsigned**2, axis=-1)
+    epad = np.pad(energy, 1, mode="edge")
+    eps = 1e-4
+    feats = []
+    for oy in (0, 1):
+        for ox in (0, 1):
+            blk = (
+                epad[oy : oy + cy, ox : ox + cx]
+                + epad[oy + 1 : oy + 1 + cy, ox : ox + cx]
+                + epad[oy : oy + cy, ox + 1 : ox + 1 + cx]
+                + epad[oy + 1 : oy + 1 + cy, ox + 1 : ox + 1 + cx]
+            )
+            feats.append((blk, 1.0 / np.sqrt(blk + eps)))
+    f_signed = sum(np.minimum(cells * inv[..., None], 0.2) for _, inv in feats) * 0.5
+    f_unsigned = (
+        sum(np.minimum(unsigned * inv[..., None], 0.2) for _, inv in feats) * 0.5
+    )
+    g_feats = np.stack(
+        [
+            np.sum(np.minimum(np.minimum(cells * inv[..., None], 0.2), 0.2), axis=-1)
+            * 0.2357
+            for _, inv in feats
+        ],
+        axis=-1,
+    )
+    out = np.concatenate([f_signed, f_unsigned, g_feats], axis=-1)
+    return out.reshape(cy * cx, 31)
+
+
+def daisy(gray, stride: int, radius: int, rings: int, ring_points: int,
+          num_orientations: int):
+    """Reference for descriptors.DaisyExtractor: (n_y*n_x, (1+Q*T)*H)."""
+    gray = np.asarray(gray, np.float64)
+    R, Q, T, H = radius, rings, ring_points, num_orientations
+    dy, dx = central_gradients(gray)
+    omaps = np.stack(
+        [
+            np.maximum(np.cos(a) * dx + np.sin(a) * dy, 0.0)
+            for a in np.arange(H) * (2 * np.pi / H)
+        ],
+        axis=-1,
+    )
+    level_maps = []
+    acc = omaps
+    for q in range(Q):
+        sigma = R * (q + 1) / (Q * 2.0)
+        acc = sep_filter(acc, gaussian_kernel(sigma))
+        level_maps.append(acc)
+    h, w = gray.shape
+    margin = R + 1
+    n_y = max((h - 2 * margin) // stride + 1, 0)
+    n_x = max((w - 2 * margin) // stride + 1, 0)
+    rows = []
+    for iy in range(n_y):
+        for ix in range(n_x):
+            y0 = iy * stride + margin
+            x0 = ix * stride + margin
+            d = [level_maps[0][y0, x0, :]]
+            for q in range(Q):
+                r = R * (q + 1) / Q
+                for t in range(T):
+                    a = 2 * np.pi * t / T
+                    oy = int(np.round(r * np.sin(a)))
+                    ox = int(np.round(r * np.cos(a)))
+                    d.append(level_maps[q][y0 + oy, x0 + ox, :])
+            rows.append(np.concatenate(d))
+    out = np.stack(rows)
+    norm = np.linalg.norm(out, axis=1, keepdims=True)
+    return out / np.maximum(norm, 1e-8)
+
+
+def lcs(img, stride: int, subpatch_size: int, subpatches: int):
+    """Reference for descriptors.LCSExtractor: (n_y*n_x, 2*g*g*C)."""
+    img = np.asarray(img, np.float64)
+    sp, g = subpatch_size, subpatches
+    box = np.ones(sp) / sp
+    mean = sep_filter(img, box)
+    mean2 = sep_filter(img * img, box)
+    std = np.sqrt(np.maximum(mean2 - mean * mean, 0.0))
+    h, w, c = img.shape
+    span = g * sp
+    n_y = max((h - span) // stride + 1, 0)
+    n_x = max((w - span) // stride + 1, 0)
+    off = sp // 2
+    rows = []
+    for iy in range(n_y):
+        for ix in range(n_x):
+            y0 = iy * stride + off
+            x0 = ix * stride + off
+            feats = []
+            for m in (mean, std):
+                for gy in range(g):
+                    for gx in range(g):
+                        feats.extend(m[y0 + gy * sp, x0 + gx * sp, :])
+            rows.append(feats)
+    return np.asarray(rows)

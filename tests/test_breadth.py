@@ -337,3 +337,71 @@ def test_multiclass_summary_pretty_printer():
     actual = Dataset(np.array([0, 1, 1, 1, 0], np.int32))
     s = MulticlassClassifierEvaluator(3).evaluate(preds, actual).summary()
     assert "Confusion matrix" in s and "accuracy" in s.lower()
+
+
+def test_kernel_apply_is_single_dispatch(monkeypatch):
+    # the blocked kernel apply must be ONE jitted scan, not one dispatch
+    # per train block (VERDICT r1: per-block host dispatch on a ~69 ms
+    # RTT link dominates the apply)
+    from keystone_tpu.nodes.learning import kernels as K
+
+    rng = np.random.default_rng(5)
+    Xtr = rng.normal(size=(50, 3)).astype(np.float32)  # pads to 4 blocks of 16
+    alpha = rng.normal(size=(50, 2)).astype(np.float32)
+    Xte = rng.normal(size=(20, 3)).astype(np.float32)
+
+    calls = []
+    orig = K._kernel_apply_scan
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(K, "_kernel_apply_scan", counting)
+    mapper = K.KernelBlockLinearMapper(Xtr, alpha, gamma=0.7, block_size=16)
+    out = np.asarray(mapper.apply_batch(Dataset(Xte)).numpy())
+    assert len(calls) == 1
+
+    # correctness vs the unblocked dense product
+    D = ((Xte[:, None, :] - Xtr[None, :, :]) ** 2).sum(-1)
+    expect = np.exp(-0.7 * D) @ alpha
+    np.testing.assert_allclose(out, expect, atol=1e-4)
+
+
+def test_block_mapper_apply_and_evaluate():
+    # incremental per-block eval (BlockLinearMapper.scala:96-137): one
+    # scan dispatch, last partial == full apply
+    from keystone_tpu.nodes.learning.block_ls import BlockLinearMapper
+
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(30, 10)).astype(np.float32)
+    W = rng.normal(size=(10, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    mapper = BlockLinearMapper(W, b, block_size=4)  # 3 blocks (last padded)
+    ds = Dataset(X)
+
+    evals = list(mapper.apply_and_evaluate(ds, lambda d: np.asarray(d.numpy())))
+    assert len(evals) == 3
+    full = np.asarray(mapper.apply_batch(ds).numpy())
+    np.testing.assert_allclose(evals[-1], full, atol=1e-5)
+    # first partial uses only the first feature block
+    np.testing.assert_allclose(evals[0], X[:, :4] @ W[:4] + b, atol=1e-5)
+    assert not np.allclose(evals[0], full)
+
+
+def test_apply_and_evaluate_chunked_matches_unchunked():
+    # chunked scans (memory-bounded dispatch groups) must yield the same
+    # partial-prediction sequence as one block per dispatch
+    from keystone_tpu.nodes.learning.block_ls import BlockLinearMapper
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(20, 12)).astype(np.float32)
+    W = rng.normal(size=(12, 2)).astype(np.float32)
+    mapper = BlockLinearMapper(W, block_size=3)  # 4 blocks
+    ds = Dataset(X)
+    grab = lambda d: np.asarray(d.numpy())
+    one = list(mapper.apply_and_evaluate(ds, grab, blocks_per_dispatch=1))
+    big = list(mapper.apply_and_evaluate(ds, grab, blocks_per_dispatch=3))
+    assert len(one) == len(big) == 4
+    for a, b in zip(one, big):
+        np.testing.assert_allclose(a, b, atol=1e-5)

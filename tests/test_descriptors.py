@@ -123,3 +123,36 @@ def test_lcs_constant_image_stats():
     nz = center[np.abs(center) > 1e-6]
     assert np.allclose(nz, 0.25, atol=1e-5)
     assert (np.abs(center) > 1e-6).sum() == center.size // 2  # stds are 0
+
+
+def test_host_batch_dispatch_scales_with_buckets(monkeypatch):
+    """Variable-size HostDataset images are bucketed by shape: one
+    vmapped dispatch per bucket, not per item (VERDICT r1 item 8)."""
+    import numpy as np
+
+    from keystone_tpu.data.dataset import HostDataset
+    from keystone_tpu.nodes.images.descriptors import LCSExtractor
+    from keystone_tpu.utils import batching
+
+    rng = np.random.default_rng(0)
+    items = [rng.uniform(size=(40, 40, 3)).astype(np.float32) for _ in range(4)]
+    items += [rng.uniform(size=(40, 56, 3)).astype(np.float32) for _ in range(3)]
+
+    calls = []
+    orig = batching.map_host_batched
+
+    def counting(its, batch_fn, chunk=256):
+        def bf(stacked):
+            calls.append(stacked.shape)
+            return batch_fn(stacked)
+
+        return orig(its, bf, chunk)
+
+    monkeypatch.setattr(batching, "map_host_batched", counting)
+    ext = LCSExtractor(stride=8)
+    out = ext.apply_batch(HostDataset(items))
+    assert len(calls) == 2, calls  # two shape buckets, seven items
+    assert {c[0] for c in calls} == {4, 3}
+    # order-preserving and identical to the per-item path
+    for got, img in zip(out.items, items):
+        np.testing.assert_allclose(got, np.asarray(ext.apply(img)), atol=1e-5)

@@ -152,3 +152,132 @@ def test_to_dot_contains_all_vertices():
     g, s, a, b, k = build_chain()
     dot = g.to_dot()
     assert f"source_{s.id}" in dot and f"sink_{k.id}" in dot
+
+
+# ---- mutator failure sweep (reference GraphSuite.scala exercises every
+# ---- `require` branch of Graph.scala:110-434; each has a ValueError here)
+
+
+def test_add_node_rejects_missing_source_dep():
+    g = Graph()
+    with pytest.raises(ValueError):
+        g.add_node(op(), [SourceId(99)])
+
+
+def test_add_node_rejects_bad_dep_type():
+    g = Graph()
+    with pytest.raises(TypeError):
+        g.add_node(op(), [SinkId(0)])
+
+
+def test_set_operator_missing_node():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.set_operator(NodeId(99), op())
+
+
+def test_set_dependencies_missing_node():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.set_dependencies(NodeId(99), [s])
+
+
+def test_set_dependencies_missing_dep():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.set_dependencies(a, [NodeId(99)])
+
+
+def test_set_sink_dependency_missing_sink():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.set_sink_dependency(SinkId(99), a)
+
+
+def test_set_sink_dependency_missing_dep():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.set_sink_dependency(k, NodeId(99))
+
+
+def test_remove_node_missing():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.remove_node(NodeId(99))
+
+
+def test_remove_source_missing():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.remove_source(SourceId(99))
+
+
+def test_remove_sink_missing():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.remove_sink(SinkId(99))
+
+
+def test_remove_sink_then_node_succeeds():
+    g, s, a, b, k = build_chain()
+    g = g.remove_sink(k)
+    g = g.remove_node(b)
+    assert b not in g.nodes and k not in g.sink_ids
+
+
+def test_replace_dependency_missing_new():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.replace_dependency(a, NodeId(99))
+
+
+def test_connect_graph_rejects_nonsource_splice_key():
+    g, s, a, b, k = build_chain()
+    other = Graph()
+    other, os_ = other.add_source()
+    other, on = other.add_node(op(), [os_])
+    other, ok_ = other.add_sink(on)
+    with pytest.raises(ValueError):
+        g.connect_graph(other, {SourceId(57): a})
+
+
+def test_replace_nodes_rejects_empty_set():
+    g, s, a, b, k = build_chain()
+    repl = Graph()
+    repl, rs = repl.add_source()
+    repl, rn = repl.add_node(op(), [rs])
+    repl, rk = repl.add_sink(rn)
+    with pytest.raises(ValueError):
+        g.replace_nodes([], repl, {rs: s}, {})
+
+
+def test_replace_nodes_rejects_missing_node():
+    g, s, a, b, k = build_chain()
+    repl = Graph()
+    repl, rs = repl.add_source()
+    repl, rn = repl.add_node(op(), [rs])
+    repl, rk = repl.add_sink(rn)
+    with pytest.raises(ValueError):
+        g.replace_nodes([NodeId(99)], repl, {rs: s}, {NodeId(99): rk})
+
+
+def test_replace_nodes_rejects_sink_splice_mismatch():
+    g, s, a, b, k = build_chain()
+    repl = Graph()
+    repl, rs = repl.add_source()
+    repl, rn = repl.add_node(op(), [rs])
+    repl, rk = repl.add_sink(rn)
+    # sink splice covers b but nodes_to_remove is {a}
+    with pytest.raises(ValueError):
+        g.replace_nodes([a], repl, {rs: s}, {b: rk})
+
+
+def test_replace_nodes_rejects_removed_splice_target():
+    g, s, a, b, k = build_chain()
+    repl = Graph()
+    repl, rs = repl.add_source()
+    repl, rn = repl.add_node(op(), [rs])
+    repl, rk = repl.add_sink(rn)
+    # source splice targets a, which is being removed
+    with pytest.raises(ValueError):
+        g.replace_nodes([a, b], repl, {rs: a}, {a: rk, b: rk})

@@ -180,3 +180,54 @@ def test_node_optimization_rule_consults_sample():
     p = Transformer.from_function(lambda x: x).to_pipeline().and_then(est, train)
     _ = p(train).get()
     assert est.chosen == "big"  # 100 rows over 8 shards -> 13/shard > 10
+
+
+def _double_diamond_graph():
+    """Two shared nodes with different profiles hanging off one dataset:
+    data -> f1 -> {a, b}, data -> f2 -> {c, d} (4 sinks)."""
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    ident = lambda name: Transformer.from_function(lambda x: x, name=name)
+    g = Graph()
+    g, data = g.add_node(
+        DatasetOperator(Dataset.from_numpy(np.ones((8, 2), np.float32))), []
+    )
+    g, f1 = g.add_node(ident("f1"), [data])
+    g, a = g.add_node(ident("a"), [f1])
+    g, b = g.add_node(ident("b"), [f1])
+    g, f2 = g.add_node(ident("f2"), [data])
+    g, c = g.add_node(ident("c"), [f2])
+    g, d = g.add_node(ident("d"), [f2])
+    for leaf in (a, b, c, d):
+        g, _ = g.add_sink(leaf)
+    return g, f1, f2
+
+
+@pytest.mark.parametrize(
+    "budget,expect",
+    [
+        (10, set()),           # nothing fits
+        (60, {"f2"}),          # only the small node fits
+        (100, {"f1"}),         # best saving first; f2 no longer fits
+        (149, {"f1"}),         # f2 still does not fit (100 + 50 > 149)
+        (200, {"f1", "f2"}),   # both fit
+    ],
+)
+def test_greedy_cache_across_memory_budgets(monkeypatch, budget, expect):
+    """Greedy decisions swept across budgets with synthetic profiles
+    (reference AutocCacheRuleSuite.scala:74-181)."""
+    import keystone_tpu.workflow.autocache as ac
+
+    g, f1, f2 = _double_diamond_graph()
+    profiles = {f1: Profile(ns=1000.0, mem_bytes=100.0),
+                f2: Profile(ns=600.0, mem_bytes=50.0)}
+    monkeypatch.setattr(ac, "profile_nodes", lambda *a, **k: profiles)
+
+    rule = AutoCacheRule(strategy="greedy", mem_budget_bytes=budget)
+    g2, _ = rule.apply((g, {}))
+    cached_parents = {
+        g2.get_operator(g2.get_dependencies(n)[0]).label
+        for n in g2.nodes
+        if isinstance(g2.get_operator(n), CacheMarker)
+    }
+    assert cached_parents == expect
