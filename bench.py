@@ -273,6 +273,20 @@ def child_main(args):
     phase("timed_done", seconds=round(elapsed, 3))
     test_metrics = evaluator(predictor(test.data), test.labels)
 
+    # Analytic FLOPs of the dominant programs (featurize conv + BCD
+    # solve), for a derived MFU against the v5e bf16 peak (197 TFLOP/s).
+    n = train.data.count
+    F, p = config.num_filters, config.patch_size
+    pos = (32 - p + 1) ** 2  # valid conv positions
+    conv_flops = 2.0 * n * pos * (p * p * 3) * (F + 1)  # filters + mean conv
+    d = 8 * F  # 2x2 pool grid x two-sided rectifier channels
+    k = config.num_classes
+    B = min(config.block_size, d)
+    # BCD sweep: per-block Gram (2nB^2 x d/B blocks) + correlation and
+    # two residual GEMMs, which scale with k, not the block width
+    solve_flops = 2.0 * n * d * B + 6.0 * n * d * k
+    total_flops = conv_flops + solve_flops
+    V5E_PEAK = 1.97e14
     detail = {
         "n_train": train.data.count,
         "train_seconds": round(elapsed, 3),
@@ -280,6 +294,8 @@ def child_main(args):
         "train_error": round(train_metrics.error, 4),
         "test_accuracy": round(test_metrics.accuracy, 4),
         "num_filters": config.num_filters,
+        "analytic_tflops": round(total_flops / 1e12, 2),
+        "mfu_vs_v5e_peak": round(total_flops / elapsed / V5E_PEAK, 4),
         "synthetic": synthetic,
         "platform": jax.devices()[0].platform,
         "data_note": (None if not synthetic else

@@ -153,14 +153,23 @@ class Dataset:
         jax.block_until_ready(self.data)
         return self
 
+    def spread_take(self, m: int):
+        """Host copy of ≤ m valid examples at evenly spread indices —
+        one device gather + one small transfer, never a full collect."""
+        m = min(max(self.count, 1), m)
+        idx = jnp.asarray(
+            np.linspace(0, max(self.count - 1, 0), num=m, dtype=np.int64)
+        )
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(jnp.take(x, idx, axis=0)), self.data
+        )
+
     def sample_per_shard(self, k: int, seed: int = 0) -> "Dataset":
         """Deterministic sample of ≤ k·n_shards valid examples, resharded
         (≈ SampleCollector's per-partition samples,
         NodeOptimizationRule.scala:145-197)."""
         m = min(self.count, k * self.n_shards)
-        idx = np.linspace(0, self.count - 1, num=m, dtype=np.int64)
-        host = jax.tree_util.tree_map(lambda x: np.asarray(x)[idx], self.data)
-        return Dataset(host, count=m, mesh=self.mesh)
+        return Dataset(self.spread_take(m), count=m, mesh=self.mesh)
 
     def take(self, k: int):
         k = min(k, self.count)

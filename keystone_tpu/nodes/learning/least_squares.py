@@ -89,7 +89,9 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                 import jax
 
                 d = jax.tree_util.tree_leaves(sample.data)[0].shape[1]
-                arr = sample.take(256)  # small host sample, not a full collect
+                # spread sample, not a head prefix — a sorted dataset's
+                # first rows can misstate sparsity and mis-route
+                arr = jax.tree_util.tree_leaves(sample.spread_take(256))[0]
             else:
                 arr = np.asarray(sample.items if hasattr(sample, "items") else sample)
                 d = arr.shape[1]
