@@ -156,9 +156,13 @@ class Dataset:
     def spread_take(self, m: int):
         """Host copy of ≤ m valid examples at evenly spread indices —
         one device gather + one small transfer, never a full collect."""
-        m = min(max(self.count, 1), m)
+        m = min(self.count, m)
+        if m == 0:
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(x[:0]), self.data
+            )
         idx = jnp.asarray(
-            np.linspace(0, max(self.count - 1, 0), num=m, dtype=np.int64)
+            np.linspace(0, self.count - 1, num=m, dtype=np.int64)
         )
         return jax.tree_util.tree_map(
             lambda x: np.asarray(jnp.take(x, idx, axis=0)), self.data

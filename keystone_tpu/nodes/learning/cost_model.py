@@ -35,10 +35,39 @@ class CostProfile:
     num_chips: int
 
 
-# seconds per unit, v5e-ish defaults
-CPU_WEIGHT = 1.0 / 2.0e14   # per FLOP (MXU bf16)
-MEM_WEIGHT = 1.0 / 8.0e11   # per HBM byte touched
-NETWORK_WEIGHT = 1.0 / 1.0e11  # per ICI all-reduced byte
+# Analytic v5e-ish fallbacks (peak-rate reciprocals), used when no
+# measured calibration file is present.
+ANALYTIC_CPU_WEIGHT = 1.0 / 2.0e14   # per FLOP (MXU bf16)
+ANALYTIC_MEM_WEIGHT = 1.0 / 8.0e11   # per HBM byte touched
+ANALYTIC_NETWORK_WEIGHT = 1.0 / 1.0e11  # per ICI all-reduced byte
+
+
+def _load_calibration():
+    """Measured weights from tpu_calibration.json (committed with
+    provenance; produced by calibrate.calibrate_cost_weights() on real
+    hardware). Falls back to the analytic defaults above."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "tpu_calibration.json")
+    try:
+        with open(path) as f:
+            cal = json.load(f)
+        return (
+            float(cal["cpu_weight"]),
+            float(cal["mem_weight"]),
+            float(cal["network_weight"]),
+        )
+    except (OSError, KeyError, ValueError, TypeError):
+        return (
+            ANALYTIC_CPU_WEIGHT,
+            ANALYTIC_MEM_WEIGHT,
+            ANALYTIC_NETWORK_WEIGHT,
+        )
+
+
+# seconds per unit; measured on the attached TPU when available
+CPU_WEIGHT, MEM_WEIGHT, NETWORK_WEIGHT = _load_calibration()
 
 
 class CostModel:

@@ -236,3 +236,28 @@ def test_device_filter_learning_matches_host_reference():
     cov = np.cov(wh.T)
     off = cov - np.diag(np.diag(cov))
     assert np.abs(off).max() < 0.1 * np.abs(np.diag(cov)).max()
+
+
+def test_spread_take_empty_dataset_returns_zero_rows():
+    """spread_take on an empty Dataset must not fabricate examples from
+    padding rows (it would silently mis-profile sparsity in
+    LeastSquaresEstimator._measure)."""
+    from keystone_tpu.data.dataset import Dataset
+
+    ds = Dataset(np.zeros((0, 5), np.float32))
+    assert ds.count == 0
+    out = ds.spread_take(256)
+    assert out.shape == (0, 5)
+
+
+def test_spread_take_spreads_and_bounds():
+    from keystone_tpu.data.dataset import Dataset
+
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    ds = Dataset(X)
+    out = ds.spread_take(4)
+    assert out.shape == (4, 4)
+    # evenly spread: first and last valid rows included, never padding
+    assert out[0, 0] == X[0, 0] and out[-1, 0] == X[-1, 0]
+    full = ds.spread_take(100)  # m > count clamps to count
+    np.testing.assert_allclose(full, X)
