@@ -33,28 +33,12 @@ from ...workflow.pipeline import Transformer
 @partial(jax.jit, static_argnames=("normalize",))
 def _convolve(images, kernel, colsum, bias, normalize: bool):
     """Folded conv: one module-level jit keyed on shapes, shared by every
-    Convolver instance (rebuilding a pipeline must not recompile)."""
-    dn = lax.conv_dimension_numbers(
-        images.shape, kernel.shape, ("NHWC", "HWIO", "NHWC")
-    )
-    out = lax.conv_general_dilated(
-        images, kernel, (1, 1), "VALID", dimension_numbers=dn,
-        preferred_element_type=jnp.float32,
-    )
-    if normalize:
-        # per-patch mean via a uniform conv, broadcast against the filter
-        # column sums (the rank-1 correction)
-        p, c = kernel.shape[0], kernel.shape[2]
-        ones = jnp.ones((p, p, c, 1), images.dtype) / (p * p * c)
-        means = lax.conv_general_dilated(
-            images, ones, (1, 1), "VALID",
-            dimension_numbers=lax.conv_dimension_numbers(
-                images.shape, ones.shape, ("NHWC", "HWIO", "NHWC")
-            ),
-            preferred_element_type=jnp.float32,
-        )
-        out = out - means * colsum
-    return out + bias
+    Convolver instance (rebuilding a pipeline must not recompile). The
+    math lives in ops.folded_conv_reference — the fused conv+rectify+pool
+    peephole's fallback path must stay in lockstep with it."""
+    from ...ops import folded_conv_reference
+
+    return folded_conv_reference(images, kernel, colsum, bias, normalize)
 
 
 class Convolver(Transformer):
@@ -78,7 +62,13 @@ class Convolver(Transformer):
         normalize_patches: bool = True,
         patch_size: Optional[int] = None,
     ):
-        filters = np.asarray(filters, np.float32)
+        # All folding math in jnp: when filters/whitener live on device
+        # (the fused filter-learning program returns device arrays), the
+        # fold is an async device dispatch — no blocking host round trip
+        # per Convolver construction. HIGHEST precision: the fold feeds
+        # every downstream conv; bf16 default-precision folding would
+        # corrupt the whitened kernel.
+        filters = jnp.asarray(filters, jnp.float32)
         if filters.ndim == 2:
             if patch_size is None:
                 patch_size = int(round((filters.shape[1] / img_channels) ** 0.5))
@@ -92,19 +82,22 @@ class Convolver(Transformer):
         D = self.patch * self.patch * img_channels
         F = filters.reshape(self.num_filters, D).T  # (D, K)
         if whitener is not None:
-            G = np.asarray(whitener.whitener, np.float32) @ F  # (D, K)
-            zca_mean = np.asarray(whitener.means, np.float32)  # (D,)
-            self.bias = -(zca_mean @ G)  # (K,)
+            G = jnp.matmul(
+                jnp.asarray(whitener.whitener, jnp.float32), F,
+                precision=lax.Precision.HIGHEST,
+            )  # (D, K)
+            zca_mean = jnp.asarray(whitener.means, jnp.float32)  # (D,)
+            bias = -jnp.matmul(zca_mean, G, precision=lax.Precision.HIGHEST)
         else:
             G = F
-            self.bias = np.zeros(self.num_filters, np.float32)
+            bias = jnp.zeros(self.num_filters, jnp.float32)
         # folded conv kernel, HWIO
-        self.kernel = jnp.asarray(
+        self.kernel = (
             G.T.reshape(self.num_filters, self.patch, self.patch, img_channels)
             .transpose(1, 2, 3, 0)
         )
-        self.colsum = jnp.asarray(G.sum(axis=0))  # (K,)
-        self.bias = jnp.asarray(self.bias)
+        self.colsum = G.sum(axis=0)  # (K,)
+        self.bias = bias
 
     def apply(self, image):
         return _convolve(

@@ -24,9 +24,23 @@ class ZCAWhitener(Transformer):
     def __init__(self, whitener, means):
         self.whitener = jnp.asarray(whitener)  # (D, D)
         self.means = jnp.asarray(means)  # (D,)
-        # host copies for driver-side filter math (no device round-trips)
-        self.whitener_np = np.asarray(whitener, np.float32)
-        self.means_np = np.asarray(means, np.float32)
+        self._whitener_np = None
+        self._means_np = None
+
+    # Host copies are LAZY: when the whitener was fit on device (the
+    # fused filter-learning program), touching .whitener_np forces a
+    # device→host transfer — only pay that if driver-side math needs it.
+    @property
+    def whitener_np(self):
+        if self._whitener_np is None:
+            self._whitener_np = np.asarray(self.whitener, np.float32)
+        return self._whitener_np
+
+    @property
+    def means_np(self):
+        if self._means_np is None:
+            self._means_np = np.asarray(self.means, np.float32)
+        return self._means_np
 
     def apply(self, x):
         return (jnp.asarray(x) - self.means) @ self.whitener

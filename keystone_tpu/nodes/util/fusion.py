@@ -72,15 +72,107 @@ class _RectifyPoolStage(Transformer):
         return (("RectifyPool", a, mv, p, s, pal), (), fn)
 
 
+class _ConvRectifyPoolStage(Transformer):
+    """Peephole-fused Convolver >> SymmetricRectifier >> Pooler(sum):
+    the Pallas one-pass kernel keeps the conv output and the
+    channel-doubled rectified tensor in VMEM, writing only the pooled
+    grid (ops/pallas_kernels.py — measured 2.26x the XLA path on v5e).
+    Default-on for TPU; KEYSTONE_DISABLE_FUSED_CONV=1 forces XLA."""
+
+    fusable = True
+
+    def __init__(self, conv, alpha: float, max_val: float, pool: int, stride: int):
+        self.alpha = alpha
+        self.max_val = max_val
+        self.pool = pool
+        self.stride = stride
+        self.patch = conv.patch
+        self.normalize = conv.normalize_patches
+        # kernel is HWIO (P,P,C,K); the Pallas path wants the channel-
+        # major (C·P·P, K) feature order of conv_general_dilated_patches.
+        # jnp (not numpy): device kernels must not force a host pull.
+        khwio = jnp.asarray(conv.kernel)
+        self.g_cmajor = khwio.transpose(2, 0, 1, 3).reshape(-1, khwio.shape[3])
+        self.kernel_hwio = conv.kernel
+        self.colsum = conv.colsum
+        self.bias = conv.bias
+
+    def apply(self, x):
+        from ...ops import conv_rectify_pool_reference
+
+        return conv_rectify_pool_reference(
+            x[None], self.kernel_hwio, self.colsum, self.bias,
+            self.alpha, self.max_val, self.pool, self.stride, self.normalize,
+        )[0]
+
+    def fuse(self):
+        from ...ops import use_fused_conv
+
+        a, mv, p, s = self.alpha, self.max_val, self.pool, self.stride
+        patch, normalize = self.patch, self.normalize
+        fused = use_fused_conv()  # part of the key (see _RectifyPoolStage)
+        # only the layout the chosen path needs rides the program params
+        kernel_param = self.g_cmajor if fused else self.kernel_hwio
+
+        def fn(params, x):
+            (kern, cs, bs) = params
+            if fused:
+                from ...ops import (
+                    FusedConvIneligibleError,
+                    conv_rectify_pool_pallas,
+                )
+
+                try:  # trace-time eligibility: fall back only when the
+                    # block geometry cannot fit VMEM
+                    return conv_rectify_pool_pallas(
+                        x, kern, cs, bs, a, mv, p, s, normalize, patch
+                    )
+                except FusedConvIneligibleError:
+                    # reconstruct HWIO from the channel-major layout
+                    d, k = kern.shape
+                    c = d // (patch * patch)
+                    kh = kern.reshape(c, patch, patch, k).transpose(1, 2, 3, 0)
+                    from ...ops import conv_rectify_pool_reference
+
+                    return conv_rectify_pool_reference(
+                        x, kh, cs, bs, a, mv, p, s, normalize
+                    )
+            from ...ops import conv_rectify_pool_reference
+
+            return conv_rectify_pool_reference(
+                x, kern, cs, bs, a, mv, p, s, normalize
+            )
+
+        return (
+            ("ConvRectifyPool", a, mv, p, s, patch, normalize, fused),
+            (kernel_param, self.colsum, self.bias),
+            fn,
+        )
+
+
 def _peephole(stages):
-    """Merge adjacent (SymmetricRectifier, Pooler[sum]) stage pairs so the
-    channel-doubled rectified tensor never materializes (see ops/)."""
-    from ..images.core import Pooler, SymmetricRectifier
+    """Merge adjacent (Convolver?, SymmetricRectifier, Pooler[sum])
+    stages so the conv output and the channel-doubled rectified tensor
+    never materialize (see ops/)."""
+    from ..images.core import Convolver, Pooler, SymmetricRectifier
 
     out, i = [], 0
     while i < len(stages):
         s = stages[i]
         if (
+            isinstance(s, Convolver)
+            and i + 2 < len(stages)
+            and isinstance(stages[i + 1], SymmetricRectifier)
+            and isinstance(stages[i + 2], Pooler)
+            and stages[i + 2].pool_fn == "sum"
+            and stages[i + 2].pixel_fn is None
+        ):
+            r, p = stages[i + 1], stages[i + 2]
+            out.append(
+                _ConvRectifyPoolStage(s, r.alpha, r.max_val, p.pool_size, p.stride)
+            )
+            i += 3
+        elif (
             isinstance(s, SymmetricRectifier)
             and i + 1 < len(stages)
             and isinstance(stages[i + 1], Pooler)

@@ -1,21 +1,31 @@
 """Pallas TPU kernels for hot ops (with XLA fallbacks)."""
 
 from .pallas_kernels import (
+    FusedConvIneligibleError,
+    conv_rectify_pool_pallas,
+    conv_rectify_pool_reference,
+    folded_conv_reference,
     rbf_block,
     rbf_block_pallas,
     rbf_block_reference,
     rectify_pool,
     rectify_pool_pallas,
     rectify_pool_reference,
+    use_fused_conv,
     use_pallas,
 )
 
 __all__ = [
+    "FusedConvIneligibleError",
+    "conv_rectify_pool_pallas",
+    "conv_rectify_pool_reference",
+    "folded_conv_reference",
     "rbf_block",
     "rbf_block_pallas",
     "rbf_block_reference",
     "rectify_pool",
     "rectify_pool_pallas",
     "rectify_pool_reference",
+    "use_fused_conv",
     "use_pallas",
 ]

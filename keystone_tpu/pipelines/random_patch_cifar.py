@@ -139,13 +139,14 @@ def learn_filters(train_data: Dataset, config) -> tuple:
     sub_idx = rng.choice(total, size=m, replace=False)
     filter_idx = rng.choice(m, size=config.num_filters, replace=False)
 
-    packed = np.asarray(
-        _learn_filters_device_jit(
-            train_data.array, jnp.asarray(idx), jnp.asarray(sub_idx),
-            jnp.asarray(filter_idx), jnp.float32(0.1),
-            patch=config.patch_size, step=config.patch_steps,
-        )
+    packed = _learn_filters_device_jit(
+        train_data.array, jnp.asarray(idx), jnp.asarray(sub_idx),
+        jnp.asarray(filter_idx), jnp.float32(0.1),
+        patch=config.patch_size, step=config.patch_steps,
     )
+    # stay on device: slicing the packed result is an async dispatch, so
+    # pipeline construction never blocks on a host round trip (the
+    # Convolver folds the whitener into its kernel in jnp too)
     D = config.patch_size * config.patch_size * c
     K = config.num_filters
     filters = packed[: K * D].reshape(K, D)

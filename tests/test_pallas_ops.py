@@ -112,3 +112,82 @@ def test_krr_still_learns_with_static_gamma():
     )
     preds = np.sign(model.apply_batch(Dataset(X)).numpy()[:, 0])
     assert (preds == y[:, 0]).mean() > 0.95
+
+
+@pytest.mark.parametrize(
+    "n,h,w,c,patch,k,pool,stride,normalize",
+    [
+        (5, 32, 32, 3, 6, 32, 14, 13, True),   # CIFAR north-star geometry
+        (3, 16, 16, 1, 5, 16, 6, 6, False),    # gray, no normalization
+        (2, 20, 14, 2, 3, 8, 5, 4, True),      # rectangular
+    ],
+)
+def test_conv_rectify_pool_pallas_matches_reference(
+    n, h, w, c, patch, k, pool, stride, normalize
+):
+    """Fused conv+rectify+pool kernel vs the exact XLA path. The kernel
+    feeds the MXU bf16 patches (what DEFAULT-precision f32 matmuls
+    truncate to anyway); on CPU interpret mode the dot is genuinely
+    bf16, so the tolerance covers bf16 product rounding."""
+    from keystone_tpu.ops import (
+        conv_rectify_pool_pallas,
+        conv_rectify_pool_reference,
+    )
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.random(size=(n, h, w, c)).astype(np.float32))
+    kern = jnp.asarray(
+        rng.normal(size=(patch, patch, c, k)).astype(np.float32)
+    )
+    colsum = jnp.asarray(rng.normal(size=(k,)).astype(np.float32))
+    bias = jnp.asarray(rng.normal(size=(k,)).astype(np.float32))
+    alpha, max_val = 0.25, 0.0
+
+    want = conv_rectify_pool_reference(
+        x, kern, colsum, bias, alpha, max_val, pool, stride, normalize
+    )
+    g_cmajor = jnp.asarray(
+        np.asarray(kern).transpose(2, 0, 1, 3).reshape(-1, k)
+    )
+    got = conv_rectify_pool_pallas(
+        x, g_cmajor, colsum, bias, alpha, max_val, pool, stride,
+        normalize, patch, interpret=True,
+    )
+    assert got.shape == want.shape
+    scale = float(jnp.abs(want).max())
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-2 * scale
+    )
+
+
+def test_conv_fusion_peephole_matches_stagewise():
+    """The _ConvRectifyPoolStage peephole (off-TPU: reference path) must
+    equal running Convolver, SymmetricRectifier, Pooler stage-by-stage
+    through a FusedBatchTransformer."""
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.core import (
+        Convolver,
+        Pooler,
+        SymmetricRectifier,
+    )
+    from keystone_tpu.nodes.util.fusion import FusedBatchTransformer, _peephole
+
+    rng = np.random.default_rng(2)
+    imgs = rng.random(size=(6, 16, 16, 3)).astype(np.float32)
+    filters = rng.normal(size=(8, 5 * 5 * 3)).astype(np.float32)
+    conv = Convolver(filters, 16, 16, 3, normalize_patches=True)
+    rect = SymmetricRectifier(alpha=0.1)
+    pool = Pooler(4, 5, pool_fn="sum")  # distinct stride/size: catches transposition
+
+    stages = [conv, rect, pool]
+    merged = _peephole(stages)
+    assert len(merged) == 1, [type(s).__name__ for s in merged]
+
+    fused = FusedBatchTransformer(stages, microbatch=4)
+    got = fused.apply_batch(Dataset(imgs)).numpy()
+
+    want = imgs
+    want = np.asarray(conv.batch_fn()(jnp.asarray(want)))
+    want = np.asarray(rect.batch_fn()(jnp.asarray(want)))
+    want = np.asarray(pool.batch_fn()(jnp.asarray(want)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
