@@ -89,9 +89,8 @@ class _ConvRectifyPoolStage(Transformer):
         self.patch = conv.patch
         self.normalize = conv.normalize_patches
         # kernel is HWIO (P,P,C,K); the Pallas path wants the channel-
-        # major (C·P·P, K) feature order of conv_general_dilated_patches.
-        # jnp (not numpy): device kernels must not force a host pull.
-        khwio = jnp.asarray(conv.kernel)
+        # major (C·P·P, K) feature order of conv_general_dilated_patches
+        khwio = conv.kernel
         self.g_cmajor = khwio.transpose(2, 0, 1, 3).reshape(-1, khwio.shape[3])
         self.kernel_hwio = conv.kernel
         self.colsum = conv.colsum
@@ -128,10 +127,11 @@ class _ConvRectifyPoolStage(Transformer):
                         x, kern, cs, bs, a, mv, p, s, normalize, patch
                     )
                 except FusedConvIneligibleError:
-                    # reconstruct HWIO from the channel-major layout
+                    # reconstruct HWIO (P,P,C,K) from the channel-major
+                    # (C·P·P, K) layout — inverse of transpose(2,0,1,3)
                     d, k = kern.shape
                     c = d // (patch * patch)
-                    kh = kern.reshape(c, patch, patch, k).transpose(1, 2, 3, 0)
+                    kh = kern.reshape(c, patch, patch, k).transpose(1, 2, 0, 3)
                     from ...ops import conv_rectify_pool_reference
 
                     return conv_rectify_pool_reference(

@@ -191,3 +191,37 @@ def test_conv_fusion_peephole_matches_stagewise():
     want = np.asarray(rect.batch_fn()(jnp.asarray(want)))
     want = np.asarray(pool.batch_fn()(jnp.asarray(want)))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_conv_fused_stage_ineligible_fallback_reconstructs_hwio(monkeypatch):
+    """When the Pallas block geometry can't fit VMEM the fused stage must
+    fall back to the reference conv with a correctly reconstructed HWIO
+    kernel (inverse of the channel-major packing)."""
+    import keystone_tpu.nodes.util.fusion as fusion_mod
+    from keystone_tpu.nodes.images.core import Convolver, Pooler, SymmetricRectifier
+    from keystone_tpu.nodes.util.fusion import _ConvRectifyPoolStage
+
+    rng = np.random.default_rng(3)
+    imgs = jnp.asarray(rng.random(size=(4, 16, 16, 3)).astype(np.float32))
+    filters = rng.normal(size=(8, 5 * 5 * 3)).astype(np.float32)
+    conv = Convolver(filters, 16, 16, 3, normalize_patches=True)
+    stage = _ConvRectifyPoolStage(conv, 0.1, 0.0, 5, 4)
+
+    # force the fused path on and make the geometry ineligible
+    monkeypatch.setattr("keystone_tpu.ops.use_fused_conv", lambda: True)
+    monkeypatch.setattr(
+        "keystone_tpu.ops.pallas_kernels._fused_conv_block_images",
+        lambda *a, **k: 0,
+    )
+    key, params, fn = stage.fuse()
+    assert key[-1] is True  # fused flag baked into the program key
+    got = np.asarray(fn(params, imgs))
+
+    from keystone_tpu.ops import conv_rectify_pool_reference
+
+    want = np.asarray(
+        conv_rectify_pool_reference(
+            imgs, conv.kernel, conv.colsum, conv.bias, 0.1, 0.0, 5, 4, True
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

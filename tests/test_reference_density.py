@@ -1,0 +1,236 @@
+"""Hand-computed fixtures and edge cases mirroring the reference's
+per-node suites (SURVEY §4 categories 6/9): PaddedFFTSuite,
+RandomSignNodeSuite, LinearRectifierSuite, SignedHellingerMapperSuite,
+CosineRandomFeaturesSuite, ClassLabelIndicatorsSuite, TopKClassifierSuite,
+MulticlassClassifierEvaluatorSuite (hand confusion), BinaryClassifierEvaluatorSuite,
+MeanAveragePrecisionSuite (hand 11-point fixture), StandardScalerSuite.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import Dataset
+
+
+# ------------------------------------------------------------- stats nodes
+
+
+def test_padded_fft_matches_numpy_golden():
+    """PaddedFFTSuite: pad 5 → 8, real positive half, vs numpy."""
+    from keystone_tpu.nodes.stats.random_features import PaddedFFT
+
+    x = np.array([1.0, 2.0, -1.0, 0.5, 3.0], np.float32)
+    got = np.asarray(PaddedFFT().apply(jnp.asarray(x)))
+    want = np.fft.rfft(x, n=8).real[:4]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert got.shape == (4,)
+
+
+def test_padded_fft_pow2_input_not_padded():
+    from keystone_tpu.nodes.stats.random_features import PaddedFFT
+
+    x = np.arange(8, dtype=np.float32)
+    got = np.asarray(PaddedFFT().apply(jnp.asarray(x)))
+    want = np.fft.rfft(x, n=8).real[:4]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_random_sign_node_signs_and_determinism():
+    from keystone_tpu.nodes.stats.random_features import RandomSignNode
+
+    n1 = RandomSignNode(64, seed=3)
+    n2 = RandomSignNode(64, seed=3)
+    s = np.asarray(n1.signs)
+    assert set(np.unique(s)) <= {-1.0, 1.0}
+    np.testing.assert_array_equal(s, np.asarray(n2.signs))
+    x = np.ones(64, np.float32)
+    np.testing.assert_array_equal(np.asarray(n1.apply(jnp.asarray(x))), s)
+
+
+def test_linear_rectifier_golden():
+    from keystone_tpu.nodes.stats.random_features import LinearRectifier
+
+    x = jnp.asarray(np.array([-2.0, 0.0, 0.3, 5.0], np.float32))
+    got = np.asarray(LinearRectifier(max_val=0.1, alpha=0.2).apply(x))
+    np.testing.assert_allclose(got, [0.1, 0.1, 0.1, 4.8], rtol=1e-6)
+
+
+def test_signed_hellinger_golden():
+    from keystone_tpu.nodes.stats.normalization import SignedHellingerMapper
+
+    x = jnp.asarray(np.array([-4.0, 0.0, 9.0, -0.25], np.float32))
+    got = np.asarray(SignedHellingerMapper().apply(x))
+    np.testing.assert_allclose(got, [-2.0, 0.0, 3.0, -0.5], rtol=1e-6)
+
+
+def test_normalize_rows_unit_norm_and_zero_row():
+    from keystone_tpu.nodes.stats.normalization import NormalizeRows
+
+    node = NormalizeRows()
+    v = np.array([3.0, 4.0], np.float32)
+    got = np.asarray(node.apply(jnp.asarray(v)))
+    np.testing.assert_allclose(got, [0.6, 0.8], rtol=1e-6)
+    # zero vector: eps floor prevents nan
+    z = np.asarray(node.apply(jnp.zeros(4)))
+    assert np.all(np.isfinite(z)) and np.all(z == 0.0)
+
+
+def test_cosine_random_features_definition_and_range():
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+
+    node = CosineRandomFeatures(8, 32, gamma=0.5, seed=1)
+    x = np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32)
+    got = np.asarray(node.apply_batch(Dataset(x)).numpy())
+    want = np.cos(x @ np.asarray(node.W) + np.asarray(node.b))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert np.all(got >= -1.0 - 1e-6) and np.all(got <= 1.0 + 1e-6)
+
+
+def test_cosine_random_features_rejects_unknown_distribution():
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+
+    with pytest.raises(ValueError):
+        CosineRandomFeatures(4, 4, distribution="levy")
+
+
+def test_standard_scaler_zero_variance_column():
+    """A constant column must not produce nan/inf after scaling."""
+    from keystone_tpu.nodes.stats.scalers import StandardScaler
+
+    X = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32)
+    X[:, 2] = 7.0
+    model = StandardScaler().fit(Dataset(X))
+    out = np.asarray(model.apply_batch(Dataset(X)).numpy())
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out[:, 2], 0.0, atol=1e-5)
+    np.testing.assert_allclose(out[:, 0].std(), 1.0, atol=0.05)
+
+
+# ------------------------------------------------------------- util nodes
+
+
+def test_class_label_indicators_golden_and_validation():
+    from keystone_tpu.nodes.util.basic import ClassLabelIndicatorsFromInt
+
+    node = ClassLabelIndicatorsFromInt(3)
+    np.testing.assert_allclose(
+        np.asarray(node.apply(jnp.asarray(1))), [-1.0, 1.0, -1.0]
+    )
+    with pytest.raises(ValueError):
+        ClassLabelIndicatorsFromInt(1)
+
+
+def test_class_label_indicators_from_int_array_multilabel():
+    from keystone_tpu.nodes.util.basic import ClassLabelIndicatorsFromIntArray
+
+    node = ClassLabelIndicatorsFromIntArray(4)
+    ys = jnp.asarray(np.array([0, 2, -1], np.int32))  # -1 = padding
+    np.testing.assert_allclose(
+        np.asarray(node.apply(ys)), [1.0, -1.0, 1.0, -1.0]
+    )
+
+
+def test_topk_classifier_ordering():
+    from keystone_tpu.nodes.util.basic import TopKClassifier
+
+    x = jnp.asarray(np.array([0.1, 0.9, 0.5, 0.7], np.float32))
+    got = np.asarray(TopKClassifier(3).apply(x))
+    np.testing.assert_array_equal(got, [1, 3, 2])
+
+
+def test_vector_combiner_concatenates_gather_tuple():
+    from keystone_tpu.nodes.util.basic import VectorCombiner
+
+    a = np.ones((4, 2), np.float32)
+    b = 2 * np.ones((4, 3), np.float32)
+    ds = Dataset(a).with_data((jnp.asarray(a), jnp.asarray(b)))
+    got = VectorCombiner().apply_batch(ds).numpy()
+    assert got.shape == (4, 5)
+    np.testing.assert_allclose(got[:, 2:], 2.0)
+
+
+def test_densify_sparsify_roundtrip():
+    import scipy.sparse as sp
+
+    from keystone_tpu.data.sparse import SparseDataset
+    from keystone_tpu.nodes.util.basic import Densify, Sparsify
+
+    X = np.zeros((6, 8), np.float32)
+    X[0, 1] = 3.0
+    X[5, 7] = -2.0
+    sd = SparseDataset(sp.csr_matrix(X))
+    dense = Densify().apply_batch(sd)
+    np.testing.assert_allclose(np.asarray(dense.numpy()), X)
+    back = Sparsify().apply_batch(dense)
+    np.testing.assert_allclose(back.matrix.toarray(), X)
+
+
+def test_shuffler_preserves_multiset():
+    from keystone_tpu.nodes.util.basic import Shuffler
+
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    out = Shuffler(seed=1).apply_batch(Dataset(X)).numpy()
+    assert out.shape == X.shape
+    np.testing.assert_allclose(
+        np.sort(out.ravel()), np.sort(X.ravel())
+    )
+
+
+# ------------------------------------------------------------- evaluators
+
+
+def test_multiclass_hand_computed_confusion():
+    """Reference MulticlassClassifierEvaluatorSuite style: 3-class fixture
+    with a fully hand-checked confusion matrix and macro metrics."""
+    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+
+    actual = [0, 0, 0, 1, 1, 2, 2, 2, 2, 2]
+    pred = [0, 0, 1, 1, 2, 2, 2, 2, 0, 1]
+    m = MulticlassClassifierEvaluator(3)(pred, actual)
+    want = np.array([[2, 1, 0], [0, 1, 1], [1, 1, 3]], np.float64)
+    np.testing.assert_allclose(m.confusion, want)
+    assert abs(m.accuracy - 0.6) < 1e-9
+    # per-class precision: c0: 2/3, c1: 1/3, c2: 3/4
+    assert abs(m.class_precision(0) - 2 / 3) < 1e-9
+    assert abs(m.class_precision(1) - 1 / 3) < 1e-9
+    assert abs(m.class_precision(2) - 3 / 4) < 1e-9
+    # per-class recall: c0: 2/3, c1: 1/2, c2: 3/5
+    assert abs(m.class_recall(1) - 1 / 2) < 1e-9
+    assert abs(m.macro_recall - (2 / 3 + 1 / 2 + 3 / 5) / 3) < 1e-9
+
+
+def test_binary_all_four_cells():
+    from keystone_tpu.evaluation import BinaryClassifierEvaluator
+
+    #            TP TP FP FN TN FN
+    pred = [True, True, True, False, False, False]
+    act = [True, True, False, True, False, True]
+    m = BinaryClassifierEvaluator()(pred, act)
+    assert m.tp == 2 and m.fp == 1 and m.fn == 2 and m.tn == 1
+    assert abs(m.precision - 2 / 3) < 1e-9
+    assert abs(m.recall - 1 / 2) < 1e-9
+    assert abs(m.specificity - 1 / 2) < 1e-9
+    assert abs(m.f1 - 2 * (2 / 3) * (1 / 2) / (2 / 3 + 1 / 2)) < 1e-9
+
+
+def test_map_11_point_hand_fixture():
+    """One class, 4 examples, scores ranking = [pos, neg, pos, neg]:
+    precision@recall: r=0.5 → max p = 1.0, r=1.0 → max p = 2/3.
+    11-point AP = (6 × 1.0 + 5 × 2/3) / 11."""
+    from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
+
+    scores = np.array([[0.9], [0.8], [0.7], [0.1]], np.float32)
+    actuals = [[0], [], [0], []]
+    ap = MeanAveragePrecisionEvaluator(1)(scores, actuals)
+    want = (6 * 1.0 + 5 * (2 / 3)) / 11.0
+    assert abs(ap[0] - want) < 1e-9
+
+
+def test_map_class_with_no_positives_scores_zero():
+    from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
+
+    scores = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+    actuals = [[0], [0]]
+    aps = MeanAveragePrecisionEvaluator(2)(scores, actuals)
+    assert aps[1] == 0.0 and aps[0] > 0.99
