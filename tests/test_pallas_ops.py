@@ -197,7 +197,6 @@ def test_conv_fused_stage_ineligible_fallback_reconstructs_hwio(monkeypatch):
     """When the Pallas block geometry can't fit VMEM the fused stage must
     fall back to the reference conv with a correctly reconstructed HWIO
     kernel (inverse of the channel-major packing)."""
-    import keystone_tpu.nodes.util.fusion as fusion_mod
     from keystone_tpu.nodes.images.core import Convolver, Pooler, SymmetricRectifier
     from keystone_tpu.nodes.util.fusion import _ConvRectifyPoolStage
 

@@ -234,3 +234,112 @@ def test_map_class_with_no_positives_scores_zero():
     actuals = [[0], [0]]
     aps = MeanAveragePrecisionEvaluator(2)(scores, actuals)
     assert aps[1] == 0.0 and aps[0] > 0.99
+
+
+# ------------------------------------------------------------ image utils
+# (reference ImageUtilsSuite / ImageSuite)
+
+
+def test_depthwise_conv2d_matches_scipy_separable():
+    from scipy.ndimage import convolve1d
+
+    from keystone_tpu.utils.images import depthwise_conv2d
+
+    rng = np.random.default_rng(0)
+    img = rng.random(size=(12, 10, 3)).astype(np.float32)
+    ky = np.array([0.1, 0.3, 0.6], np.float32)  # asymmetric: pins the
+    kx = np.array([0.7, 0.2, 0.1], np.float32)  # correlation orientation
+    got = np.asarray(depthwise_conv2d(img, ky, kx))
+    want = np.empty_like(img)
+    for c in range(3):
+        # lax conv is correlation; scipy convolve1d flips, so pre-flip
+        t = convolve1d(img[:, :, c], ky[::-1], axis=0, mode="constant")
+        want[:, :, c] = convolve1d(t, kx[::-1], axis=1, mode="constant")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_extract_patches_values_and_count():
+    from keystone_tpu.utils.images import extract_patches
+
+    img = np.arange(2 * 5 * 5 * 1, dtype=np.float32).reshape(2, 5, 5, 1)
+    pats = extract_patches(img, 3, 2)  # positions (0,0),(0,2),(2,0),(2,2)
+    assert pats.shape == (2 * 4, 9)
+    np.testing.assert_allclose(pats[0], img[0, 0:3, 0:3, 0].ravel())
+    np.testing.assert_allclose(pats[3], img[0, 2:5, 2:5, 0].ravel())
+
+
+def test_flip_horizontal_and_grayscale_golden():
+    from keystone_tpu.utils.images import flip_horizontal, grayscale
+
+    img = np.zeros((2, 3, 3), np.float32)
+    img[0, 0] = [1.0, 0.0, 0.0]
+    flipped = np.asarray(flip_horizontal(img))
+    np.testing.assert_allclose(flipped[0, 2], [1.0, 0.0, 0.0])
+    g = np.asarray(grayscale(img))
+    assert abs(float(g[0, 0, 0]) - 0.299) < 1e-6
+
+
+# ------------------------------------------------------------------- nlp
+# (reference NGramSuite / StringUtilsSuite)
+
+
+def test_ngram_equality_and_hash_semantics():
+    from keystone_tpu.nodes.nlp.text import NGram
+
+    a, b = NGram(["the", "cat"]), NGram(["the", "cat"])
+    c = NGram(["the", "dog"])
+    assert a == b and hash(a) == hash(b)
+    assert a != c and a != ("the", "cat")
+    assert repr(a) == "[the,cat]"
+    assert len({a, b, c}) == 2
+
+
+def test_ngrams_featurizer_orders_and_counts_modes():
+    from keystone_tpu.data.dataset import HostDataset
+    from keystone_tpu.nodes.nlp.text import NGramsCounts, NGramsFeaturizer
+
+    feats = NGramsFeaturizer([1, 2]).apply(["a", "b", "a"])
+    assert ("a",) in feats and ("a", "b") in feats and ("b", "a") in feats
+    assert len(feats) == 3 + 2
+
+    ds = HostDataset([feats, NGramsFeaturizer([1, 2]).apply(["a", "c"])])
+    merged = NGramsCounts("default").apply_batch(ds)
+    (pairs,) = merged.items  # single global sorted (ngram, count) list
+    counts = dict(pairs)
+    assert counts[("a",)] == 3  # 2 from first doc + 1 from second
+    cs = [c for _, c in pairs]
+    assert cs == sorted(cs, reverse=True)  # descending sort by count
+    with pytest.raises(ValueError):
+        NGramsCounts("bogus")
+
+
+def test_tokenizer_trim_lowercase_chain():
+    from keystone_tpu.nodes.nlp.text import LowerCase, Tokenizer, Trim
+
+    s = "  The QUICK brown-fox  "
+    out = Tokenizer().apply(LowerCase().apply(Trim().apply(s)))
+    assert out[0] == "the" and "quick" in out
+
+
+def test_corenlp_extractor_with_trained_ner_replaces_entities():
+    from keystone_tpu.nodes.nlp.annotators import NER, CoreNLPFeatureExtractor
+
+    ex = CoreNLPFeatureExtractor(orders=(1,), ner=NER.trained())
+    grams = ex.apply("John visited Paris yesterday")
+    toks = [g[0] for g in grams]
+    # entity tokens are replaced by their NE tag, others lemmatized+lowered
+    assert "visit" in toks or "visited" in toks
+    assert any(t.isupper() for t in toks), toks  # some NE tag survived
+
+
+# ------------------------------------------------------------ host dataset
+
+
+def test_host_dataset_map_count_and_cache():
+    from keystone_tpu.data.dataset import HostDataset
+
+    hd = HostDataset(["ab", "c", "def"])
+    assert hd.count == 3
+    lens = hd.map(len)
+    assert lens.items == [2, 1, 3]
+    assert hd.cache() is hd
