@@ -290,6 +290,15 @@ class Cropper(Transformer):
         return x[y0:y1, x0:x1, :]
 
 
+@partial(jax.jit, static_argnames=("window", "stride"))
+def _window_batch(imgs, window: int, stride: int):
+    """(N, H, W, C) → (N·gy·gx, window, window, C) on device — one
+    extraction conv instead of a host round trip + python loop."""
+    from ...utils.images import extract_patches_device
+
+    return extract_patches_device(imgs, window, stride)
+
+
 class Windower(Transformer):
     """All strided patches of each image; the batch path flattens
     (N, …) → (N·patches, p, p, C), changing the dataset count
@@ -306,13 +315,14 @@ class Windower(Transformer):
         return flat.reshape(-1, self.window_size, self.window_size, image.shape[-1])
 
     def apply_batch(self, data: Dataset):
-        from ...utils.images import extract_patches
-
-        imgs = data.numpy()
-        c = imgs.shape[-1]
-        patches = extract_patches(imgs, self.window_size, self.stride)
+        h, w = data.array.shape[1], data.array.shape[2]
+        gy = (h - self.window_size) // self.stride + 1
+        gx = (w - self.window_size) // self.stride + 1
+        # padding rows' windows land at the tail (image-major order), so
+        # an explicit count keeps exactly the valid windows
         return Dataset(
-            patches.reshape(-1, self.window_size, self.window_size, c),
+            _window_batch(data.array, self.window_size, self.stride),
+            count=data.count * gy * gx,
             mesh=data.mesh,
         )
 
@@ -329,19 +339,21 @@ class RandomPatcher(Transformer):
         self._rng = np.random.default_rng(seed)  # stateful: varies per call
 
     def apply_batch(self, data: Dataset):
-        imgs = data.numpy()
-        n, h, w, c = imgs.shape
+        # crop offsets drawn on host (tiny); the gather runs on device —
+        # no round trip of the image tensor
+        n = data.count
+        h, w = data.array.shape[1], data.array.shape[2]
         rng = np.random.default_rng(self.seed)
         ys = rng.integers(0, h - self.patch_h + 1, size=(n, self.patches_per_image))
         xs = rng.integers(0, w - self.patch_w + 1, size=(n, self.patches_per_image))
-        out = np.empty((n * self.patches_per_image, self.patch_h, self.patch_w, c), imgs.dtype)
-        idx = 0
-        for i in range(n):
-            for j in range(self.patches_per_image):
-                y, x = ys[i, j], xs[i, j]
-                out[idx] = imgs[i, y : y + self.patch_h, x : x + self.patch_w]
-                idx += 1
-        return Dataset(out, mesh=data.mesh)
+        ppi = self.patches_per_image
+        img_idx = jnp.asarray(np.repeat(np.arange(n), ppi))        # (n·ppi,)
+        row0 = jnp.asarray(ys.reshape(-1))                          # (n·ppi,)
+        col0 = jnp.asarray(xs.reshape(-1))
+        rows = row0[:, None, None] + jnp.arange(self.patch_h)[None, :, None]
+        cols = col0[:, None, None] + jnp.arange(self.patch_w)[None, None, :]
+        out = data.array[img_idx[:, None, None], rows, cols, :]     # one gather
+        return Dataset(out, count=n * ppi, mesh=data.mesh)
 
     def apply(self, image):
         y = self._rng.integers(0, image.shape[0] - self.patch_h + 1)
@@ -358,13 +370,18 @@ class CenterCornerPatcher(Transformer):
         self.patch_w = patch_w
         self.with_flips = with_flips
 
-    def _crops(self, image):
-        h, w = image.shape[0], image.shape[1]
+    def _starts(self, h: int, w: int):
+        """Shared crop geometry — the single-item and batch paths must
+        emit identical crop order (cifar_variants relies on it)."""
         ph, pw = self.patch_h, self.patch_w
-        starts = [
+        return [
             (0, 0), (0, w - pw), (h - ph, 0), (h - ph, w - pw),
             ((h - ph) // 2, (w - pw) // 2),
         ]
+
+    def _crops(self, image):
+        ph, pw = self.patch_h, self.patch_w
+        starts = self._starts(image.shape[0], image.shape[1])
         crops = [image[y : y + ph, x : x + pw] for y, x in starts]
         if self.with_flips:
             crops += [c[:, ::-1] for c in crops]
@@ -374,9 +391,16 @@ class CenterCornerPatcher(Transformer):
         return np.stack(self._crops(np.asarray(image)))
 
     def apply_batch(self, data: Dataset):
-        imgs = data.numpy()
-        out = np.concatenate([np.stack(self._crops(img)) for img in imgs])
-        return Dataset(out, mesh=data.mesh)
+        # five static slices (+flips) on device, image-major output order
+        imgs = data.array
+        ph, pw = self.patch_h, self.patch_w
+        starts = self._starts(imgs.shape[1], imgs.shape[2])
+        crops = [imgs[:, y : y + ph, x : x + pw] for y, x in starts]
+        if self.with_flips:
+            crops += [c[:, :, ::-1] for c in crops]
+        k = len(crops)
+        out = jnp.stack(crops, axis=1).reshape(-1, ph, pw, imgs.shape[-1])
+        return Dataset(out, count=data.count * k, mesh=data.mesh)
 
 
 class RandomImageTransformer(Transformer):

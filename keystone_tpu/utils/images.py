@@ -93,3 +93,20 @@ def extract_patches(images: np.ndarray, patch: int, stride: int = 1) -> np.ndarr
     view = view[:, ::stride, ::stride]
     view = view.transpose(0, 1, 2, 4, 5, 3)  # (n, gy, gx, p, p, c)
     return view.reshape(-1, patch * patch * c)
+
+
+def extract_patches_device(images, patch: int, stride: int = 1):
+    """Device analog of `extract_patches`: (N, H, W, C) →
+    (N·gy·gx, patch, patch, C) via one extraction conv. HIGHEST
+    precision — the identity-kernel conv must reproduce pixel values
+    exactly (TPU default conv precision is bf16)."""
+    from jax import lax
+
+    c = images.shape[-1]
+    pats = lax.conv_general_dilated_patches(
+        images, (patch, patch), (stride, stride), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=lax.Precision.HIGHEST,
+    )  # (N, gy, gx, C·P·P), channel-major features
+    pats = pats.reshape(-1, c, patch, patch)
+    return jnp.transpose(pats, (0, 2, 3, 1))

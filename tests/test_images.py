@@ -141,3 +141,56 @@ def test_zca_whitener_decorrelates():
     Xw = (X - w.means_np) @ w.whitener_np
     cov = Xw.T @ Xw / (len(X) - 1)
     np.testing.assert_allclose(cov, np.eye(4), atol=0.05)
+
+
+def test_windower_device_path_matches_host_reference():
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.core import Windower
+    from keystone_tpu.utils.images import extract_patches
+
+    rng = np.random.default_rng(5)
+    imgs = rng.random(size=(5, 9, 9, 2)).astype(np.float32)  # 5 % shards != 0
+    out = Windower(2, 4).apply_batch(Dataset(imgs))
+    want = extract_patches(imgs, 4, 2).reshape(-1, 4, 4, 2)
+    assert out.count == want.shape[0]
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+
+def test_random_patcher_device_gather_matches_host_loop():
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.core import RandomPatcher
+
+    rng = np.random.default_rng(6)
+    imgs = rng.random(size=(6, 12, 12, 3)).astype(np.float32)
+    node = RandomPatcher(3, 5, 5, seed=7)
+    out = node.apply_batch(Dataset(imgs))
+    # host reference with the same seed-derived offsets
+    r = np.random.default_rng(7)
+    ys = r.integers(0, 12 - 5 + 1, size=(6, 3))
+    xs = r.integers(0, 12 - 5 + 1, size=(6, 3))
+    want = np.stack([
+        imgs[i, ys[i, j]: ys[i, j] + 5, xs[i, j]: xs[i, j] + 5]
+        for i in range(6) for j in range(3)
+    ])
+    assert out.count == 18
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+
+def test_center_corner_patcher_device_order_and_flips():
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.core import CenterCornerPatcher
+
+    rng = np.random.default_rng(8)
+    imgs = rng.random(size=(3, 8, 8, 1)).astype(np.float32)
+    node = CenterCornerPatcher(4, 4, with_flips=True)
+    out = node.apply_batch(Dataset(imgs))
+    assert out.count == 3 * 10
+    # image-major order: first 10 rows are image 0's crops; row 0 is the
+    # top-left crop, row 5 its horizontal flip
+    got = out.numpy()
+    np.testing.assert_allclose(got[0], imgs[0, :4, :4])
+    np.testing.assert_allclose(got[5], imgs[0, :4, :4][:, ::-1])
+    np.testing.assert_allclose(got[10], imgs[1, :4, :4])
+    # single-item path agrees
+    single = np.asarray(node.apply(imgs[0]))
+    np.testing.assert_allclose(got[:10], single, rtol=1e-6)
