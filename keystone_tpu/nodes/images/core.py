@@ -290,15 +290,6 @@ class Cropper(Transformer):
         return x[y0:y1, x0:x1, :]
 
 
-@partial(jax.jit, static_argnames=("window", "stride"))
-def _window_batch(imgs, window: int, stride: int):
-    """(N, H, W, C) → (N·gy·gx, window, window, C) on device — one
-    extraction conv instead of a host round trip + python loop."""
-    from ...utils.images import extract_patches_device
-
-    return extract_patches_device(imgs, window, stride)
-
-
 class Windower(Transformer):
     """All strided patches of each image; the batch path flattens
     (N, …) → (N·patches, p, p, C), changing the dataset count
@@ -315,13 +306,15 @@ class Windower(Transformer):
         return flat.reshape(-1, self.window_size, self.window_size, image.shape[-1])
 
     def apply_batch(self, data: Dataset):
+        from ...utils.images import extract_patches_device
+
         h, w = data.array.shape[1], data.array.shape[2]
         gy = (h - self.window_size) // self.stride + 1
         gx = (w - self.window_size) // self.stride + 1
         # padding rows' windows land at the tail (image-major order), so
         # an explicit count keeps exactly the valid windows
         return Dataset(
-            _window_batch(data.array, self.window_size, self.stride),
+            extract_patches_device(data.array, self.window_size, self.stride),
             count=data.count * gy * gx,
             mesh=data.mesh,
         )
@@ -412,13 +405,33 @@ class RandomImageTransformer(Transformer):
         self.seed = seed
         self._rng = np.random.default_rng(seed)  # stateful: varies per call
 
-    def apply_batch(self, data: Dataset):
-        imgs = np.array(data.numpy(), copy=True)
+    def apply_batch(self, data):
         rng = np.random.default_rng(self.seed)
-        flips = rng.random(imgs.shape[0]) < self.prob
+        flips = rng.random(data.count) < self.prob
+        # Device path ONLY for transforms that declare themselves pure
+        # and traceable (`jax_traceable = True`, e.g. utils.images.
+        # flip_horizontal). vmap traces the function ONCE, so a
+        # transform with host-side randomness/state would silently get
+        # constant-folded — the per-image host loop is the only correct
+        # general path.
+        if (
+            isinstance(data, Dataset)
+            and getattr(self.transform, "jax_traceable", False)
+        ):
+            imgs = data.array
+            mask = jnp.asarray(
+                np.pad(flips, (0, imgs.shape[0] - data.count))
+            ).reshape((-1,) + (1,) * (imgs.ndim - 1))
+            transformed = jax.vmap(self.transform)(imgs)
+            if (
+                transformed.shape == imgs.shape
+                and transformed.dtype == imgs.dtype
+            ):
+                return data.with_data(jnp.where(mask, transformed, imgs))
+        imgs = np.array(data.numpy(), copy=True)
         for i in np.nonzero(flips)[0]:
             imgs[i] = self.transform(imgs[i])
-        return Dataset(imgs, mesh=data.mesh)
+        return Dataset(imgs, mesh=getattr(data, "mesh", None))
 
     def apply(self, image):
         return self.transform(image) if self._rng.random() < self.prob else image

@@ -57,11 +57,15 @@ class Sampler(Transformer):
             return data
         idx = np.random.default_rng(self.seed).choice(n, self.size, replace=False)
         idx.sort()
-        host = data.numpy()
         import jax
+        import jax.numpy as jnp
 
-        picked = jax.tree_util.tree_map(lambda x: x[idx], host)
-        return Dataset(picked, mesh=data.mesh)
+        # gather on device — never pull the full dataset to host
+        jidx = jnp.asarray(idx)
+        picked = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, jidx, axis=0), data.array
+        )
+        return Dataset(picked, count=self.size, mesh=data.mesh)
 
 
 class ColumnSampler(Transformer):

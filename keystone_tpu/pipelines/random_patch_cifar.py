@@ -76,16 +76,13 @@ def _learn_filters_device(images, idx, sub_idx, filter_idx, eps, patch: int, ste
 
     sel = jnp.take(images, idx, axis=0) / 255.0
     c = sel.shape[-1]
-    pats = lax.conv_general_dilated_patches(
-        sel, (patch, patch), (step, step), "VALID",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        precision=lax.Precision.HIGHEST,  # identity conv must be exact
+    # shared exact-extraction helper (HIGHEST precision, (ph, pw, C)
+    # flat layout matching utils.images.extract_patches)
+    from ..utils.images import extract_patches_device
+
+    flat = extract_patches_device(sel, patch, step).reshape(
+        -1, patch * patch * c
     )
-    # feature dim is (C, ph, pw); reorder to the (ph, pw, C) flat layout
-    # used everywhere else (utils.images.extract_patches)
-    gy, gx = pats.shape[1], pats.shape[2]
-    pats = pats.reshape(-1, c, patch, patch).transpose(0, 2, 3, 1)
-    flat = pats.reshape(idx.shape[0] * gy * gx, patch * patch * c)
     flat = jnp.take(flat, sub_idx, axis=0)
     # normalizeRows(_, 10.0): subtract patch mean, divide by max(norm, 10/255)
     flat = flat - flat.mean(axis=1, keepdims=True)

@@ -59,6 +59,11 @@ def flip_horizontal(image):
     return image[:, ::-1, :]
 
 
+# pure, shape/dtype-preserving, no internal host state: safe to vmap on
+# device (RandomImageTransformer's device path keys on this marker)
+flip_horizontal.jax_traceable = True
+
+
 def depthwise_conv2d(image, kernel_y, kernel_x):
     """Separable depthwise 2-D convolution, 'same' padding — one
     `lax.conv_general_dilated` per axis with `feature_group_count=C`
@@ -95,11 +100,19 @@ def extract_patches(images: np.ndarray, patch: int, stride: int = 1) -> np.ndarr
     return view.reshape(-1, patch * patch * c)
 
 
+from functools import partial as _partial
+
+import jax as _jax
+
+
+@_partial(_jax.jit, static_argnames=("patch", "stride"))
 def extract_patches_device(images, patch: int, stride: int = 1):
     """Device analog of `extract_patches`: (N, H, W, C) →
     (N·gy·gx, patch, patch, C) via one extraction conv. HIGHEST
     precision — the identity-kernel conv must reproduce pixel values
-    exactly (TPU default conv precision is bf16)."""
+    exactly (TPU default conv precision is bf16). The single source of
+    the channel-major→(p,p,c) reorder (Windower and the filter-learning
+    program both call this)."""
     from jax import lax
 
     c = images.shape[-1]

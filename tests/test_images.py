@@ -194,3 +194,36 @@ def test_center_corner_patcher_device_order_and_flips():
     # single-item path agrees
     single = np.asarray(node.apply(imgs[0]))
     np.testing.assert_allclose(got[:10], single, rtol=1e-6)
+
+
+def test_random_image_transformer_device_matches_host():
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.core import RandomImageTransformer
+    from keystone_tpu.utils.images import flip_horizontal
+
+    rng = np.random.default_rng(9)
+    imgs = rng.random(size=(10, 6, 6, 3)).astype(np.float32)
+    node = RandomImageTransformer(0.5, flip_horizontal, seed=4)
+    got = node.apply_batch(Dataset(imgs)).numpy()
+    # host reference with the same draws
+    r = np.random.default_rng(4)
+    flips = r.random(10) < 0.5
+    want = imgs.copy()
+    for i in np.nonzero(flips)[0]:
+        want[i] = want[i][:, ::-1]
+    assert flips.any() and not flips.all()  # both branches exercised
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_random_image_transformer_host_fallback_for_python_transform():
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.images.core import RandomImageTransformer
+
+    def numpy_only(img):  # not jnp-traceable: forces the host fallback
+        arr = np.asarray(img)
+        return arr[::-1].copy()
+
+    imgs = np.random.default_rng(2).random(size=(6, 4, 4, 1)).astype(np.float32)
+    node = RandomImageTransformer(1.0, numpy_only, seed=0)
+    got = node.apply_batch(Dataset(imgs)).numpy()
+    np.testing.assert_allclose(got, imgs[:, ::-1])

@@ -343,3 +343,17 @@ def test_host_dataset_map_count_and_cache():
     lens = hd.map(len)
     assert lens.items == [2, 1, 3]
     assert hd.cache() is hd
+
+
+def test_sampler_device_gather_matches_host_choice():
+    from keystone_tpu.nodes.stats.normalization import Sampler
+
+    X = np.arange(80, dtype=np.float32).reshape(20, 4)
+    out = Sampler(6, seed=3).apply_batch(Dataset(X))
+    assert out.count == 6
+    idx = np.random.default_rng(3).choice(20, 6, replace=False)
+    idx.sort()
+    np.testing.assert_allclose(out.numpy(), X[idx])
+    # n <= size: pass-through
+    same = Sampler(50, seed=3).apply_batch(Dataset(X))
+    assert same.count == 20
