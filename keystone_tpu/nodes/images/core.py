@@ -419,15 +419,17 @@ class RandomImageTransformer(Transformer):
             and getattr(self.transform, "jax_traceable", False)
         ):
             imgs = data.array
-            mask = jnp.asarray(
-                np.pad(flips, (0, imgs.shape[0] - data.count))
-            ).reshape((-1,) + (1,) * (imgs.ndim - 1))
-            transformed = jax.vmap(self.transform)(imgs)
-            if (
-                transformed.shape == imgs.shape
-                and transformed.dtype == imgs.dtype
-            ):
+            # shape/dtype eligibility without computing anything
+            spec = jax.eval_shape(jax.vmap(self.transform), imgs)
+            if spec.shape == imgs.shape and spec.dtype == imgs.dtype:
+                mask = jnp.asarray(
+                    np.pad(flips, (0, imgs.shape[0] - data.count))
+                ).reshape((-1,) + (1,) * (imgs.ndim - 1))
+                transformed = jax.vmap(self.transform)(imgs)
                 return data.with_data(jnp.where(mask, transformed, imgs))
+        # host path; also reached by HostDataset input (fixed-shape items
+        # stack — HostDataset.numpy() returns the item list, and it has
+        # no .mesh, hence the getattr)
         imgs = np.array(data.numpy(), copy=True)
         for i in np.nonzero(flips)[0]:
             imgs[i] = self.transform(imgs[i])

@@ -8,6 +8,7 @@
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -57,9 +58,6 @@ class Sampler(Transformer):
             return data
         idx = np.random.default_rng(self.seed).choice(n, self.size, replace=False)
         idx.sort()
-        import jax
-        import jax.numpy as jnp
-
         # gather on device — never pull the full dataset to host
         jidx = jnp.asarray(idx)
         picked = jax.tree_util.tree_map(

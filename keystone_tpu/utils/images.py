@@ -12,6 +12,9 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -100,12 +103,7 @@ def extract_patches(images: np.ndarray, patch: int, stride: int = 1) -> np.ndarr
     return view.reshape(-1, patch * patch * c)
 
 
-from functools import partial as _partial
-
-import jax as _jax
-
-
-@_partial(_jax.jit, static_argnames=("patch", "stride"))
+@partial(jax.jit, static_argnames=("patch", "stride"))
 def extract_patches_device(images, patch: int, stride: int = 1):
     """Device analog of `extract_patches`: (N, H, W, C) →
     (N·gy·gx, patch, patch, C) via one extraction conv. HIGHEST
