@@ -76,7 +76,11 @@ class GaussianKernelGenerator(Estimator):
         self.gamma = gamma
 
     def fit(self, data: Dataset) -> GaussianKernelTransformer:
-        return GaussianKernelTransformer(np.asarray(data.numpy()), self.gamma)
+        # anchors stay on device: slice off the padding rows, no host
+        # round trip of the training matrix
+        return GaussianKernelTransformer(
+            data.array[: data.count], self.gamma
+        )
 
 
 class BlockKernelMatrix:

@@ -203,6 +203,9 @@ class Shuffler(Transformer):
             idx = np.random.default_rng(self.seed).permutation(len(data))
             return HostDataset([data.items[i] for i in idx])
         idx = np.random.default_rng(self.seed).permutation(data.count)
-        host = data.numpy()
-        picked = jax.tree_util.tree_map(lambda x: x[idx], host)
-        return Dataset(picked, mesh=data.mesh)
+        # device gather (indices only touch valid rows)
+        jidx = jnp.asarray(idx)
+        picked = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, jidx, axis=0), data.array
+        )
+        return Dataset(picked, count=data.count, mesh=data.mesh)

@@ -88,10 +88,6 @@ class _ConvRectifyPoolStage(Transformer):
         self.stride = stride
         self.patch = conv.patch
         self.normalize = conv.normalize_patches
-        # kernel is HWIO (P,P,C,K); the Pallas path wants the channel-
-        # major (C·P·P, K) feature order of conv_general_dilated_patches
-        khwio = conv.kernel
-        self.g_cmajor = khwio.transpose(2, 0, 1, 3).reshape(-1, khwio.shape[3])
         self.kernel_hwio = conv.kernel
         self.colsum = conv.colsum
         self.bias = conv.bias
@@ -108,44 +104,20 @@ class _ConvRectifyPoolStage(Transformer):
         from ...ops import use_fused_conv
 
         a, mv, p, s = self.alpha, self.max_val, self.pool, self.stride
-        patch, normalize = self.patch, self.normalize
+        normalize = self.normalize
         fused = use_fused_conv()  # part of the key (see _RectifyPoolStage)
-        # only the layout the chosen path needs rides the program params
-        kernel_param = self.g_cmajor if fused else self.kernel_hwio
 
         def fn(params, x):
             (kern, cs, bs) = params
-            if fused:
-                from ...ops import (
-                    FusedConvIneligibleError,
-                    conv_rectify_pool_pallas,
-                )
+            from ...ops import conv_rectify_pool
 
-                try:  # trace-time eligibility: fall back only when the
-                    # block geometry cannot fit VMEM
-                    return conv_rectify_pool_pallas(
-                        x, kern, cs, bs, a, mv, p, s, normalize, patch
-                    )
-                except FusedConvIneligibleError:
-                    # reconstruct HWIO (P,P,C,K) from the channel-major
-                    # (C·P·P, K) layout — inverse of transpose(2,0,1,3)
-                    d, k = kern.shape
-                    c = d // (patch * patch)
-                    kh = kern.reshape(c, patch, patch, k).transpose(1, 2, 0, 3)
-                    from ...ops import conv_rectify_pool_reference
-
-                    return conv_rectify_pool_reference(
-                        x, kh, cs, bs, a, mv, p, s, normalize
-                    )
-            from ...ops import conv_rectify_pool_reference
-
-            return conv_rectify_pool_reference(
+            return conv_rectify_pool(
                 x, kern, cs, bs, a, mv, p, s, normalize
             )
 
         return (
-            ("ConvRectifyPool", a, mv, p, s, patch, normalize, fused),
-            (kernel_param, self.colsum, self.bias),
+            ("ConvRectifyPool", a, mv, p, s, self.patch, normalize, fused),
+            (self.kernel_hwio, self.colsum, self.bias),
             fn,
         )
 

@@ -2,9 +2,11 @@
 
 from .pallas_kernels import (
     FusedConvIneligibleError,
+    conv_rectify_pool,
     conv_rectify_pool_pallas,
     conv_rectify_pool_reference,
     folded_conv_reference,
+    hwio_to_cmajor,
     rbf_block,
     rbf_block_pallas,
     rbf_block_reference,
@@ -17,9 +19,11 @@ from .pallas_kernels import (
 
 __all__ = [
     "FusedConvIneligibleError",
+    "conv_rectify_pool",
     "conv_rectify_pool_pallas",
     "conv_rectify_pool_reference",
     "folded_conv_reference",
+    "hwio_to_cmajor",
     "rbf_block",
     "rbf_block_pallas",
     "rbf_block_reference",

@@ -288,6 +288,35 @@ def conv_rectify_pool_reference(
     return rectify_pool_reference(out, alpha, max_val, pool, stride)
 
 
+def hwio_to_cmajor(kernel_hwio):
+    """(P,P,C,K) → the channel-major (C·P·P, K) feature layout the Pallas
+    kernel consumes (conv_general_dilated_patches order)."""
+    return kernel_hwio.transpose(2, 0, 1, 3).reshape(-1, kernel_hwio.shape[3])
+
+
+def conv_rectify_pool(
+    images, kernel_hwio, colsum, bias, alpha, max_val,
+    pool: int, stride: int, normalize: bool,
+):
+    """Dispatcher: fused Pallas kernel on TPU (default on), XLA
+    elsewhere or when the block geometry cannot fit VMEM. The single
+    entry point for Convolver>>Rectifier>>Pooler semantics — the fusion
+    peephole and the driver graft entry both route through it."""
+    if use_fused_conv():
+        try:
+            return conv_rectify_pool_pallas(
+                images, hwio_to_cmajor(kernel_hwio), colsum, bias,
+                alpha, max_val, pool, stride, normalize,
+                kernel_hwio.shape[0],
+            )
+        except FusedConvIneligibleError:
+            pass
+    return conv_rectify_pool_reference(
+        images, kernel_hwio, colsum, bias, alpha, max_val, pool, stride,
+        normalize,
+    )
+
+
 def _pool_matrix(b: int, pos_h: int, pos_w: int, posp: int,
                  pool: int, stride: int) -> "np.ndarray":
     """(b·cells, b·posp) block-diagonal 0/1 sum-pool weights over the

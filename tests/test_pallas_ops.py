@@ -209,6 +209,9 @@ def test_conv_fused_stage_ineligible_fallback_reconstructs_hwio(monkeypatch):
     # force the fused path on and make the geometry ineligible
     monkeypatch.setattr("keystone_tpu.ops.use_fused_conv", lambda: True)
     monkeypatch.setattr(
+        "keystone_tpu.ops.pallas_kernels.use_fused_conv", lambda: True
+    )
+    monkeypatch.setattr(
         "keystone_tpu.ops.pallas_kernels._fused_conv_block_images",
         lambda *a, **k: 0,
     )
