@@ -363,24 +363,6 @@ def test_sampler_device_gather_matches_host_choice():
 # (reference StatsSuite / MatrixUtilsSuite)
 
 
-def test_about_eq_tolerance_semantics():
-    from keystone_tpu.utils.stats import about_eq
-
-    assert about_eq(1.0, 1.0 + 1e-9)
-    assert not about_eq(1.0, 1.1)
-    assert about_eq(np.ones(3), np.ones(3) + 1e-10)
-    assert not about_eq(np.ones(3), np.array([1.0, 1.0, 2.0]))
-
-
-def test_normalize_rows_floor_and_unit_norm():
-    from keystone_tpu.utils.stats import normalize_rows
-
-    X = np.array([[3.0, 4.0], [0.0, 0.0]], np.float32)
-    out = normalize_rows(X, floor=0.5)
-    np.testing.assert_allclose(out[0], [0.6, 0.8], rtol=1e-6)
-    np.testing.assert_allclose(out[1], [0.0, 0.0])  # floored, no nan
-
-
 def test_rows_matrix_roundtrip():
     from keystone_tpu.utils.stats import matrix_to_rows, rows_to_matrix
 

@@ -132,8 +132,12 @@ def test_annotators():
 def test_stats_helpers():
     assert about_eq([1.0, 2.0], [1.0, 2.0 + 1e-10])
     assert not about_eq([1.0], [1.1])
+    assert not about_eq(np.ones(3), np.array([1.0, 1.0, 2.0]))  # shape-safe
     N = normalize_rows(np.array([[3.0, 4.0]]))
     np.testing.assert_allclose(np.linalg.norm(N, axis=1), 1.0)
+    # zero row: floored denominator, no nan
+    Z = normalize_rows(np.array([[0.0, 0.0]]), floor=0.5)
+    np.testing.assert_allclose(Z, [[0.0, 0.0]])
 
 
 def test_native_csv_rejects_empty_fields(tmp_path):
@@ -261,3 +265,27 @@ def test_spread_take_spreads_and_bounds():
     assert out[0, 0] == X[0, 0] and out[-1, 0] == X[-1, 0]
     full = ds.spread_take(100)  # m > count clamps to count
     np.testing.assert_allclose(full, X)
+
+
+def test_execution_profiler_times_and_reports():
+    """profile_execution wraps node expressions and attributes forced
+    executions (SURVEY §5 profiling; AutoCacheRule.profileNodes analog)."""
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.utils.profiling import profile_execution
+    from keystone_tpu.workflow.pipeline import Transformer
+
+    class Add(Transformer):
+        def __init__(self, v):
+            self.v = v
+
+        def apply(self, x):
+            return x + self.v
+
+    X = np.ones((8, 3), np.float32)
+    with profile_execution() as prof:
+        out = (Add(1.0) >> Add(2.0))(Dataset(X)).get()
+    np.testing.assert_allclose(out.numpy(), X + 3.0)
+    assert prof.profiles, "no nodes profiled"
+    assert sum(p.forced for p in prof.profiles.values()) >= 2
+    rep = prof.report()
+    assert "seconds" in rep and "forced" in rep
