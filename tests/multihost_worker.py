@@ -70,5 +70,16 @@ W_ref = np.linalg.solve(X.T @ X + lam * np.eye(d), X.T @ Y)
 err = np.abs(W - W_ref).max() / max(np.abs(W_ref).max(), 1e-9)
 assert err < 5e-3, err
 
+# --- BCD block solver across hosts (scan + psum over the DCN link) -----
+with use_mesh(mesh):
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+
+    bcd = BlockLeastSquaresEstimator(block_size=3, num_iter=25, lam=lam).fit(
+        Xds, Yds
+    )
+    Wb = np.asarray(bcd.W)[:d]  # strip intercept row if present
+err_b = np.abs(Wb - W_ref).max() / max(np.abs(W_ref).max(), 1e-9)
+assert err_b < 5e-2, err_b
+
 multihost.barrier()
 print(f"[{proc_id}] MULTIHOST_OK", flush=True)
