@@ -25,7 +25,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ...parallel import mesh as meshlib
-from .cost_model import CPU_WEIGHT, MEM_WEIGHT, NETWORK_WEIGHT
+from . import cost_model
 
 
 @dataclass
@@ -100,7 +100,7 @@ def calibrate_cost_weights(
     a = jnp.ones((gemm_dim, gemm_dim), jnp.float32)
     flops = 2.0 * gemm_dim**3
     t = _probe(lambda x: x @ a / jnp.float32(gemm_dim), a, iters,
-               fallback=CPU_WEIGHT * flops, name="cpu")
+               fallback=cost_model.CPU_WEIGHT * flops, name="cpu")
     cpu_weight = t / flops
 
     # --- HBM: elementwise pass over a large buffer (read + write) -----
@@ -108,13 +108,13 @@ def calibrate_cost_weights(
     v = jnp.ones((n,), jnp.float32)
     hbm_bytes = 2.0 * 4.0 * n
     t = _probe(lambda x: x * 1.000001 + 1e-9, v, iters,
-               fallback=MEM_WEIGHT * hbm_bytes, name="mem")
+               fallback=cost_model.MEM_WEIGHT * hbm_bytes, name="mem")
     mem_weight = t / hbm_bytes
 
     # --- ICI: psum of a sharded buffer over the data axis -------------
     rows = meshlib.n_data_shards(mesh)
     if rows <= 1:
-        network_weight = NETWORK_WEIGHT
+        network_weight = cost_model.NETWORK_WEIGHT
     else:
         axis = meshlib.DATA_AXIS
         m = (4 << 20) // 4  # 4 MB per shard
@@ -141,7 +141,7 @@ def calibrate_cost_weights(
 
         ici_bytes = 4.0 * m * 2.0 * (rows - 1) / rows
         # ring all-reduce moves ~2·(p−1)/p of the buffer per chip
-        t = _probe(step, xs, iters, fallback=NETWORK_WEIGHT * ici_bytes,
+        t = _probe(step, xs, iters, fallback=cost_model.NETWORK_WEIGHT * ici_bytes,
                    name="network")
         network_weight = t / ici_bytes
 
@@ -149,4 +149,5 @@ def calibrate_cost_weights(
 
 
 def default_weights() -> CostWeights:
-    return CostWeights(CPU_WEIGHT, MEM_WEIGHT, NETWORK_WEIGHT)
+    return CostWeights(cost_model.CPU_WEIGHT, cost_model.MEM_WEIGHT,
+                       cost_model.NETWORK_WEIGHT)

@@ -42,32 +42,80 @@ ANALYTIC_MEM_WEIGHT = 1.0 / 8.0e11   # per HBM byte touched
 ANALYTIC_NETWORK_WEIGHT = 1.0 / 1.0e11  # per ICI all-reduced byte
 
 
-def _load_calibration():
+_ANALYTIC = (ANALYTIC_CPU_WEIGHT, ANALYTIC_MEM_WEIGHT, ANALYTIC_NETWORK_WEIGHT)
+_weights_cache = None
+
+
+def _resolve_weights():
     """Measured weights from tpu_calibration.json (committed with
     provenance; produced by calibrate.calibrate_cost_weights() on real
-    hardware). Falls back to the analytic defaults above."""
+    hardware), used only when its recorded platform matches the live JAX
+    backend — a v5e-measured file must not silently override the analytic
+    model on CPU dev boxes or other TPU generations.
+
+    KEYSTONE_COST_CALIBRATION=analytic ignores the file entirely;
+    KEYSTONE_COST_CALIBRATION=force applies it regardless of platform.
+    Resolution is lazy (first weight access) so importing the package
+    never initializes a JAX backend through a possibly-wedged tunnel.
+    """
+    global _weights_cache
     import json
+    import logging
     import os
 
+    mode = os.environ.get("KEYSTONE_COST_CALIBRATION", "")
+    if _weights_cache is not None and _weights_cache[0] == mode:
+        return _weights_cache[1]
+    if mode == "analytic":
+        _weights_cache = (mode, _ANALYTIC)
+        return _ANALYTIC
     path = os.path.join(os.path.dirname(__file__), "tpu_calibration.json")
+    log = logging.getLogger(__name__)
     try:
         with open(path) as f:
             cal = json.load(f)
-        return (
+        weights = (
             float(cal["cpu_weight"]),
             float(cal["mem_weight"]),
             float(cal["network_weight"]),
         )
-    except (OSError, KeyError, ValueError, TypeError):
-        return (
-            ANALYTIC_CPU_WEIGHT,
-            ANALYTIC_MEM_WEIGHT,
-            ANALYTIC_NETWORK_WEIGHT,
-        )
+        prov = cal.get("provenance")
+        cal_platform = prov.get("platform") if isinstance(prov, dict) else None
+    except FileNotFoundError:
+        _weights_cache = (mode, _ANALYTIC)
+        return _ANALYTIC
+    except (OSError, KeyError, ValueError, TypeError, AttributeError) as e:
+        log.warning(
+            "cost-model calibration file %s exists but failed to parse "
+            "(%s); falling back to analytic weights", path, e)
+        _weights_cache = (mode, _ANALYTIC)
+        return _ANALYTIC
+    if mode != "force":
+        try:
+            import jax
+
+            live = jax.default_backend()
+        except Exception:
+            live = None
+        if live != cal_platform:
+            log.info(
+                "cost-model calibration was measured on platform=%r but "
+                "backend is %r; using analytic weights "
+                "(KEYSTONE_COST_CALIBRATION=force to override)",
+                cal_platform, live)
+            _weights_cache = (mode, _ANALYTIC)
+            return _ANALYTIC
+    _weights_cache = (mode, weights)
+    return weights
 
 
-# seconds per unit; measured on the attached TPU when available
-CPU_WEIGHT, MEM_WEIGHT, NETWORK_WEIGHT = _load_calibration()
+def __getattr__(name):
+    # Lazy module attributes (PEP 562): CPU_WEIGHT / MEM_WEIGHT /
+    # NETWORK_WEIGHT resolve the calibration on first access.
+    idx = {"CPU_WEIGHT": 0, "MEM_WEIGHT": 1, "NETWORK_WEIGHT": 2}.get(name)
+    if idx is None:
+        raise AttributeError(name)
+    return _resolve_weights()[idx]
 
 
 class CostModel:
@@ -76,18 +124,33 @@ class CostModel:
     def cost(
         self,
         p: CostProfile,
-        cpu_weight: float = CPU_WEIGHT,
-        mem_weight: float = MEM_WEIGHT,
-        network_weight: float = NETWORK_WEIGHT,
+        cpu_weight: float = None,
+        mem_weight: float = None,
+        network_weight: float = None,
     ) -> float:
         raise NotImplementedError
+
+    @staticmethod
+    def _weights(cpu_weight, mem_weight, network_weight):
+        if None not in (cpu_weight, mem_weight, network_weight):
+            # all supplied: never touch calibration (which may init a
+            # JAX backend through a possibly-wedged tunnel)
+            return cpu_weight, mem_weight, network_weight
+        cw, mw, nw = _resolve_weights()
+        return (
+            cw if cpu_weight is None else cpu_weight,
+            mw if mem_weight is None else mem_weight,
+            nw if network_weight is None else network_weight,
+        )
 
 
 class ExactSolverCostModel(CostModel):
     """Normal equations: XᵀX flops n·d²/chips + d³ solve (replicated) +
     d² all-reduce (LinearMapper.scala cost model)."""
 
-    def cost(self, p, cpu_weight=CPU_WEIGHT, mem_weight=MEM_WEIGHT, network_weight=NETWORK_WEIGHT):
+    def cost(self, p, cpu_weight=None, mem_weight=None, network_weight=None):
+        cpu_weight, mem_weight, network_weight = self._weights(
+            cpu_weight, mem_weight, network_weight)
         flops = 2.0 * p.n * p.d * p.d / p.num_chips + 2.0 * p.d**3
         mem = 4.0 * (p.n * p.d / p.num_chips + p.d * p.d)
         net = 4.0 * p.d * p.d
@@ -102,7 +165,9 @@ class BlockSolverCostModel(CostModel):
         self.block_size = block_size
         self.num_iter = num_iter
 
-    def cost(self, p, cpu_weight=CPU_WEIGHT, mem_weight=MEM_WEIGHT, network_weight=NETWORK_WEIGHT):
+    def cost(self, p, cpu_weight=None, mem_weight=None, network_weight=None):
+        cpu_weight, mem_weight, network_weight = self._weights(
+            cpu_weight, mem_weight, network_weight)
         B = min(self.block_size, p.d)
         nb = -(-p.d // B)
         per_sweep_flops = nb * (
@@ -122,7 +187,9 @@ class LBFGSCostModel(CostModel):
         self.num_iters = num_iters
         self.sparse = sparse
 
-    def cost(self, p, cpu_weight=CPU_WEIGHT, mem_weight=MEM_WEIGHT, network_weight=NETWORK_WEIGHT):
+    def cost(self, p, cpu_weight=None, mem_weight=None, network_weight=None):
+        cpu_weight, mem_weight, network_weight = self._weights(
+            cpu_weight, mem_weight, network_weight)
         density = p.sparsity if self.sparse else 1.0
         flops = self.num_iters * 4.0 * p.n * p.d * p.k * density / p.num_chips
         mem = 4.0 * self.num_iters * (p.n * p.d * density / p.num_chips + p.d * p.k)

@@ -49,11 +49,11 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         self.num_iters = num_iters
         self.block_size = block_size
         self.num_chips = num_chips
-        from .cost_model import CPU_WEIGHT, MEM_WEIGHT, NETWORK_WEIGHT
+        from .cost_model import CostModel
 
-        self.cpu_weight = CPU_WEIGHT if cpu_weight is None else cpu_weight
-        self.mem_weight = MEM_WEIGHT if mem_weight is None else mem_weight
-        self.network_weight = NETWORK_WEIGHT if network_weight is None else network_weight
+        self.cpu_weight, self.mem_weight, self.network_weight = (
+            CostModel._weights(cpu_weight, mem_weight, network_weight)
+        )
 
     @classmethod
     def calibrated(

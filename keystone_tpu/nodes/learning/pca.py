@@ -203,20 +203,14 @@ class ApproximatePCAEstimator(Estimator):
 
 class LocalPCACostModel(CostModel):
     def cost(self, p, cpu_weight=None, mem_weight=None, network_weight=None):
-        from .cost_model import CPU_WEIGHT, MEM_WEIGHT, NETWORK_WEIGHT
-
-        cw = CPU_WEIGHT if cpu_weight is None else cpu_weight
-        nw = NETWORK_WEIGHT if network_weight is None else network_weight
+        cw, _, nw = self._weights(cpu_weight, mem_weight, network_weight)
         # collect everything to one replica + one SVD there
         return nw * 4.0 * p.n * p.d + cw * (2.0 * p.n * p.d * p.d)
 
 
 class DistributedPCACostModel(CostModel):
     def cost(self, p, cpu_weight=None, mem_weight=None, network_weight=None):
-        from .cost_model import CPU_WEIGHT, NETWORK_WEIGHT
-
-        cw = CPU_WEIGHT if cpu_weight is None else cpu_weight
-        nw = NETWORK_WEIGHT if network_weight is None else network_weight
+        cw, _, nw = self._weights(cpu_weight, mem_weight, network_weight)
         # per-shard QR + d×d R gather + small SVD
         return cw * (2.0 * p.n * p.d * p.d / p.num_chips + 2.0 * p.d**3) + nw * (
             4.0 * p.d * p.d * p.num_chips

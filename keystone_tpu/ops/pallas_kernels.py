@@ -311,6 +311,16 @@ def conv_rectify_pool(
             )
         except FusedConvIneligibleError:
             pass
+        except Exception as e:  # Mosaic lowering/trace failure on an
+            # unanticipated geometry: degrade to the XLA path rather
+            # than hard-fail the pipeline (compile-time failures inside
+            # an outer jit are out of reach of this trace-time guard,
+            # so the kernel also avoids partial lane-dim stores).
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused conv Pallas path failed (%s: %s); falling back "
+                "to XLA", type(e).__name__, e)
     return conv_rectify_pool_reference(
         images, kernel_hwio, colsum, bias, alpha, max_val, pool, stride,
         normalize,
@@ -350,10 +360,21 @@ def _conv_rect_pool_kernel(
         z = z - means * colsum_ref[:]
     out = z + bias_ref[:]
     pm = pmat_ref[:]
+    # HIGHEST: the rectified activations would otherwise be truncated to
+    # bf16 by the pool GEMM, a second rounding on top of the documented
+    # bf16 patch feed; the 0/1 pm operand is exact either way. One full-
+    # block store (no partial lane slice: k need not be a 128-multiple).
     pos = jnp.maximum(max_val, out - alpha)
-    o_ref[:, :k] = jnp.dot(pm, pos, preferred_element_type=jnp.float32)
     neg = jnp.maximum(max_val, -out - alpha)
-    o_ref[:, k:] = jnp.dot(pm, neg, preferred_element_type=jnp.float32)
+    o_ref[:] = jnp.concatenate(
+        [
+            jnp.dot(pm, pos, preferred_element_type=jnp.float32,
+                    precision=lax.Precision.HIGHEST),
+            jnp.dot(pm, neg, preferred_element_type=jnp.float32,
+                    precision=lax.Precision.HIGHEST),
+        ],
+        axis=1,
+    )
 
 
 def _fused_conv_block_images(posp: int, dp: int, k: int, cells: int) -> int:
@@ -365,9 +386,14 @@ def _fused_conv_block_images(posp: int, dp: int, k: int, cells: int) -> int:
     best = 0
     cand = b
     while cand <= 64:
+        # peak liveness: z stays live throughout, but pos is dead before
+        # neg materializes (each is consumed by its pool dot), so two
+        # (b·posp, k) f32 buffers, not three; the 10 MB cap of the 16 MB
+        # VMEM absorbs scheduling slop
         bytes_needed = (
             2 * cand * posp * dp * 2          # patches, double-buffered bf16
-            + 2 * cand * posp * k * 4         # z + one rectified sign
+            + 2 * cand * posp * k * 4         # z + one rectified sign (f32)
+            + 2 * cand * cells * 2 * k * 4    # pooled out, double-buffered
             + cand * cells * cand * posp * 4  # pool matrix
             + dp * k * 2
         )

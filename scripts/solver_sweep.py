@@ -65,10 +65,11 @@ def _fit_once(est, data, labels):
     eps = float(_PERTURB_RNG.random()) * 1e-6
     if hasattr(data, "map_batches"):
         data = data.map_batches(lambda x: x * (1.0 + eps))
-        import jax
-
-        jax.block_until_ready(data.array)  # perturbation pass must not
-        # land inside the timed fit window (dispatch is async)
+        # perturbation pass must not land inside the timed fit window
+        # (dispatch is async, and block_until_ready does not actually
+        # block through the axon tunnel — PERF.md methodology): fence
+        # with a tiny value transfer, same as the post-fit sync
+        np.asarray(data.array[:1, :1]).sum()
     elif hasattr(data, "matrix"):  # sparse: fresh values keep the
         # on-device Gram L-BFGS iterations out of the transport memo too
         m = data.matrix.copy()
