@@ -91,74 +91,107 @@ class LCSExtractor(_GridDescriptorExtractor):
 
 
 class HogExtractor(_GridDescriptorExtractor):
-    """Felzenszwalb/Girshick 31-dim HOG per cell
-    (HogExtractor.scala:33-296). Returns (cells_y·cells_x, 31)."""
+    """Felzenszwalb/Girshick 32-dim HOG per interior cell
+    (HogExtractor.scala:33-296, itself a translation of voc-dpm
+    features.cc). Returns ((cells_y−2)·(cells_x−2), 32): 18
+    contrast-sensitive + 9 contrast-insensitive + 4 texture + 1 zero
+    truncation feature.
+
+    Reference fidelity notes (all verified against a scalar-loop numpy
+    oracle implementing the Scala semantics):
+      - orientations are SNAPPED to the best of 18 contrast-sensitive
+        bins by max |dot| with 9 unit vectors (no orientation
+        interpolation), zero-gradient pixels land in bin 0;
+      - each pixel's magnitude is distributed over the 4 surrounding
+        cells by bilinear tent weights on (p+0.5)/cell − 0.5 — here
+        expressed as two separable tent-weight matmuls instead of the
+        reference's per-pixel scatter;
+      - features exist only for interior cells, normalized by the four
+        2×2 cell-energy blocks containing the cell (no edge padding);
+      - the reference's axis convention is x=row (xDim is the image
+        HEIGHT — Image.scala:139), so its `dx` is the vertical
+        derivative; per-pixel channel ties pick the highest channel
+        index (the reference scans channels 2→0 keeping strict maxima).
+    """
 
     def __init__(self, cell_size: int = 8):
         self.cell_size = cell_size
 
     def _fn(self):
         cs = self.cell_size
-        n_signed, n_unsigned = 18, 9
         eps = 1e-4
+        # 9 unit vectors at 0°,20°,…,160° (HogExtractor.scala uu/vv)
+        theta = np.arange(9) * np.pi / 9
+        uu = jnp.asarray(np.cos(theta), jnp.float32)
+        vv = jnp.asarray(np.sin(theta), jnp.float32)
 
         def fn(img):  # (H, W, C)
-            dy = jnp.zeros(img.shape).at[1:-1].set((img[2:] - img[:-2]) * 0.5)
-            dx = jnp.zeros(img.shape).at[:, 1:-1].set(
-                (img[:, 2:] - img[:, :-2]) * 0.5
-            )
-            mag2 = dx * dx + dy * dy
-            # pick the channel with the largest gradient per pixel
-            cidx = jnp.argmax(mag2, axis=-1)
+            h, w, c = img.shape
+            cells_r = int(np.floor(h / cs + 0.5))  # round-half-up
+            cells_c = int(np.floor(w / cs + 0.5))
+            vis_r, vis_c = min(cells_r * cs, h), min(cells_c * cs, w)
+            gv = jnp.zeros(img.shape).at[1:-1].set(img[2:] - img[:-2])
+            gh = jnp.zeros(img.shape).at[:, 1:-1].set(img[:, 2:] - img[:, :-2])
+            mag2 = gv * gv + gh * gh
+            # channel with the largest gradient; ties → highest index
+            cidx = (c - 1) - jnp.argmax(mag2[..., ::-1], axis=-1)
             take = lambda a: jnp.take_along_axis(a, cidx[..., None], axis=-1)[..., 0]
-            gx, gy = take(dx), take(dy)
+            gvb, ghb = take(gv), take(gh)
             mag = jnp.sqrt(take(mag2))
-            ang = jnp.arctan2(gy, gx)  # [-pi, pi] signed
-            t = jnp.mod(ang / (2 * jnp.pi) * n_signed, n_signed)
-            lo = jnp.floor(t)
-            frac = t - lo
-            lo = lo.astype(jnp.int32) % n_signed
-            hi = (lo + 1) % n_signed
-            omaps = (
-                jax.nn.one_hot(lo, n_signed) * (mag * (1 - frac))[..., None]
-                + jax.nn.one_hot(hi, n_signed) * (mag * frac)[..., None]
-            )  # (H, W, 18)
-            # cell aggregation: box conv + stride (bilinear omitted: flat cells)
-            box = jnp.ones((cs,), jnp.float32)
-            agg = depthwise_conv2d(omaps, box, box)
-            off = cs // 2
-            cells = agg[off::cs, off::cs, :]  # (cy, cx, 18)
-            cy, cx = cells.shape[0], cells.shape[1]
-            unsigned = cells[..., :n_unsigned] + cells[..., n_unsigned:]
-            # block energy: 2x2 neighborhoods of cells
-            energy = jnp.sum(unsigned**2, axis=-1)
-            epad = jnp.pad(energy, 1, mode="edge")
-            feats = []
-            for oy in (0, 1):
-                for ox in (0, 1):
-                    blk = (
-                        epad[oy : oy + cy, ox : ox + cx]
-                        + epad[oy + 1 : oy + 1 + cy, ox : ox + cx]
-                        + epad[oy : oy + cy, ox + 1 : ox + 1 + cx]
-                        + epad[oy + 1 : oy + 1 + cy, ox + 1 : ox + 1 + cx]
-                    )
-                    inv = 1.0 / jnp.sqrt(blk + eps)[..., None]
-                    feats.append(jnp.minimum(cells * inv, 0.2))
-            f_signed = sum(feats) * 0.5  # (cy, cx, 18)
-            f_unsigned = sum(
-                jnp.minimum(unsigned * (1.0 / jnp.sqrt(
-                    (epad[oy:oy+cy, ox:ox+cx] + epad[oy+1:oy+1+cy, ox:ox+cx]
-                     + epad[oy:oy+cy, ox+1:ox+1+cx] + epad[oy+1:oy+1+cy, ox+1:ox+1+cx])
-                    + eps))[..., None], 0.2)
-                for oy in (0, 1) for ox in (0, 1)
-            ) * 0.5  # (cy, cx, 9)
-            # 4 gradient-energy features
-            g_feats = jnp.stack(
-                [jnp.sum(jnp.minimum(f, 0.2), axis=-1) * 0.2357 for f in feats],
-                axis=-1,
-            )  # (cy, cx, 4)
-            out = jnp.concatenate([f_signed, f_unsigned, g_feats], axis=-1)  # 31
-            return out.reshape(cy * cx, 31)
+            # visible interior pixels only (1 ≤ p ≤ cells·cs − 2)
+            rmask = (jnp.arange(h) >= 1) & (jnp.arange(h) <= vis_r - 2)
+            cmask = (jnp.arange(w) >= 1) & (jnp.arange(w) <= vis_c - 2)
+            mag = mag * (rmask[:, None] & cmask[None, :])
+            # snap to the best of 18 orientations; the interleaved
+            # (+o, −o) order reproduces the reference's strict-> scan
+            # tie-breaking under argmax's first-max-wins
+            dots = ghb[..., None] * uu + gvb[..., None] * vv  # (H, W, 9)
+            inter = jnp.stack([dots, -dots], axis=-1).reshape(h, w, 18)
+            j = jnp.argmax(inter, axis=-1)
+            b = (j // 2) + 9 * (j % 2)
+            omaps = jax.nn.one_hot(b, 18) * mag[..., None]  # (H, W, 18)
+            # bilinear spatial binning as separable tent-weight matmuls
+            rp = (jnp.arange(h, dtype=jnp.float32) + 0.5) / cs - 0.5
+            cp = (jnp.arange(w, dtype=jnp.float32) + 0.5) / cs - 0.5
+            wr = jnp.maximum(
+                0.0, 1.0 - jnp.abs(rp[None, :] - jnp.arange(cells_r)[:, None])
+            )  # (cells_r, H)
+            wc = jnp.maximum(
+                0.0, 1.0 - jnp.abs(cp[None, :] - jnp.arange(cells_c)[:, None])
+            )  # (cells_c, W)
+            hist = jnp.einsum(
+                "yr,rco,xc->yxo", wr, omaps, wc, precision="highest"
+            )  # (cells_r, cells_c, 18)
+            energy = jnp.sum(
+                (hist[..., :9] + hist[..., 9:]) ** 2, axis=-1
+            )  # (cells_r, cells_c)
+            fr, fc = cells_r - 2, cells_c - 2
+            if fr <= 0 or fc <= 0:
+                return jnp.zeros((0, 32), jnp.float32)
+            # 2×2 block energies; feature cell (r,c) ↔ hist cell (r+1,c+1)
+            e2 = (energy[:-1, :-1] + energy[1:, :-1]
+                  + energy[:-1, 1:] + energy[1:, 1:])
+            inv = lambda a: 1.0 / jnp.sqrt(a + eps)
+            ns = [  # reference n1..n4 block order
+                inv(e2[1 : 1 + fr, 1 : 1 + fc]),
+                inv(e2[0:fr, 1 : 1 + fc]),
+                inv(e2[1 : 1 + fr, 0:fc]),
+                inv(e2[0:fr, 0:fc]),
+            ]
+            hc = hist[1 : 1 + fr, 1 : 1 + fc, :]  # (fr, fc, 18)
+            clipped = [jnp.minimum(hc * n[..., None], 0.2) for n in ns]
+            f_sens = 0.5 * sum(clipped)  # (fr, fc, 18)
+            hsum = hc[..., :9] + hc[..., 9:]
+            f_insens = 0.5 * sum(
+                jnp.minimum(hsum * n[..., None], 0.2) for n in ns
+            )  # (fr, fc, 9)
+            f_tex = 0.2357 * jnp.stack(
+                [jnp.sum(cl, axis=-1) for cl in clipped], axis=-1
+            )  # (fr, fc, 4)
+            out = jnp.concatenate(
+                [f_sens, f_insens, f_tex, jnp.zeros((fr, fc, 1))], axis=-1
+            )
+            return out.reshape(fr * fc, 32)
 
         return fn
 

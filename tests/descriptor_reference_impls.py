@@ -101,52 +101,91 @@ def dense_sift_one_scale(gray, bin_size: int, step: int, sigma: float):
 
 
 def hog(img, cell_size: int):
-    """Reference for descriptors.HogExtractor: (cy*cx, 31)."""
+    """Scalar-loop oracle for descriptors.HogExtractor, implementing the
+    REFERENCE semantics (HogExtractor.scala:33-296 / voc-dpm
+    features.cc), not the jax formulation: per-pixel 18-orientation
+    snapping by max |dot| against 9 unit vectors, bilinear tent binning
+    of each pixel's magnitude into the 4 surrounding cells, interior
+    cells only, 32 features (18 sensitive + 9 insensitive + 4 texture +
+    trailing 0). Axis convention: the reference's x is the ROW index
+    (Image.scala:139 — xDim is the height), so its dx is the vertical
+    derivative. Returns ((cells_r-2)*(cells_c-2), 32)."""
     img = np.asarray(img, np.float64)
     cs = cell_size
-    dy = np.zeros_like(img)
-    dx = np.zeros_like(img)
-    dy[1:-1] = (img[2:] - img[:-2]) * 0.5
-    dx[:, 1:-1] = (img[:, 2:] - img[:, :-2]) * 0.5
-    mag2 = dx * dx + dy * dy
-    cidx = np.argmax(mag2, axis=-1)
-    yy, xx = np.indices(cidx.shape)
-    gx, gy = dx[yy, xx, cidx], dy[yy, xx, cidx]
-    mag = np.sqrt(mag2[yy, xx, cidx])
-    ang = np.arctan2(gy, gx)
-    omaps = orientation_maps(mag, ang, 18)
-    agg = sep_filter(omaps, np.ones(cs))
-    off = cs // 2
-    cells = agg[off::cs, off::cs, :]
-    cy, cx = cells.shape[:2]
-    unsigned = cells[..., :9] + cells[..., 9:]
-    energy = np.sum(unsigned**2, axis=-1)
-    epad = np.pad(energy, 1, mode="edge")
+    h, w, c = img.shape
+    cells_r = int(np.floor(h / cs + 0.5))
+    cells_c = int(np.floor(w / cs + 0.5))
+    vis_r, vis_c = min(cells_r * cs, h), min(cells_c * cs, w)
+    uu = np.cos(np.arange(9) * np.pi / 9)
+    vv = np.sin(np.arange(9) * np.pi / 9)
+    hist = np.zeros((cells_r, cells_c, 18))
+    for r in range(1, vis_r - 1):
+        for col in range(1, vis_c - 1):
+            # highest-gradient channel, scanning c-1..0 with strict >
+            best_m2 = -np.inf
+            gv = gh = 0.0
+            for ch in range(c - 1, -1, -1):
+                dv = img[r + 1, col, ch] - img[r - 1, col, ch]
+                dh = img[r, col + 1, ch] - img[r, col - 1, ch]
+                m2 = dv * dv + dh * dh
+                if m2 > best_m2:
+                    best_m2, gv, gh = m2, dv, dh
+            mag = np.sqrt(best_m2)
+            # snap to one of 18 orientations (strict >, init 0.0)
+            best_dot, best_o = 0.0, 0
+            for o in range(9):
+                dot = uu[o] * gh + vv[o] * gv
+                if dot > best_dot:
+                    best_dot, best_o = dot, o
+                elif -dot > best_dot:
+                    best_dot, best_o = -dot, o + 9
+            # bilinear tent binning into the 4 surrounding cells
+            rp = (r + 0.5) / cs - 0.5
+            cp = (col + 0.5) / cs - 0.5
+            irp, icp = int(np.floor(rp)), int(np.floor(cp))
+            vr0, vc0 = rp - irp, cp - icp
+            vr1, vc1 = 1.0 - vr0, 1.0 - vc0
+            if irp >= 0 and icp >= 0:
+                hist[irp, icp, best_o] += vr1 * vc1 * mag
+            if irp + 1 < cells_r and icp >= 0:
+                hist[irp + 1, icp, best_o] += vr0 * vc1 * mag
+            if irp >= 0 and icp + 1 < cells_c:
+                hist[irp, icp + 1, best_o] += vr1 * vc0 * mag
+            if irp + 1 < cells_r and icp + 1 < cells_c:
+                hist[irp + 1, icp + 1, best_o] += vr0 * vc0 * mag
+    energy = np.zeros((cells_r, cells_c))
+    for o in range(9):
+        energy += (hist[:, :, o] + hist[:, :, o + 9]) ** 2
     eps = 1e-4
-    feats = []
-    for oy in (0, 1):
-        for ox in (0, 1):
-            blk = (
-                epad[oy : oy + cy, ox : ox + cx]
-                + epad[oy + 1 : oy + 1 + cy, ox : ox + cx]
-                + epad[oy : oy + cy, ox + 1 : ox + 1 + cx]
-                + epad[oy + 1 : oy + 1 + cy, ox + 1 : ox + 1 + cx]
-            )
-            feats.append((blk, 1.0 / np.sqrt(blk + eps)))
-    f_signed = sum(np.minimum(cells * inv[..., None], 0.2) for _, inv in feats) * 0.5
-    f_unsigned = (
-        sum(np.minimum(unsigned * inv[..., None], 0.2) for _, inv in feats) * 0.5
-    )
-    g_feats = np.stack(
-        [
-            np.sum(np.minimum(np.minimum(cells * inv[..., None], 0.2), 0.2), axis=-1)
-            * 0.2357
-            for _, inv in feats
-        ],
-        axis=-1,
-    )
-    out = np.concatenate([f_signed, f_unsigned, g_feats], axis=-1)
-    return out.reshape(cy * cx, 31)
+    fr, fc = max(cells_r - 2, 0), max(cells_c - 2, 0)
+    out = np.zeros((fr * fc, 32))
+    for r in range(fr):
+        for col in range(fc):
+            row = r * fc + col
+            hc = hist[r + 1, col + 1, :]
+            # four 2x2 cell-energy blocks containing cell (r+1, col+1),
+            # in the reference's n1..n4 order
+            ns = []
+            for dr, dc in ((1, 1), (0, 1), (1, 0), (0, 0)):
+                blk = (energy[r + dr, col + dc] + energy[r + dr + 1, col + dc]
+                       + energy[r + dr, col + dc + 1]
+                       + energy[r + dr + 1, col + dc + 1])
+                ns.append(1.0 / np.sqrt(blk + eps))
+            ts = [0.0, 0.0, 0.0, 0.0]
+            for o in range(18):
+                acc = 0.0
+                for i, n in enumerate(ns):
+                    hv = min(hc[o] * n, 0.2)
+                    acc += hv
+                    ts[i] += hv
+                out[row, o] = 0.5 * acc
+            for o in range(9):
+                s = hc[o] + hc[o + 9]
+                out[row, 18 + o] = 0.5 * sum(min(s * n, 0.2) for n in ns)
+            for i in range(4):
+                out[row, 27 + i] = 0.2357 * ts[i]
+            # out[row, 31] stays 0 (truncation feature)
+    return out
 
 
 def daisy(gray, stride: int, radius: int, rings: int, ring_points: int,

@@ -62,12 +62,15 @@ def test_hog_shapes():
     rng = np.random.default_rng(6)
     img = rng.uniform(0, 1, size=(64, 64, 3)).astype(np.float32)
     out = np.asarray(HogExtractor(cell_size=8).apply(img))
-    assert out.shape == (8 * 8, 31)
+    # 8x8 cells -> 6x6 interior feature cells, 32 features each
+    assert out.shape == (6 * 6, 32)
     assert np.isfinite(out).all()
     # orientation features bounded by 0.4 (0.5·Σ of four ≤0.2 norms);
     # the 4 texture-energy features can reach ~0.85
     assert out[:, :27].max() <= 0.4 + 1e-5
     assert out.max() <= 1.0
+    # truncation feature is identically zero
+    assert np.all(out[:, 31] == 0.0)
 
 
 def test_daisy_shapes_and_norm():
@@ -90,14 +93,14 @@ def test_hog_orientation_selectivity():
     img = np.tile(np.sin(x * np.pi / 4)[None, :, None], (64, 1, 3)) * 0.5 + 0.5
     out = np.asarray(HogExtractor(cell_size=8).apply(img))
     # contrast-insensitive block (features 18..27): one dominant bin
-    interior = out.reshape(8, 8, 31)[2:6, 2:6].reshape(-1, 31)
+    interior = out.reshape(6, 6, 32)[1:5, 1:5].reshape(-1, 32)
     ci = interior[:, 18:27]
     dominant = ci.max(axis=1)
     total = ci.sum(axis=1)
     assert np.all(dominant / np.maximum(total, 1e-8) > 0.45)
     # rotating the image 90 deg moves the energy to a different bin
     out_r = np.asarray(HogExtractor(cell_size=8).apply(img.transpose(1, 0, 2)))
-    ci_r = out_r.reshape(8, 8, 31)[2:6, 2:6].reshape(-1, 31)[:, 18:27]
+    ci_r = out_r.reshape(6, 6, 32)[1:5, 1:5].reshape(-1, 32)[:, 18:27]
     assert not np.allclose(ci.mean(axis=0).argmax(), ci_r.mean(axis=0).argmax())
 
 
