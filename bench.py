@@ -73,7 +73,12 @@ def run_child(args, timeout_s: float):
         sys.executable, "-u", os.path.abspath(__file__), "--child",
         "--n-train", str(args.n_train), "--n-test", str(args.n_test),
         "--num-filters", str(args.num_filters),
+        "--flagship-n", str(args.flagship_n),
+        "--flagship-d", str(args.flagship_d),
+        "--flagship-k", str(args.flagship_k),
     ]
+    if args.skip_flagship:
+        cmd += ["--skip-flagship"]
     if args.train_path:
         cmd += ["--train-path", args.train_path]
     if args.test_path:
@@ -149,6 +154,10 @@ def main():
     p.add_argument("--n-train", type=int, default=50_000)
     p.add_argument("--n-test", type=int, default=10_000)
     p.add_argument("--num-filters", type=int, default=256)
+    p.add_argument("--flagship-n", type=int, default=120_000)
+    p.add_argument("--flagship-d", type=int, default=8192)
+    p.add_argument("--flagship-k", type=int, default=138)
+    p.add_argument("--skip-flagship", action="store_true")
     p.add_argument("--liveness-timeout", type=float, default=90.0)
     p.add_argument("--run-timeout", type=float, default=1500.0)
     p.add_argument("--retry-wait", type=float, default=120.0)
@@ -180,6 +189,15 @@ def main():
         detail, phases = run_child(args, min(args.run_timeout, remaining))
         if detail is not None:
             rec = result_record(detail)
+            if not detail.get("accuracy_in_band", True):
+                # solver-quality regression: accuracy left the calibrated
+                # band. Emit the measurement loudly marked as failing and
+                # do NOT let it become the stale-fallback record.
+                rec["error"] = (
+                    f"test_accuracy {detail.get('test_accuracy')} outside "
+                    f"calibrated band {detail.get('accuracy_band')}")
+                emit(rec)
+                return 0
             if detail.get("platform") != "cpu":  # only real-device runs
                 # qualify as the stale-fallback record
                 try:
@@ -223,6 +241,85 @@ def phase(name, **kw):
     print("BENCH_PHASE " + json.dumps({"phase": name, **kw}), flush=True)
 
 
+# Calibrated synthetic-task difficulty (see loaders.cifar_loader.
+# synthetic_cifar): class templates partially mixed toward confusers +
+# heavy pixel noise place the best attainable accuracy in a nontrivial
+# band, so solver-quality regressions (centering, BCD convergence,
+# precision) FAIL the bench instead of hiding behind a separable task.
+# Calibration (CPU mesh, 2026-07): noise=1.2/confusion=0.6 → test acc
+# 0.745-0.797 at n=2-3k, rising with n; chance = 0.10.
+BENCH_NOISE = 1.2
+BENCH_CONFUSION = 0.6
+ACC_BAND = (0.72, 0.96)
+
+V5E_PEAK_FLOPS = 1.97e14  # bf16 MXU
+V5E_PEAK_BW = 8.19e11     # HBM bytes/s
+
+
+def _roofline(flops, bytes_, seconds):
+    return {
+        "gflops": round(flops / 1e9, 1),
+        "gbytes": round(bytes_ / 1e9, 2),
+        "attained_tflops": round(flops / seconds / 1e12, 2),
+        "attained_gbs": round(bytes_ / seconds / 1e9, 1),
+        "pct_peak_flops": round(100 * flops / seconds / V5E_PEAK_FLOPS, 1),
+        "pct_peak_bw": round(100 * bytes_ / seconds / V5E_PEAK_BW, 1),
+        "seconds": round(seconds, 4),
+    }
+
+
+def _flagship_bcd(n, d, k, block, iters):
+    """Reference-scale solver metric (VERDICT r2 #6): multi-block,
+    multi-iter BCD at d≥8192 exercising the block loop + tp sharding at
+    scale. Mirrors the TIMIT-shaped row of the reference's solver sweep
+    (scripts/solver-comparisons-final.csv; BASELINE.md: TIMIT Block
+    d=8192 = 580 555 ms on 16x r3.4xlarge at n=2.2e6)."""
+    import jax
+    import numpy as np
+
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+
+    rng = np.random.default_rng(0)
+    # standard_normal(float32) and random labels: the solve's arithmetic
+    # profile is label-independent, and a host-side X@W_true at this
+    # scale (271 GFLOP single-threaded) would dominate the bench's
+    # wall-clock budget
+    X = rng.standard_normal(size=(n, d), dtype=np.float32)
+    Y = rng.standard_normal(size=(n, k), dtype=np.float32)
+    data, labels = Dataset(X), Dataset(Y)
+    del X, Y
+    est = BlockLeastSquaresEstimator(block_size=block, num_iter=iters, lam=1e-2)
+
+    def fit_once():
+        # fresh values defeat the axon transport's byte-identical-program
+        # memo; the scalar pull fences the perturbation out of the timed
+        # window and the post-fit pull is the true sync
+        eps = float(rng.random()) * 1e-6
+        d2 = data.map_batches(lambda x: x * (1.0 + eps)).sync()
+        t0 = time.perf_counter()
+        model = est.fit(d2, labels)
+        np.asarray(model.W[:1, :1])  # raw array: scalar pull is the sync
+        return time.perf_counter() - t0
+
+    fit_once()  # warm/compile
+    secs = fit_once()
+    nb = -(-d // block)
+    flops = iters * nb * (2.0 * n * block * (block + 2 * k) + (2 / 3) * block**3)
+    bytes_ = iters * nb * 4.0 * n * (block + k)
+    ref_ms = 580_555.0  # TIMIT Block d=8192 (csv:25), n=2.2e6
+    n_scale = n / 2_200_000.0
+    return {
+        "n": n, "d": d, "k": k, "block_size": block, "num_iter": iters,
+        "fit_seconds": round(secs, 3),
+        "scaled_fit_seconds_at_ref_n": round(secs / n_scale, 2),
+        "reference_ms_16xr3.4xlarge": ref_ms,
+        "speedup_vs_reference_n_scaled": round(
+            ref_ms / 1e3 / (secs / n_scale), 1),
+        "roofline": _roofline(flops, bytes_, secs),
+    }
+
+
 def child_main(args):
     """The measured workload. Runs in a killable subprocess; prints phase
     markers and finally one BENCH_DETAIL line."""
@@ -236,6 +333,7 @@ def child_main(args):
     from keystone_tpu.pipelines.random_patch_cifar import (
         RandomPatchCifarConfig,
         build_pipeline,
+        run_staged,
     )
     from keystone_tpu.loaders.cifar_loader import cifar_loader, synthetic_cifar
     from keystone_tpu.evaluation import MulticlassClassifierEvaluator
@@ -251,20 +349,27 @@ def child_main(args):
         test = cifar_loader(args.test_path or args.train_path)
         synthetic = False
     else:
-        train, test = synthetic_cifar(args.n_train, args.n_test)
+        train, test = synthetic_cifar(
+            args.n_train, args.n_test,
+            noise=BENCH_NOISE, confusion=BENCH_CONFUSION,
+        )
         synthetic = True
     phase("data", n_train=train.data.count, n_test=test.data.count,
           synthetic=synthetic)
 
-    # Warm-up at the SAME shapes (jit caches are shape-keyed): run the
-    # full workload once untimed so the measured run reflects steady-state
-    # TPU throughput, not compile time. This also places the training
-    # arrays on device once; the timed run reuses them.
+    # Warm-up at the SAME shapes (jit caches are shape-keyed, and the
+    # fused-program cache is global/structural): one untimed staged pass
+    # + one untimed pipeline pass compile every program both timed paths
+    # use, so the measurements reflect steady-state TPU throughput.
     evaluator = MulticlassClassifierEvaluator(config.num_classes)
+    run_staged(train, config, evaluator)
+    PipelineEnv.reset()
     warm_pipe = build_pipeline(train, config)
     evaluator(warm_pipe(train.data), train.labels)
     phase("warm_done")
 
+    # Headline: the real pipeline path end-to-end, async dispatch free to
+    # overlap stages (what a user's run costs).
     PipelineEnv.reset()
     t0 = time.perf_counter()
     predictor = build_pipeline(train, config)
@@ -273,35 +378,74 @@ def child_main(args):
     phase("timed_done", seconds=round(elapsed, 3))
     test_metrics = evaluator(predictor(test.data), test.labels)
 
-    # Analytic FLOPs of the dominant programs (featurize conv + BCD
-    # solve), for a derived MFU against the v5e bf16 peak (197 TFLOP/s).
+    # Stage breakdown: same components, scalar-pull sync after each
+    # stage, so the stages SUM to the staged end-to-end by construction
+    # (VERDICT r2 #1/#4 — no unaccounted time).
+    PipelineEnv.reset()
+    stages, staged_metrics, _ = run_staged(train, config, evaluator)
+    staged_total = sum(stages.values())
+    phase("staged_done", seconds=round(staged_total, 3))
+
+    # Per-stage roofline vs v5e peaks (featurize/solve dominate; the
+    # fused conv kernel's HBM traffic is patches bf16 write+read +
+    # images read + pooled write).
     n = train.data.count
     F, p = config.num_filters, config.patch_size
-    pos = (32 - p + 1) ** 2  # valid conv positions
-    conv_flops = 2.0 * n * pos * (p * p * 3) * (F + 1)  # filters + mean conv
-    d = 8 * F  # 2x2 pool grid x two-sided rectifier channels
+    pos = (32 - p + 1) ** 2
+    d_patch = p * p * 3
+    posp, dp = -(-pos // 8) * 8, -(-d_patch // 128) * 128
+    d = 8 * F
     k = config.num_classes
     B = min(config.block_size, d)
-    # BCD sweep: per-block Gram (2nB^2 x d/B blocks) + correlation and
-    # two residual GEMMs, which scale with k, not the block width
-    solve_flops = 2.0 * n * d * B + 6.0 * n * d * k
+    conv_flops = 2.0 * n * pos * d_patch * (F + 1)
+    conv_bytes = n * (2.0 * posp * dp * 2 + 32 * 32 * 3 * 4 + 8 * F * 4)
+    scaler_bytes = 3.0 * n * d * 4
+    solve_flops = 2.0 * n * d * B + (2.0 / 3.0) * B**3 + 6.0 * n * d * k
+    solve_bytes = 3.0 * n * d * 4
+    pred_flops = 2.0 * n * d * k
+    rooflines = {
+        "featurize": _roofline(conv_flops, conv_bytes, stages["featurize"]),
+        "scaler": _roofline(n * d * 4.0, scaler_bytes, stages["scaler"]),
+        "bcd_solve": _roofline(solve_flops, solve_bytes, stages["bcd_solve"]),
+        "predict_eval": _roofline(pred_flops, n * d * 4.0,
+                                  stages["predict_eval"]),
+    }
+
+    acc = test_metrics.accuracy
+    in_band = (not synthetic) or (ACC_BAND[0] <= acc <= ACC_BAND[1])
+    flagship = None
+    if not args.skip_flagship:
+        phase("flagship_solver")
+        flagship = _flagship_bcd(
+            n=args.flagship_n, d=args.flagship_d, k=args.flagship_k,
+            block=4096, iters=3,
+        )
+        phase("flagship_done", seconds=flagship["fit_seconds"])
+
     total_flops = conv_flops + solve_flops
-    V5E_PEAK = 1.97e14
     detail = {
         "n_train": train.data.count,
         "train_seconds": round(elapsed, 3),
         "images_per_sec": round(train.data.count / elapsed, 2),
         "train_error": round(train_metrics.error, 4),
-        "test_accuracy": round(test_metrics.accuracy, 4),
+        "test_accuracy": round(acc, 4),
+        "accuracy_band": list(ACC_BAND),
+        "accuracy_in_band": in_band,
+        "task_difficulty": {"noise": BENCH_NOISE, "confusion": BENCH_CONFUSION},
         "num_filters": config.num_filters,
+        "stages_seconds": {kk: round(vv, 4) for kk, vv in stages.items()},
+        "stages_sum_seconds": round(staged_total, 3),
+        "rooflines": rooflines,
+        "flagship_bcd_d8192": flagship,
         "analytic_tflops": round(total_flops / 1e12, 2),
-        "mfu_vs_v5e_peak": round(total_flops / elapsed / V5E_PEAK, 4),
+        "mfu_vs_v5e_peak": round(total_flops / elapsed / V5E_PEAK_FLOPS, 4),
         "synthetic": synthetic,
         "platform": jax.devices()[0].platform,
         "data_note": (None if not synthetic else
                       "real CIFAR-10 binaries are not obtainable in this "
                       "zero-egress environment; synthetic learnable task at "
-                      "identical shapes/scale (see BENCH notes in README)"),
+                      "identical shapes/scale with CALIBRATED difficulty "
+                      "(see BENCH notes in README)"),
     }
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
     return 0

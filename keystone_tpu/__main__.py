@@ -21,6 +21,7 @@ REGISTRY = {
     "pipelines.images.cifar.RandomCifar": ("keystone_tpu.pipelines.cli_mains", "random_cifar_main"),
     "pipelines.images.cifar.RandomPatchCifarKernel": ("keystone_tpu.pipelines.cli_mains", "cifar_kernel_main"),
     "pipelines.images.cifar.RandomPatchCifarAugmented": ("keystone_tpu.pipelines.cli_mains", "cifar_augmented_main"),
+    "pipelines.images.cifar.RandomPatchCifarAugmentedKernel": ("keystone_tpu.pipelines.cli_mains", "cifar_augmented_kernel_main"),
     "pipelines.images.voc.VOCSIFTFisher": ("keystone_tpu.pipelines.voc_sift_fisher", "main"),
     "pipelines.images.imagenet.ImageNetSiftLcsFV": ("keystone_tpu.pipelines.imagenet_sift_lcs_fv", "main"),
     "pipelines.speech.TimitPipeline": ("keystone_tpu.pipelines.timit", "main"),

@@ -148,9 +148,23 @@ class Dataset:
                        mesh=self.mesh, _placed=True)
 
     def cache(self) -> "Dataset":
-        """Device arrays are already materialized; block until compute
-        finishes so downstream timing is honest (≈ `.cache()` + action)."""
+        """Device arrays are already materialized (≈ `.cache()` + action).
+        NOT a timing fence — production Cacher nodes call this on every
+        run, and a host round trip here would defeat async dispatch
+        overlap at every cache boundary; timing paths (autocache
+        profiling, calibration) must use `sync()` instead."""
         jax.block_until_ready(self.data)
+        return self
+
+    def sync(self) -> "Dataset":
+        """TRUE host sync: transfer one element per leaf.
+        `jax.block_until_ready` does not actually block through the axon
+        tunnel (see PERF.md methodology), so honest wall-clock timing —
+        autocache profiling, calibration — must force a value transfer;
+        a single-element device slice keeps the transfer tiny."""
+        for leaf in jax.tree_util.tree_leaves(self.data):
+            if hasattr(leaf, "ndim") and hasattr(leaf, "dtype"):
+                np.asarray(leaf[(0,) * leaf.ndim])
         return self
 
     def spread_take(self, m: int):

@@ -45,10 +45,21 @@ def synthetic_cifar(
     num_classes: int = 10,
     seed: int = 0,
     mesh=None,
+    noise: float = 0.6,
+    confusion: float = 0.0,
 ) -> Tuple[LabeledData, LabeledData]:
     """A learnable CIFAR-shaped task: each class is a smooth random
     template warped by random shifts + noise. Pipelines that work on real
-    CIFAR separate these classes; broken featurization drops to chance."""
+    CIFAR separate these classes; broken featurization drops to chance.
+
+    `noise` scales the per-pixel Gaussian noise; `confusion` > 0 mixes
+    each sample's template toward a random OTHER class's template by a
+    per-sample weight ~ Uniform(0, confusion), creating genuinely
+    ambiguous examples (irreducible class overlap). Together they place
+    the best attainable accuracy in a nontrivial, calibratable band —
+    the bench asserts that band so solver-quality regressions (broken
+    centering, BCD convergence, precision) fail loudly instead of
+    hiding behind a trivially separable task."""
     rng = np.random.default_rng(seed)
     # smooth class templates (low-frequency patterns)
     freqs = rng.normal(size=(num_classes, 4, 2))
@@ -71,11 +82,17 @@ def synthetic_cifar(
         r = np.random.default_rng(seed2)
         labels = r.integers(0, num_classes, size=n).astype(np.int32)
         images = templates[labels].copy()
+        if confusion > 0.0:
+            other = (labels + r.integers(1, num_classes, size=n)) % num_classes
+            mix = r.uniform(0.0, confusion, size=n).astype(np.float32)
+            images = (1.0 - mix[:, None, None, None]) * images + mix[
+                :, None, None, None
+            ] * templates[other]
         # random circular shifts + noise
         for i in range(n):
             sy, sx = r.integers(-4, 5, size=2)
             images[i] = np.roll(images[i], (sy, sx), axis=(0, 1))
-        images += 0.6 * r.normal(size=images.shape).astype(np.float32)
+        images += noise * r.normal(size=images.shape).astype(np.float32)
         images = (images - images.min()) / (images.max() - images.min()) * 255.0
         return LabeledData(
             labels=Dataset(labels, mesh=mesh),

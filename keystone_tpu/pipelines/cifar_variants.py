@@ -8,6 +8,12 @@
 - RandomPatchCifarAugmented (RandomPatchCifarAugmented.scala): random
   patch + flip augmentation at train, center/corner patches at test,
   AugmentedExamplesEvaluator.
+- RandomPatchCifarAugmentedKernel
+  (RandomPatchCifarAugmentedKernel.scala:1-190): the augmented
+  featurization with random horizontal flips and a shuffle at train,
+  KernelRidgeRegression as the solver (with `--checkpoint-dir` block-loop
+  checkpointing, :176), center/corner/flip crops + score averaging at
+  test.
 """
 
 from __future__ import annotations
@@ -227,6 +233,95 @@ def run_random_patch_cifar_augmented(config: RandomPatchCifarAugmentedConfig):
     ids = np.repeat(np.arange(test.data.count), n_aug)
     actuals = np.repeat(np.asarray(test.labels.numpy()), n_aug)
     scores = scorer(aug_test).get()
+    m = AugmentedExamplesEvaluator(config.num_classes)(ids, scores, actuals)
+    return {
+        "test_error": m.error,
+        "test_accuracy": m.accuracy,
+        "seconds": time.perf_counter() - t0,
+    }
+
+
+@dataclass
+class RandomPatchCifarAugmentedKernelConfig(RandomPatchCifarConfig):
+    patches_per_image: int = 4
+    aug_patch: int = 24
+    flip_chance: float = 0.5
+    gamma: float = 2e-4
+    kernel_block: int = 2048
+    kernel_epochs: int = 1
+    checkpoint_dir: Optional[str] = None
+    blocks_before_checkpoint: int = 25
+
+
+def run_random_patch_cifar_augmented_kernel(
+    config: RandomPatchCifarAugmentedKernelConfig,
+):
+    """The 13th reference app (RandomPatchCifarAugmentedKernel.scala:
+    1-190): random 24x24 crops + p=0.5 horizontal flips at train,
+    shuffled; whitened-random-patch featurization; KernelRidgeRegression
+    with optional block-loop checkpointing (`--checkpoint-dir`, :176);
+    center/corner crops WITH flips (10 augmentations) at test, scores
+    averaged per source image by AugmentedExamplesEvaluator."""
+    from ..nodes.images.core import RandomImageTransformer
+    from ..utils.images import flip_horizontal
+
+    train, test = _load(config)
+    t0 = time.perf_counter()
+    ap = config.aug_patch
+
+    # augment train: random crops, then horizontal flips with p=0.5.
+    # Per-stage seed offsets keep the crop / flip / shuffle streams
+    # independent (one shared PCG64 state would correlate the draws).
+    patcher = RandomPatcher(config.patches_per_image, ap, ap, seed=config.seed)
+    aug_train = RandomImageTransformer(
+        config.flip_chance, flip_horizontal, seed=config.seed + 1
+    ).apply_batch(patcher.apply_batch(train.data))
+    aug_labels = np.repeat(
+        np.asarray(train.labels.numpy()), config.patches_per_image
+    )
+    # shuffle images and labels with ONE permutation (the reference zips,
+    # shuffles, and unzips — Shuffler over (Image, label) pairs); the
+    # image gather stays on device, only the permutation crosses over
+    import jax.numpy as jnp
+
+    perm = np.random.default_rng(config.seed + 2).permutation(len(aug_labels))
+    perm_dev = jnp.asarray(perm)
+    aug_train = aug_train.map_batches(lambda a: jnp.take(a, perm_dev, axis=0))
+    aug_labels = aug_labels[perm]
+
+    filters, whitener = learn_filters(aug_train, config)
+    featurizer = (
+        FusedBatchTransformer(
+            [
+                PixelScaler(),
+                Convolver(filters, ap, ap, 3, whitener=whitener),
+                SymmetricRectifier(alpha=config.alpha),
+                Pooler(max(ap // 2 - 1, 1), ap // 2, pool_fn="sum"),
+                ImageVectorizer(),
+            ],
+            microbatch=config.microbatch,
+        ).to_pipeline()
+        >> Cacher("features")
+    )
+    label_ind = ClassLabelIndicatorsFromInt(config.num_classes)(
+        Dataset(aug_labels.astype(np.int32))
+    ).get()
+    predictor = featurizer.and_then(StandardScaler(), aug_train).and_then(
+        KernelRidgeRegression(
+            config.gamma, config.lam, config.kernel_block,
+            config.kernel_epochs, seed=config.seed,
+            checkpoint_dir=config.checkpoint_dir,
+            blocks_before_checkpoint=config.blocks_before_checkpoint,
+        ),
+        aug_train, label_ind,
+    )
+    # test: center + corner crops AND their flips -> 10 augmented views
+    cc = CenterCornerPatcher(ap, ap, with_flips=True)
+    aug_test = cc.apply_batch(test.data)
+    n_aug = 10
+    ids = np.repeat(np.arange(test.data.count), n_aug)
+    actuals = np.repeat(np.asarray(test.labels.numpy()), n_aug)
+    scores = predictor(aug_test).get()
     m = AugmentedExamplesEvaluator(config.num_classes)(ids, scores, actuals)
     return {
         "test_error": m.error,

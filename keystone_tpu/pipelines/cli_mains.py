@@ -85,6 +85,31 @@ def cifar_augmented_main(argv=None):
     return r
 
 
+def cifar_augmented_kernel_main(argv=None):
+    from .cifar_variants import (
+        RandomPatchCifarAugmentedKernelConfig,
+        run_random_patch_cifar_augmented_kernel,
+    )
+
+    p = _cifar_parser("RandomPatchCifarAugmentedKernel")
+    p.add_argument("--patches-per-image", type=int, default=4)
+    p.add_argument("--aug-patch", type=int, default=24)
+    p.add_argument("--flip-chance", type=float, default=0.5)
+    p.add_argument("--gamma", type=float, default=2e-4)
+    p.add_argument("--kernel-block", type=int, default=2048)
+    p.add_argument("--kernel-epochs", type=int, default=1)
+    p.add_argument("--checkpoint-dir")
+    p.add_argument("--blocks-before-checkpoint", type=int, default=25)
+    args = p.parse_args(argv)
+    r = run_random_patch_cifar_augmented_kernel(
+        RandomPatchCifarAugmentedKernelConfig(
+            **{k: v for k, v in vars(args).items() if v is not None}
+        )
+    )
+    print(f"test_error={r['test_error']:.4f} time={r['seconds']:.1f}s")
+    return r
+
+
 def newsgroups_main(argv=None):
     from .text_pipelines import NewsgroupsConfig, run_newsgroups
 

@@ -187,6 +187,76 @@ def build_pipeline(train, config):
     return predictor
 
 
+def _sync_leaf(x):
+    """Scalar-pull host sync for RAW arrays (Dataset values should use
+    `Dataset.sync()`, the canonical encoding of this idiom — see
+    data/dataset.py; block_until_ready is a no-op through the axon
+    tunnel, PERF.md methodology)."""
+    if hasattr(x, "ndim") and getattr(x, "ndim", 0) > 0:
+        np.asarray(x[(0,) * x.ndim])
+    return x
+
+
+def run_staged(train, config, evaluator):
+    """Stage-resolved timed run of the SAME components `build_pipeline`
+    assembles, with a scalar-pull host sync closing every stage so the
+    per-stage wall-clocks are honest and sum to the staged end-to-end by
+    construction (each stage's async dispatch cannot leak into the
+    next). Returns (stage_seconds, train_metrics, predictor_parts).
+
+    Stages mirror the reference app's phases (RandomPatchCifar.scala:
+    21-86): filter learning (:45-57), featurization conv/rectify/pool
+    (:59-64), scaler fit+apply (:67), BCD solve (:68), predict+eval
+    (:70-80)."""
+    stages = {}
+    t = time.perf_counter
+
+    t0 = t()
+    filters, whitener = learn_filters(train.data, config)
+    _sync_leaf(filters)
+    stages["filter_learning"] = t() - t0
+
+    leaves = train.data.array
+    h, w, c = leaves.shape[1:]
+    t0 = t()
+    featurizer = FusedBatchTransformer(
+        [
+            PixelScaler(),
+            Convolver(filters, h, w, c, whitener=whitener, normalize_patches=True),
+            SymmetricRectifier(alpha=config.alpha),
+            Pooler(config.pool_stride, config.pool_size, pool_fn="sum"),
+            ImageVectorizer(),
+        ],
+        microbatch=config.microbatch,
+    )
+    feats = featurizer.apply_batch(train.data).sync()
+    stages["featurize"] = t() - t0
+
+    t0 = t()
+    scaler = StandardScaler().fit(feats)
+    scaled = scaler.apply_batch(feats).sync()
+    stages["scaler"] = t() - t0
+
+    t0 = t()
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(train.labels).get()
+    model = BlockLeastSquaresEstimator(
+        config.block_size, num_iter=1, lam=config.lam
+    ).fit(scaled, labels)
+    _sync_leaf(model.W)
+    stages["bcd_solve"] = t() - t0
+
+    t0 = t()
+    preds = MaxClassifier().apply_batch(model.apply_batch(scaled))
+    train_metrics = evaluator(preds, train.labels)
+    stages["predict_eval"] = t() - t0
+
+    parts = {
+        "featurizer": featurizer, "scaler": scaler, "model": model,
+        "filters": filters, "whitener": whitener,
+    }
+    return stages, train_metrics, parts
+
+
 def run(config: RandomPatchCifarConfig):
     if config.train_path:
         train = cifar_loader(config.train_path)

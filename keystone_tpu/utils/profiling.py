@@ -12,7 +12,7 @@ during execution.
     print(prof.report())
 
 Timing wraps each node's lazy Expression, so it measures the real force
-time (including device compute via the `.cache()` block) rather than
+time (including device compute via the `.sync()` scalar pull) rather than
 graph construction.
 """
 
@@ -47,8 +47,10 @@ class ExecutionProfiler:
         def timed():
             t0 = time.perf_counter()
             value = orig_thunk()
-            if hasattr(value, "cache"):
-                value.cache()  # block so device time is attributed here
+            if hasattr(value, "sync"):
+                value.sync()  # scalar-pull sync so device time is
+                # attributed here (block_until_ready is a no-op
+                # through the axon tunnel)
             dt = time.perf_counter() - t0
             p = self.profiles.setdefault(label, NodeProfile(label))
             p.seconds += dt

@@ -163,8 +163,9 @@ def profile_nodes(
                     continue
                 t0 = time.perf_counter()
                 value = executor.execute(v).get
-                if hasattr(value, "cache"):
-                    value.cache()  # block so timing is honest
+                if hasattr(value, "sync"):
+                    value.sync()  # scalar-pull sync: honest compute time
+                    # (block_until_ready does not block over the tunnel)
                 per_node[v] = Profile(
                     (time.perf_counter() - t0) * 1e9, _estimate_bytes(value)
                 )

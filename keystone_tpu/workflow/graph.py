@@ -283,9 +283,26 @@ class Graph:
                 raise ValueError(f"{n} is not in the graph")
         if set(replacement_sink_splice) != to_remove:
             raise ValueError("replacement_sink_splice must cover exactly nodes_to_remove")
+        # GraphSuite.scala:711-790 argument checks: every replacement
+        # source must be bound, every replacement sink attached, and
+        # splice targets must be surviving vertices of this graph.
+        if set(replacement_source_splice) != set(replacement.sources):
+            raise ValueError(
+                "replacement_source_splice must cover exactly the "
+                "replacement's sources")
+        if set(replacement_sink_splice.values()) != set(
+            replacement.sink_dependencies
+        ):
+            raise ValueError(
+                "replacement_sink_splice must attach all of the "
+                "replacement's sinks")
         for tgt in replacement_source_splice.values():
             if isinstance(tgt, NodeId) and tgt in to_remove:
                 raise ValueError("source splice target may not be a removed node")
+            if isinstance(tgt, NodeId) and tgt not in self.operators:
+                raise ValueError(f"source splice target {tgt} is not in the graph")
+            if isinstance(tgt, SourceId) and tgt not in self.sources:
+                raise ValueError(f"source splice target {tgt} is not in the graph")
 
         g, sink_map = self.connect_graph(replacement, replacement_source_splice)
         # Rewire users of each removed node to the replacement sink's dependency.

@@ -1,0 +1,70 @@
+"""Generate the miniature archive fixtures checked in next to this file
+(the analog of the reference's checked-in test tars used by
+ImageNetLoaderSuite.scala:1-40 / VOCLoaderSuite.scala). Deterministic:
+small crops of the two public test images re-encoded as baseline JPEG.
+
+Run from the repo root:  python tests/resources/make_archive_fixtures.py
+"""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+from PIL import Image
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def crops():
+    gantry = np.asarray(Image.open(os.path.join(HERE, "gantrycrane.png")).convert("RGB"))
+    voc = np.asarray(Image.open(os.path.join(HERE, "000012.jpg")).convert("RGB"))
+    return [
+        gantry[:64, :64], gantry[-64:, -64:], gantry[:64, -64:],
+        voc[:64, :64], voc[-64:, -64:], voc[:64, -64:],
+    ]
+
+
+def jpeg_bytes(arr):
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+    return buf.getvalue()
+
+
+def write_tar(path, entries):
+    # uncompressed tar (the native fast path indexes plain tars)
+    with tarfile.open(path, "w") as tar:
+        for name, data in entries:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = 0
+            tar.addfile(info, io.BytesIO(data))
+
+
+def main():
+    cs = crops()
+    jpegs = [jpeg_bytes(c) for c in cs]
+    # imagenet: <synset>/<file> entries across two synsets, plus one
+    # entry whose synset is NOT in the labels map (must be skipped)
+    write_tar(os.path.join(HERE, "imagenet_mini.tar"), [
+        ("n01234567/im_a.jpg", jpegs[0]),
+        ("n01234567/im_b.jpg", jpegs[1]),
+        ("n07654321/im_c.jpg", jpegs[2]),
+        ("n07654321/im_d.jpg", jpegs[3]),
+        ("n99999999/im_e.jpg", jpegs[4]),  # unlabeled synset
+    ])
+    # voc: flat files joined against a filename,class_id csv; one image
+    # carries two labels, one has no csv row (skipped)
+    write_tar(os.path.join(HERE, "voc_mini.tar"), [
+        ("JPEGImages/000001.jpg", jpegs[0]),
+        ("JPEGImages/000002.jpg", jpegs[3]),
+        ("JPEGImages/000003.jpg", jpegs[5]),
+        ("JPEGImages/000009.jpg", jpegs[2]),  # no label row
+    ])
+    with open(os.path.join(HERE, "voc_mini_labels.csv"), "w") as f:
+        f.write("000001.jpg,3\n000001.jpg,11\n000002.jpg,0\n000003.jpg,19\n")
+    print("wrote imagenet_mini.tar, voc_mini.tar, voc_mini_labels.csv")
+
+
+if __name__ == "__main__":
+    main()

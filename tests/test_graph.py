@@ -281,3 +281,123 @@ def test_replace_nodes_rejects_removed_splice_target():
     # source splice targets a, which is being removed
     with pytest.raises(ValueError):
         g.replace_nodes([a, b], repl, {rs: a}, {a: rk, b: rk})
+
+
+# ---- GraphSuite.scala:41-110 accessor failure cases -----------------------
+
+
+def test_get_operator_missing_node_raises():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(KeyError):
+        g.get_operator(NodeId(99))
+
+
+def test_get_dependencies_missing_node_raises():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(KeyError):
+        g.get_dependencies(NodeId(99))
+
+
+def test_get_sink_dependency_missing_sink_raises():
+    g, s, a, b, k = build_chain()
+    with pytest.raises(KeyError):
+        g.get_sink_dependency(SinkId(99))
+
+
+# ---- GraphSuite.scala:625-644 connectGraph argument checks ----------------
+
+
+def test_connect_graph_rejects_dangling_splice_target():
+    """Splice values must be vertices of self (the reference rejects
+    splice maps naming sinks/sources that do not exist)."""
+    g, s, a, b, k = build_chain()
+    other = Graph()
+    other, os_ = other.add_source()
+    other, on = other.add_node(op(), [os_])
+    other, ok_ = other.add_sink(on)
+    with pytest.raises(ValueError):
+        g.connect_graph(other, {os_: NodeId(99)})
+    with pytest.raises(ValueError):
+        g.connect_graph(other, {os_: SourceId(99)})
+
+
+def test_connect_graph_partial_splice_keeps_source():
+    """Unspliced sources of `other` survive as sources of the result —
+    connectGraph (unlike replaceNodes) does not require binding all."""
+    g, s, a, b, k = build_chain()
+    other = Graph()
+    other, o1 = other.add_source()
+    other, o2 = other.add_source()
+    other, on = other.add_node(op(), [o1, o2])
+    other, ok_ = other.add_sink(on)
+    g2, sink_map = g.connect_graph(other, {o1: b})
+    assert len(g2.sources) == 2  # original s + remapped unspliced o2
+
+
+# ---- GraphSuite.scala:711-790 replaceNodes argument checks ----------------
+
+
+def _repl_two_sources():
+    repl = Graph()
+    repl, r1 = repl.add_source()
+    repl, r2 = repl.add_source()
+    repl, rn = repl.add_node(op(), [r1, r2])
+    repl, rk = repl.add_sink(rn)
+    return repl, r1, r2, rn, rk
+
+
+def test_replace_nodes_rejects_unbound_replacement_source():
+    """Must attach ALL of the replacement's sources."""
+    g, s, a, b, k = build_chain()
+    repl, r1, r2, rn, rk = _repl_two_sources()
+    with pytest.raises(ValueError):
+        g.replace_nodes([b], repl, {r1: s}, {b: rk})  # r2 unbound
+
+
+def test_replace_nodes_rejects_unattached_replacement_sink():
+    """Must attach ALL of the replacement's sinks."""
+    g, s, a, b, k = build_chain()
+    repl = Graph()
+    repl, rs = repl.add_source()
+    repl, rn = repl.add_node(op(), [rs])
+    repl, rk1 = repl.add_sink(rn)
+    repl, rk2 = repl.add_sink(rn)  # second sink, never attached
+    with pytest.raises(ValueError):
+        g.replace_nodes([b], repl, {rs: s}, {b: rk1})
+
+
+def test_replace_nodes_rejects_dangling_source_splice_target():
+    """May only connect replacement sources to existing vertices
+    (reference: SourceId(-42) case)."""
+    g, s, a, b, k = build_chain()
+    repl = Graph()
+    repl, rs = repl.add_source()
+    repl, rn = repl.add_node(op(), [rs])
+    repl, rk = repl.add_sink(rn)
+    with pytest.raises(ValueError):
+        g.replace_nodes([b], repl, {rs: SourceId(-42)}, {b: rk})
+    with pytest.raises(ValueError):
+        g.replace_nodes([b], repl, {rs: NodeId(99)}, {b: rk})
+
+
+def test_replace_nodes_happy_path_two_nodes():
+    """Positive case at the same shape as the failure matrix: replace the
+    {a, b} chain with a single-node subgraph; sink rewires to it."""
+    g, s, a, b, k = build_chain()
+    repl = Graph()
+    repl, rs = repl.add_source()
+    repl, rn = repl.add_node(op("r"), [rs])
+    repl, rk = repl.add_sink(rn)
+    g2 = g.replace_nodes([a, b], repl, {rs: s}, {a: rk, b: rk})
+    assert a not in g2.operators and b not in g2.operators
+    new_dep = g2.get_sink_dependency(k)
+    assert isinstance(new_dep, NodeId) and new_dep in g2.operators
+    assert g2.get_dependencies(new_dep) == (s,)
+
+
+def test_remove_node_with_sink_user_still_fails():
+    """A node referenced only by a sink still counts as having
+    dependents (GraphSuite removeNode)."""
+    g, s, a, b, k = build_chain()
+    with pytest.raises(ValueError):
+        g.remove_node(b)

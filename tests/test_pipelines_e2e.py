@@ -78,6 +78,40 @@ def test_random_patch_cifar_augmented():
     assert r["test_accuracy"] > 0.85
 
 
+def test_random_patch_cifar_augmented_kernel(tmp_path, monkeypatch):
+    """The 13th app (RandomPatchCifarAugmentedKernel.scala:1-190):
+    augmented featurization + flips + shuffle + KRR with checkpoint dir
+    + flip-augmented test eval."""
+    import os
+
+    from keystone_tpu.pipelines.cifar_variants import (
+        RandomPatchCifarAugmentedKernelConfig,
+        run_random_patch_cifar_augmented_kernel,
+    )
+
+    # the solver removes its checkpoint on successful completion, so
+    # observe the atomic os.replace publishes to prove --checkpoint-dir
+    # was threaded through to the KRR block loop
+    writes = []
+    real_replace = os.replace
+    monkeypatch.setattr(
+        os, "replace",
+        lambda src, dst: (writes.append(dst), real_replace(src, dst))[1],
+    )
+    r = run_random_patch_cifar_augmented_kernel(
+        RandomPatchCifarAugmentedKernelConfig(
+            synth_train=200, synth_test=50, num_filters=48, sample_patches=5000,
+            microbatch=64, kernel_block=128, gamma=2e-3, lam=0.1,
+            checkpoint_dir=str(tmp_path), blocks_before_checkpoint=2,
+        )
+    )
+    assert r["test_accuracy"] > 0.85
+    ckpt_writes = [d for d in writes if str(tmp_path) in str(d)]
+    assert ckpt_writes, "KRR wrote no checkpoints under --checkpoint-dir"
+    # and the completed fit cleaned its checkpoint up
+    assert not any(f.startswith("krr_") for f in os.listdir(tmp_path))
+
+
 def test_voc_sift_fisher():
     from keystone_tpu.pipelines.voc_sift_fisher import VOCSIFTFisherConfig, run
 
