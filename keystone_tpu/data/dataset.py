@@ -41,6 +41,15 @@ def _pad_to(x, target: int):
     return jnp.pad(x, pad_widths)
 
 
+def sync_pull(leaf) -> None:
+    """THE scalar-pull sync idiom, in one place: transfer one element of
+    a (device) array to host. `jax.block_until_ready` does not actually
+    block through the axon tunnel (PERF.md methodology), so every honest
+    timing fence in the library routes through this helper."""
+    if hasattr(leaf, "ndim") and hasattr(leaf, "dtype") and leaf.ndim > 0:
+        np.asarray(leaf[(0,) * leaf.ndim])
+
+
 class Dataset:
     """Sharded device-resident dataset (leading axis = examples)."""
 
@@ -163,8 +172,7 @@ class Dataset:
         autocache profiling, calibration — must force a value transfer;
         a single-element device slice keeps the transfer tiny."""
         for leaf in jax.tree_util.tree_leaves(self.data):
-            if hasattr(leaf, "ndim") and hasattr(leaf, "dtype"):
-                np.asarray(leaf[(0,) * leaf.ndim])
+            sync_pull(leaf)
         return self
 
     def spread_take(self, m: int):

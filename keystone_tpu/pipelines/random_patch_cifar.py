@@ -188,12 +188,12 @@ def build_pipeline(train, config):
 
 
 def _sync_leaf(x):
-    """Scalar-pull host sync for RAW arrays (Dataset values should use
-    `Dataset.sync()`, the canonical encoding of this idiom — see
-    data/dataset.py; block_until_ready is a no-op through the axon
-    tunnel, PERF.md methodology)."""
-    if hasattr(x, "ndim") and getattr(x, "ndim", 0) > 0:
-        np.asarray(x[(0,) * x.ndim])
+    """Scalar-pull host sync for RAW arrays (Dataset values use
+    `Dataset.sync()`; both route through data.dataset.sync_pull, the
+    single encoding of the tunnel-safe fence)."""
+    from ..data.dataset import sync_pull
+
+    sync_pull(x)
     return x
 
 

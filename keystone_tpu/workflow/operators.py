@@ -87,7 +87,16 @@ class TransformerOperator(Operator):
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         deps = list(deps)
-        if any(isinstance(d, DatumExpression) for d in deps):
+        # Operator.scala:77-100 argument checks: at least one data
+        # dependency, and all of one kind (no datum/dataset mixing)
+        if not deps:
+            raise ValueError("TransformerOperator requires data dependencies")
+        n_datum = sum(isinstance(d, DatumExpression) for d in deps)
+        if n_datum and n_datum != len(deps):
+            raise ValueError(
+                "TransformerOperator dependencies must be all datasets or "
+                "all datums")
+        if n_datum:
             return DatumExpression(lambda: self.single_transform([d.get for d in deps]))
         return DatasetExpression(lambda: self.batch_transform([d.get for d in deps]))
 
@@ -111,9 +120,20 @@ class DelegatingOperator(Operator):
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         deps = list(deps)
-        assert deps, "DelegatingOperator requires a transformer dependency"
+        # Operator.scala:136-163 argument checks
+        if not deps:
+            raise ValueError("DelegatingOperator requires a transformer dependency")
         transformer_expr, data_deps = deps[0], deps[1:]
-        assert isinstance(transformer_expr, TransformerExpression)
+        if not isinstance(transformer_expr, TransformerExpression):
+            raise ValueError(
+                "DelegatingOperator's first dependency must be a transformer")
+        if not data_deps:
+            raise ValueError("DelegatingOperator requires data dependencies")
+        n_datum = sum(isinstance(d, DatumExpression) for d in data_deps)
+        if n_datum and n_datum != len(data_deps):
+            raise ValueError(
+                "DelegatingOperator data dependencies must be all datasets "
+                "or all datums")
         if any(isinstance(d, DatumExpression) for d in data_deps):
             return DatumExpression(
                 lambda: transformer_expr.get.single_transform([d.get for d in data_deps])

@@ -215,3 +215,218 @@ def test_pipeline_env_reset_isolates_state():
     PipelineEnv.reset()
     _ = p(train).get()
     assert est.n_fits == 2  # state gone after reset
+
+
+# ---- PipelineSuite.scala:115-240: incremental execution-state reuse -------
+# The reference counts per-item recomputation with Spark accumulators; the
+# analog here is a host-side counter in a per-item transformer over a
+# HostDataset (the per-item execution path, like the reference's RDD maps).
+
+
+class _CountingTriple(Transformer):
+    def __init__(self, counter):
+        self.counter = counter
+
+    def apply(self, x):
+        self.counter[0] += 1
+        return str(int(x) * 3)
+
+
+class _QubEstimator(Estimator):
+    def fit(self, data):
+        class Qub(Transformer):
+            def apply(self, x):
+                return x + "qub"
+
+        return Qub()
+
+
+class _QubLabelEstimator(LabelEstimator):
+    def fit(self, data, labels):
+        class Qub(Transformer):
+            def apply(self, x):
+                return x + "qub"
+
+        return Qub()
+
+
+def _hd(values):
+    from keystone_tpu import HostDataset
+
+    return HostDataset(list(values))
+
+
+def test_incremental_state_variation_1():
+    """PipelineSuite.scala:115-148: cached features are not reprocessed
+    when the pipeline is extended and re-applied; new data costs only its
+    own items."""
+    from keystone_tpu.nodes.util import Cacher
+
+    counter = [0]
+    featurizer = _CountingTriple(counter).to_pipeline() >> Cacher()
+    data = _hd([32, 94, 12])
+    features = featurizer(data)
+    assert features.get().items == ["96", "282", "36"]
+    assert counter[0] == 3
+
+    # reference form: featurizer andThen est.withData(features) — the
+    # estimator fits on the ALREADY-featurized result
+    # (PipelineSuite.scala:136; and_then(est, data) would re-featurize)
+    pipe = featurizer >> _QubEstimator().with_data(features)
+    out = pipe(data)
+    assert out.get().items == ["96qub", "282qub", "36qub"]
+    assert out.get().items == ["96qub", "282qub", "36qub"]
+    assert pipe(data).get().items == ["96qub", "282qub", "36qub"]
+    assert counter[0] == 3, "cached values must not be reprocessed"
+
+    test_data = _hd([32, 94])
+    test_out = pipe(test_data)
+    assert test_out.get().items == ["96qub", "282qub"]
+    assert test_out.get().items == ["96qub", "282qub"]
+    assert counter[0] == 5, "only the new dataset's items run"
+
+
+def test_incremental_state_variation_2():
+    """PipelineSuite.scala:150-192: a model estimated from cached
+    features applies to those features without recomputation; a single
+    uncached datum costs exactly one run."""
+    from keystone_tpu.nodes.util import Cacher
+
+    counter = [0]
+    featurizer = _CountingTriple(counter).to_pipeline() >> Cacher()
+    data = _hd([32, 94, 12])
+    features = featurizer(data)
+    assert features.get().items == ["96", "282", "36"]
+    assert counter[0] == 3
+
+    test_features = featurizer(_hd([32, 94]))
+    assert test_features.get().items == ["96", "282"]
+    assert counter[0] == 5
+
+    model = _QubEstimator().with_data(features)
+    out = model(features)
+    assert out.get().items == ["96qub", "282qub", "36qub"]
+    assert out.get().items == ["96qub", "282qub", "36qub"]
+    assert counter[0] == 5
+
+    test_out = model(test_features)
+    assert test_out.get().items == ["96qub", "282qub"]
+    assert counter[0] == 5
+
+    datum_out = model(featurizer(2))
+    assert datum_out.get() == "6qub"
+    assert datum_out.get() == "6qub"
+    assert counter[0] == 6, "single uncached value runs exactly once"
+
+
+def test_incremental_state_with_label_estimator():
+    """PipelineSuite.scala:194-238: label estimators reuse cached feature
+    and label branches across applies."""
+    from keystone_tpu.nodes.util import Cacher
+
+    counter = [0]
+    featurizer = _CountingTriple(counter).to_pipeline() >> Cacher()
+    data = _hd([32, 94, 12])
+    labels = _hd([64, 188, 24])
+
+    features = featurizer(data)
+    assert features.get().items == ["96", "282", "36"]
+    assert counter[0] == 3
+    label_features = featurizer(labels)
+    assert label_features.get().items == ["192", "564", "72"]
+    assert counter[0] == 6
+
+    pipe = featurizer >> _QubLabelEstimator().with_data(
+        features, label_features
+    )
+    out = pipe(data)
+    assert out.get().items == ["96qub", "282qub", "36qub"]
+    assert pipe(data).get().items == ["96qub", "282qub", "36qub"]
+    assert counter[0] == 6
+
+    labels_out = pipe(labels)
+    assert labels_out.get().items == ["192qub", "564qub", "72qub"]
+    assert counter[0] == 6
+
+    test_out = pipe(_hd([32, 94]))
+    assert test_out.get().items == ["96qub", "282qub"]
+    assert counter[0] == 8
+
+
+def test_access_features_and_final_value():
+    """PipelineSuite.scala:328-387: both an intermediate (features) sink
+    and the final prediction share one execution of the common prefix."""
+    from keystone_tpu.nodes.util import Cacher
+
+    counter = [0]
+    featurizer = _CountingTriple(counter).to_pipeline() >> Cacher()
+    data = _hd([1, 2, 3])
+    features = featurizer(data)
+    pipe = featurizer >> _QubEstimator().with_data(features)
+    preds = pipe(data)
+    assert features.get().items == ["3", "6", "9"]
+    assert preds.get().items == ["3qub", "6qub", "9qub"]
+    assert counter[0] == 3, "features and predictions share one run"
+
+
+def test_incremental_state_with_and_then_chaining():
+    """PipelineSuite.scala:240-326: two fitted pipeline halves chained
+    with andThen reuse every fit and every cached featurization; the
+    exact recomputation counts match the reference."""
+    from keystone_tpu import HostDataset
+    from keystone_tpu.nodes.util import Cacher
+
+    t1c, t2c, e1c, e2c = [0], [0], [0], [0]
+
+    class T1(Transformer):
+        def apply(self, x):
+            t1c[0] += 1
+            return x + "d"
+
+    class T2(Transformer):
+        def apply(self, x):
+            t2c[0] += 1
+            return x + "e"
+
+    def make_est(counter, suffix):
+        class E(Estimator):
+            def fit(self, data):
+                counter[0] += len(data.items)
+
+                class S(Transformer):
+                    def apply(self, x):
+                        return x + suffix
+
+                return S()
+
+        return E()
+
+    est1, est2 = make_est(e1c, "abc"), make_est(e2c, "xyz")
+    data1 = HostDataset(["h", "i", "j"])
+    data2 = HostDataset(["f", "g"])
+
+    pipe_left = (T1().to_pipeline() >> Cacher()).and_then(est1, data1)
+    pipe_right = (T2().to_pipeline() >> Cacher()).and_then(est2, data2)
+    # nothing executes before .get()
+    assert (t1c[0], t2c[0], e1c[0], e2c[0]) == (0, 0, 0, 0)
+
+    assert pipe_left(data1).get().items == ["hdabc", "idabc", "jdabc"]
+    assert (t1c[0], t2c[0], e1c[0], e2c[0]) == (3, 0, 3, 0)
+
+    assert pipe_right(data2).get().items == ["fexyz", "gexyz"]
+    assert (t1c[0], t2c[0], e1c[0], e2c[0]) == (3, 2, 3, 2)
+
+    pipe = pipe_left >> pipe_right
+
+    # reuses both fits and the cached transformer1(data1); transformer2
+    # must run on the new intermediate values
+    assert pipe(data1).get().items == ["hdabcexyz", "idabcexyz", "jdabcexyz"]
+    assert (t1c[0], t2c[0], e1c[0], e2c[0]) == (3, 5, 3, 2)
+
+    # data2 through the full chain: t1 and t2 both compute; no refits
+    assert pipe(data2).get().items == ["fdabcexyz", "gdabcexyz"]
+    assert (t1c[0], t2c[0], e1c[0], e2c[0]) == (5, 7, 3, 2)
+
+    # single datum: both transformers compute once; no refits
+    assert pipe("l").get() == "ldabcexyz"
+    assert (t1c[0], t2c[0], e1c[0], e2c[0]) == (6, 8, 3, 2)

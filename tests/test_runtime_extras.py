@@ -289,3 +289,57 @@ def test_execution_profiler_times_and_reports():
     assert sum(p.forced for p in prof.profiles.values()) >= 2
     rep = prof.report()
     assert "seconds" in rep and "forced" in rep
+
+
+# ---- OperatorSuite.scala:104-124, 247-283: invalid-input checks -----------
+
+
+def test_transformer_operator_rejects_invalid_inputs():
+    from keystone_tpu.workflow.expressions import (
+        DatasetExpression,
+        DatumExpression,
+    )
+    from keystone_tpu.workflow.operators import TransformerOperator
+
+    class T(TransformerOperator):
+        def single_transform(self, inputs):
+            return 4
+
+        def batch_transform(self, inputs):
+            return [4]
+
+    t = T()
+    with pytest.raises(ValueError):
+        t.execute([DatasetExpression.of([4]), DatumExpression.of(4)])  # mixed
+    with pytest.raises(ValueError):
+        t.execute([])  # empty
+
+
+def test_delegating_operator_rejects_invalid_inputs():
+    from keystone_tpu.workflow.expressions import (
+        DatasetExpression,
+        DatumExpression,
+        TransformerExpression,
+    )
+    from keystone_tpu.workflow.operators import (
+        DelegatingOperator,
+        TransformerOperator,
+    )
+
+    class T(TransformerOperator):
+        def single_transform(self, inputs):
+            return 4
+
+        def batch_transform(self, inputs):
+            return [4]
+
+    op = DelegatingOperator()
+    texpr = TransformerExpression(lambda: T())
+    with pytest.raises(ValueError):  # mixed data deps
+        op.execute([texpr, DatasetExpression.of([4]), DatumExpression.of(4)])
+    with pytest.raises(ValueError):  # empty
+        op.execute([])
+    with pytest.raises(ValueError):  # transformer only, no data
+        op.execute([texpr])
+    with pytest.raises(ValueError):  # first dep not a transformer
+        op.execute([DatasetExpression.of([4]), DatasetExpression.of([4])])
