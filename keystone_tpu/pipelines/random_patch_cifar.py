@@ -152,25 +152,32 @@ def learn_filters(train_data: Dataset, config) -> tuple:
     return filters, ZCAWhitener(W, mu)
 
 
+def make_featurizer(filters, whitener, h, w, c, config,
+                    microbatch: Optional[int] = None) -> FusedBatchTransformer:
+    """THE fused featurization stack (scale → folded-whitening conv →
+    two-sided ReLU → sum-pool → flatten), one microbatched XLA program.
+    Single source of truth for `build_pipeline`, `run_staged`, and the
+    microbatch sweep (scripts/featurize_sweep.py)."""
+    return FusedBatchTransformer(
+        [
+            PixelScaler(),
+            Convolver(filters, h, w, c, whitener=whitener, normalize_patches=True),
+            SymmetricRectifier(alpha=config.alpha),
+            Pooler(config.pool_stride, config.pool_size, pool_fn="sum"),
+            ImageVectorizer(),
+        ],
+        microbatch=microbatch if microbatch is not None else config.microbatch,
+    )
+
+
 def build_pipeline(train, config):
     """Build + fit the full prediction pipeline; returns (pipeline, labels)."""
     filters, whitener = learn_filters(train.data, config)
 
     leaves = train.data.array
     h, w, c = leaves.shape[1:]
-    # One fused, microbatched XLA program for the whole featurization:
-    # scale → folded-whitening conv → two-sided ReLU → sum-pool → flatten.
     featurizer = (
-        FusedBatchTransformer(
-            [
-                PixelScaler(),
-                Convolver(filters, h, w, c, whitener=whitener, normalize_patches=True),
-                SymmetricRectifier(alpha=config.alpha),
-                Pooler(config.pool_stride, config.pool_size, pool_fn="sum"),
-                ImageVectorizer(),
-            ],
-            microbatch=config.microbatch,
-        ).to_pipeline()
+        make_featurizer(filters, whitener, h, w, c, config).to_pipeline()
         >> Cacher("features")
     )
     labels = ClassLabelIndicatorsFromInt(config.num_classes)(train.labels).get()
@@ -219,16 +226,7 @@ def run_staged(train, config, evaluator):
     leaves = train.data.array
     h, w, c = leaves.shape[1:]
     t0 = t()
-    featurizer = FusedBatchTransformer(
-        [
-            PixelScaler(),
-            Convolver(filters, h, w, c, whitener=whitener, normalize_patches=True),
-            SymmetricRectifier(alpha=config.alpha),
-            Pooler(config.pool_stride, config.pool_size, pool_fn="sum"),
-            ImageVectorizer(),
-        ],
-        microbatch=config.microbatch,
-    )
+    featurizer = make_featurizer(filters, whitener, h, w, c, config)
     feats = featurizer.apply_batch(train.data).sync()
     stages["featurize"] = t() - t0
 

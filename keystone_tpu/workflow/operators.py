@@ -134,7 +134,7 @@ class DelegatingOperator(Operator):
             raise ValueError(
                 "DelegatingOperator data dependencies must be all datasets "
                 "or all datums")
-        if any(isinstance(d, DatumExpression) for d in data_deps):
+        if n_datum:
             return DatumExpression(
                 lambda: transformer_expr.get.single_transform([d.get for d in data_deps])
             )

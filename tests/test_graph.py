@@ -401,3 +401,71 @@ def test_remove_node_with_sink_user_still_fails():
     g, s, a, b, k = build_chain()
     with pytest.raises(ValueError):
         g.remove_node(b)
+
+
+# ---- AnalysisUtilsSuite.scala:39-287: topology queries on a diamond -------
+
+
+def build_diamond():
+    """source -> a -> {b, c} -> d(gather) -> sink1; b -> sink2.
+    Exercises multi-child, multi-parent, and sink-bearing vertices like
+    the reference's 19-node fixture does."""
+    g = Graph()
+    g, s = g.add_source()
+    g, a = g.add_node(op("a"), [s])
+    g, b = g.add_node(op("b"), [a])
+    g, c = g.add_node(op("c"), [a])
+    g, d = g.add_node(op("d"), [b, c])
+    g, k1 = g.add_sink(d)
+    g, k2 = g.add_sink(b)
+    return g, s, a, b, c, d, k1, k2
+
+
+def test_children_per_vertex_kind():
+    g, s, a, b, c, d, k1, k2 = build_diamond()
+    assert analysis.children(g, s) == {a}
+    assert analysis.children(g, a) == {b, c}
+    assert analysis.children(g, b) == {d, k2}  # node AND sink children
+    assert analysis.children(g, d) == {k1}
+    assert analysis.children(g, k1) == set()  # sinks have no children
+
+
+def test_parents_per_vertex_kind():
+    g, s, a, b, c, d, k1, k2 = build_diamond()
+    assert analysis.parents(g, a) == [s]
+    assert set(analysis.parents(g, d)) == {b, c}
+    assert analysis.parents(g, k1) == [d]  # sink's parent is its dep
+    assert analysis.parents(g, k2) == [b]
+    assert analysis.parents(g, s) == []  # sources have no parents
+
+
+def test_descendants_include_sinks():
+    g, s, a, b, c, d, k1, k2 = build_diamond()
+    assert analysis.descendants(g, s) == {a, b, c, d, k1, k2}
+    assert analysis.descendants(g, b) == {d, k1, k2}
+    assert analysis.descendants(g, c) == {d, k1}
+    assert analysis.descendants(g, d) == {k1}
+
+
+def test_ancestors_include_sources():
+    g, s, a, b, c, d, k1, k2 = build_diamond()
+    assert analysis.ancestors(g, k1) == {s, a, b, c, d}
+    assert analysis.ancestors(g, k2) == {s, a, b}
+    assert analysis.ancestors(g, d) == {s, a, b, c}
+    assert analysis.ancestors(g, a) == {s}
+    assert analysis.ancestors(g, s) == set()
+
+
+def test_linearize_respects_dependencies_and_is_deterministic():
+    g, s, a, b, c, d, k1, k2 = build_diamond()
+    order = analysis.linearize(g)
+    pos = {v: i for i, v in enumerate(order)}
+    for node in (a, b, c, d):
+        for dep in g.get_dependencies(node):
+            assert pos[dep] < pos[node]
+    assert order == analysis.linearize(g)  # deterministic
+    # repeated builds of the same topology linearize identically
+    g2 = build_diamond()[0]
+    assert [type(v).__name__ for v in analysis.linearize(g2)] == [
+        type(v).__name__ for v in order
+    ]

@@ -430,3 +430,65 @@ def test_incremental_state_with_and_then_chaining():
     # single datum: both transformers compute once; no refits
     assert pipe("l").get() == "ldabcexyz"
     assert (t1c[0], t2c[0], e1c[0], e2c[0]) == (6, 8, 3, 2)
+
+
+# ---- EstimatorSuite.scala / LabelEstimatorSuite.scala ---------------------
+
+
+def test_estimator_with_data_raw_and_pipeline_data():
+    """EstimatorSuite.scala: withData accepts both raw datasets and lazy
+    pipeline results; the fit sees exactly that data."""
+    from keystone_tpu import HostDataset
+
+    class FirstAdder(Estimator):
+        def fit(self, data):
+            first = data.items[0]
+
+            class A(Transformer):
+                def apply(self, x):
+                    return x + first
+
+            return A()
+
+    train = HostDataset([32, 94, 12])
+    test = HostDataset([42, 58, 61])
+    pipe = FirstAdder().with_data(train)
+    assert pipe(test).get().items == [42 + 32, 58 + 32, 61 + 32]
+
+    class Doubler(Transformer):
+        def apply(self, x):
+            return x * 2
+
+    pipe2 = FirstAdder().with_data(Doubler().to_pipeline()(train))
+    assert pipe2(test).get().items == [42 + 64, 58 + 64, 61 + 64]
+
+
+def test_label_estimator_with_data_raw_and_pipeline_data():
+    """LabelEstimatorSuite.scala:9-50: both data and labels may be raw
+    or lazy pipeline results."""
+    from keystone_tpu import HostDataset
+
+    class SumFitter(LabelEstimator):
+        def fit(self, data, labels):
+            s = data.items[0] + labels.items[0]
+
+            class A(Transformer):
+                def apply(self, x):
+                    return x + s
+
+            return A()
+
+    train = HostDataset([10, 20])
+    labels = HostDataset([5, 6])
+    test = HostDataset([1, 2])
+    pipe = SumFitter().with_data(train, labels)
+    assert pipe(test).get().items == [1 + 15, 2 + 15]
+
+    class Neg(Transformer):
+        def apply(self, x):
+            return -x
+
+    pipe2 = SumFitter().with_data(
+        Neg().to_pipeline()(train), Neg().to_pipeline()(labels)
+    )
+    assert pipe2(test).get().items == [1 - 15, 2 - 15]
