@@ -146,6 +146,21 @@ def result_record(detail, extra=None):
     return rec
 
 
+def finalize_record(detail):
+    """Gate a child measurement: returns (record, persist_as_last_good).
+
+    An out-of-band accuracy (solver-quality regression on the calibrated
+    task) is emitted loudly marked with "error" and must NEVER become
+    the stale-fallback record; CPU runs never persist either."""
+    rec = result_record(detail)
+    if not detail.get("accuracy_in_band", True):
+        rec["error"] = (
+            f"test_accuracy {detail.get('test_accuracy')} outside "
+            f"calibrated band {detail.get('accuracy_band')}")
+        return rec, False
+    return rec, detail.get("platform") != "cpu"
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
@@ -188,18 +203,8 @@ def main():
         remaining = args.deadline - (time.monotonic() - t_start)
         detail, phases = run_child(args, min(args.run_timeout, remaining))
         if detail is not None:
-            rec = result_record(detail)
-            if not detail.get("accuracy_in_band", True):
-                # solver-quality regression: accuracy left the calibrated
-                # band. Emit the measurement loudly marked as failing and
-                # do NOT let it become the stale-fallback record.
-                rec["error"] = (
-                    f"test_accuracy {detail.get('test_accuracy')} outside "
-                    f"calibrated band {detail.get('accuracy_band')}")
-                emit(rec)
-                return 0
-            if detail.get("platform") != "cpu":  # only real-device runs
-                # qualify as the stale-fallback record
+            rec, persist = finalize_record(detail)
+            if persist:
                 try:
                     with open(LAST_GOOD, "w") as f:
                         json.dump(rec, f, indent=1)

@@ -117,3 +117,36 @@ def test_calibrated_difficulty_accuracy_band():
     pred = build_pipeline(train, RandomPatchCifarConfig(num_filters=128))
     acc = MulticlassClassifierEvaluator(10)(pred(test.data), test.labels).accuracy
     assert 0.68 <= acc <= 0.92, f"accuracy {acc} left the calibrated band"
+
+
+def test_bench_band_gate():
+    """bench.py's record gate: out-of-band accuracy is marked as an
+    error and never persists as the stale-fallback record; in-band TPU
+    runs persist; CPU runs never persist."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    base = {"images_per_sec": 1000.0, "test_accuracy": 0.85,
+            "accuracy_band": [0.72, 0.96], "platform": "tpu"}
+    rec, persist = bench.finalize_record(dict(base, accuracy_in_band=True))
+    assert persist and "error" not in rec
+
+    rec, persist = bench.finalize_record(
+        dict(base, test_accuracy=1.0, accuracy_in_band=False))
+    assert not persist and "outside calibrated band" in rec["error"]
+
+    rec, persist = bench.finalize_record(
+        dict(base, platform="cpu", accuracy_in_band=True))
+    assert not persist
+
+    # legacy records (no band fields) still pass through and persist
+    rec, persist = bench.finalize_record(
+        {"images_per_sec": 500.0, "platform": "tpu"})
+    assert persist and "error" not in rec
