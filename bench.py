@@ -279,18 +279,40 @@ def _flagship_bcd(n, d, k, block, iters):
     scale. Mirrors the TIMIT-shaped row of the reference's solver sweep
     (scripts/solver-comparisons-final.csv; BASELINE.md: TIMIT Block
     d=8192 = 580 555 ms on 16x r3.4xlarge at n=2.2e6)."""
+    import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from keystone_tpu.data.dataset import Dataset
     from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.parallel import mesh as meshlib
+
     rng = np.random.default_rng(0)
-    # standard_normal(float32) and random labels: the solve's arithmetic
-    # profile is label-independent, and a host-side X@W_true at this
-    # scale (271 GFLOP single-threaded) would dominate the bench's
-    # wall-clock budget
-    X = rng.standard_normal(size=(n, d), dtype=np.float32)
-    Y = rng.standard_normal(size=(n, k), dtype=np.float32)
+    # Generate ON DEVICE, directly into the Dataset's sharding: random
+    # data (the solve's arithmetic profile is label-independent) via
+    # jitted PRNG instead of a ~4 GB host device_put — the tunnel is
+    # both slow for and, if the process dies mid-transfer, wedgeable by
+    # bulk host→device traffic. out_shardings matters: without it the
+    # full array would materialize unsharded on one chip before the
+    # Dataset reshard (OOM at reference scale on a pod).
+    m = meshlib.current_mesh()
+    shards = meshlib.n_data_shards(m)
+    n = -(-n // shards) * shards  # pad to whole rows per shard
+    row_sh = NamedSharding(m, P(meshlib.DATA_AXIS))
+
+    def gen(key, rows, cols):
+        sh = meshlib.feature_sharding(m, cols) or row_sh
+        f = jax.jit(
+            lambda kk: jax.random.normal(kk, (rows, cols), jnp.float32),
+            out_shardings=sh,
+        )
+        return f(key)
+
+    X = gen(jax.random.PRNGKey(0), n, d)
+    Y = gen(jax.random.PRNGKey(1), n, k)
     data, labels = Dataset(X), Dataset(Y)
     del X, Y
     est = BlockLeastSquaresEstimator(block_size=block, num_iter=iters, lam=1e-2)
