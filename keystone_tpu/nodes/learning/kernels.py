@@ -218,6 +218,24 @@ class KernelRidgeRegression(LabelEstimator):
         import hashlib
         import os
 
+        try:
+            import jax
+
+            multi = jax.process_count() > 1
+        except Exception:
+            multi = False
+        if multi:
+            # single-host-only: the save path host-fetches alpha/KA
+            # (non-addressable in a multi-process job) and every process
+            # would race the same file. The reference's equivalent was
+            # driver-side RDD checkpointing — also a single coordinator.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KernelRidgeRegression checkpointing is single-host only; "
+                "disabling for this %d-process job", jax.process_count())
+            return None
+
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         # fingerprint the data, not just shapes: a stale checkpoint from a
         # different dataset with identical shape must not resume
@@ -277,6 +295,7 @@ class KernelRidgeRegression(LabelEstimator):
                     os.replace(tmp, ckpt)
         if ckpt and os.path.exists(ckpt):
             os.unlink(ckpt)  # fit completed; stale state must not resume
-        return KernelBlockLinearMapper(
-            np.asarray(X), alpha, self.gamma, self.block_size
-        )
+        # keep the anchors on device: np.asarray here would fetch a
+        # global array spanning non-addressable devices in a multihost
+        # job (and costs a pointless round trip on one host)
+        return KernelBlockLinearMapper(X, alpha, self.gamma, self.block_size)
