@@ -218,13 +218,9 @@ class KernelRidgeRegression(LabelEstimator):
         import hashlib
         import os
 
-        try:
-            import jax
+        import jax
 
-            multi = jax.process_count() > 1
-        except Exception:
-            multi = False
-        if multi:
+        if jax.process_count() > 1:
             # single-host-only: the save path host-fetches alpha/KA
             # (non-addressable in a multi-process job) and every process
             # would race the same file. The reference's equivalent was

@@ -112,5 +112,45 @@ with use_mesh(mesh):
     )
 assert acc >= 0.9, f"multihost KRR failed to learn XOR: acc={acc}"
 
+# --- the full north-star pipeline across hosts -------------------------
+# build_pipeline (PixelScaler → folded-ZCA Convolver → SymmetricRectifier
+# → Pooler → StandardScaler → BCD solve → MaxClassifier) fit and applied
+# with the training images dp-sharded ACROSS the two processes — the
+# multihost analog of the driver's single-process dryrun_multichip.
+from keystone_tpu.loaders.cifar_loader import synthetic_cifar
+from keystone_tpu.pipelines.random_patch_cifar import (
+    RandomPatchCifarConfig,
+    build_pipeline,
+)
+
+from keystone_tpu.parallel.mesh import make_mesh
+
+n_img = 64  # per the global job; each process contributes half
+# generate on a LOCAL 1-device mesh so the host copy below is
+# addressable; same seed on both hosts -> same global data
+local_mesh = make_mesh(jax.local_devices()[:1])
+tr, _ = synthetic_cifar(n_img, 8, seed=5, mesh=local_mesh)
+imgs = np.asarray(tr.data.numpy())
+labs = np.asarray(tr.labels.numpy())
+lo_i, hi_i = proc_id * (n_img // 2), (proc_id + 1) * (n_img // 2)
+with use_mesh(mesh):
+    from keystone_tpu.loaders.csv_loader import LabeledData
+
+    tr_ds = LabeledData(
+        data=multihost.dataset_from_process_local(imgs[lo_i:hi_i], mesh=mesh),
+        labels=multihost.dataset_from_process_local(labs[lo_i:hi_i], mesh=mesh),
+    )
+    config = RandomPatchCifarConfig(
+        num_filters=16, block_size=64, microbatch=32, sample_patches=2000
+    )
+    predictor = build_pipeline(tr_ds, config)
+    pred_arr = predictor(tr_ds.data).get().array
+    train_acc = float(
+        jax.jit(lambda p, y: (p == y).mean())(pred_arr, tr_ds.labels.array)
+    )
+# the synthetic default task is separable: the cross-host fit must
+# reach high train accuracy or the distributed pipeline is broken
+assert train_acc >= 0.9, f"multihost pipeline train acc {train_acc}"
+
 multihost.barrier()
 print(f"[{proc_id}] MULTIHOST_OK", flush=True)
