@@ -330,13 +330,15 @@ def _flagship_bcd(n, d, k, block, iters):
 
     fit_once()  # warm/compile
     secs = fit_once()
-    nb = -(-d // block)
-    flops = iters * nb * (2.0 * n * block * (block + 2 * k) + (2 / 3) * block**3)
-    bytes_ = iters * nb * 4.0 * n * (block + k)
+    B = min(block, d)  # effective block width (solver clamps to d)
+    nb = -(-d // B)
+    flops = iters * nb * (2.0 * n * B * (B + 2 * k) + (2 / 3) * B**3)
+    bytes_ = iters * nb * 4.0 * n * (B + k)
     ref_ms = 580_555.0  # TIMIT Block d=8192 (csv:25), n=2.2e6
     n_scale = n / 2_200_000.0
     return {
-        "n": n, "d": d, "k": k, "block_size": block, "num_iter": iters,
+        "n": n, "d": d, "k": k, "block_size": block,
+        "effective_block": B, "num_iter": iters,
         "fit_seconds": round(secs, 3),
         "scaled_fit_seconds_at_ref_n": round(secs / n_scale, 2),
         "reference_ms_16xr3.4xlarge": ref_ms,

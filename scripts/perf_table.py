@@ -1,0 +1,48 @@
+"""Render the stage/roofline/flagship tables from a bench record
+(BENCH_LAST_GOOD.json or a bench.py output line) as markdown for
+PERF.md.
+
+Usage: python scripts/perf_table.py [path=BENCH_LAST_GOOD.json]
+"""
+
+import json
+import sys
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_LAST_GOOD.json"
+    with open(path) as f:
+        text = f.read().strip()
+    if text.startswith("BENCH_DETAIL "):
+        text = text[len("BENCH_DETAIL "):]
+    rec = json.loads(text)
+    d = rec.get("detail", rec)
+    print(f"Headline: {rec.get('value')} img/s "
+          f"({d.get('train_seconds')} s e2e, vs_baseline "
+          f"{rec.get('vs_baseline')}x); test_accuracy "
+          f"{d.get('test_accuracy')} in band {d.get('accuracy_band')}\n")
+    stages = d.get("stages_seconds")
+    roofs = d.get("rooflines", {})
+    if stages:
+        print("| Stage | Seconds | GFLOP | GB | TFLOP/s | GB/s | %peak FLOP | %peak BW |")
+        print("|---|---|---|---|---|---|---|---|")
+        for name, secs in stages.items():
+            r = roofs.get(name, {})
+            print(f"| {name} | {secs} | {r.get('gflops','—')} | "
+                  f"{r.get('gbytes','—')} | {r.get('attained_tflops','—')} | "
+                  f"{r.get('attained_gbs','—')} | {r.get('pct_peak_flops','—')} | "
+                  f"{r.get('pct_peak_bw','—')} |")
+        print(f"| **sum** | **{d.get('stages_sum_seconds')}** | | | | | | |")
+    fl = d.get("flagship_bcd_d8192")
+    if fl:
+        r = fl.get("roofline", {})
+        print(f"\nFlagship BCD d={fl['d']} k={fl['k']} n={fl['n']} "
+              f"({fl['num_iter']} epochs x {-(-fl['d']//fl['block_size'])} blocks): "
+              f"{fl['fit_seconds']} s fit "
+              f"({r.get('attained_tflops')} TFLOP/s, {r.get('attained_gbs')} GB/s); "
+              f"n-scaled vs 16x r3.4xlarge reference: "
+              f"{fl.get('speedup_vs_reference_n_scaled')}x faster")
+
+
+if __name__ == "__main__":
+    main()
