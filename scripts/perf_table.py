@@ -17,10 +17,14 @@ def main():
         text = text[len("BENCH_DETAIL "):]
     rec = json.loads(text)
     d = rec.get("detail", rec)
-    print(f"Headline: {rec.get('value')} img/s "
-          f"({d.get('train_seconds')} s e2e, vs_baseline "
-          f"{rec.get('vs_baseline')}x); test_accuracy "
-          f"{d.get('test_accuracy')} in band {d.get('accuracy_band')}\n")
+    value = rec.get("value", d.get("images_per_sec"))
+    vsb = rec.get("vs_baseline")
+    vsb = f"{vsb}x" if vsb is not None else "n/a"
+    band = d.get("accuracy_band")
+    band_s = f" in band {band}" if band is not None else ""
+    print(f"Headline: {value} img/s ({d.get('train_seconds')} s e2e, "
+          f"vs_baseline {vsb}); test_accuracy "
+          f"{d.get('test_accuracy')}{band_s}\n")
     stages = d.get("stages_seconds")
     roofs = d.get("rooflines", {})
     if stages:

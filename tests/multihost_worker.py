@@ -81,6 +81,31 @@ with use_mesh(mesh):
 err_b = np.abs(Wb - W_ref).max() / max(np.abs(W_ref).max(), 1e-9)
 assert err_b < 5e-2, err_b
 
+# --- class-weighted BCD across hosts (SURVEY §2.7 class-partition row) --
+# One-hot ±1 labels with mixture_weight=0.5 so the per-class weighted
+# Gram path (class counts, per-class covariance blend) really runs;
+# the cross-host fit must match a single-host fit of the same global
+# problem (sharding changes layout, not math).
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.parallel.mesh import make_mesh
+
+cls = (np.arange(n_global) % 3)
+Yc = (2.0 * np.eye(3, dtype=np.float32)[cls] - 1.0).astype(np.float32)
+with use_mesh(mesh):
+    Ycds = multihost.dataset_from_process_local(Yc[lo:hi], mesh=mesh)
+    bwls = BlockWeightedLeastSquaresEstimator(
+        d, num_iter=8, lam=lam, mixture_weight=0.5
+    ).fit(Xds, Ycds)
+    Ww = np.asarray(bwls.W)
+with use_mesh(make_mesh(jax.local_devices()[:1])):
+    bwls1 = BlockWeightedLeastSquaresEstimator(
+        d, num_iter=8, lam=lam, mixture_weight=0.5
+    ).fit(Dataset(X), Dataset(Yc))
+    Ww1 = np.asarray(bwls1.W)
+err_w = np.abs(Ww - Ww1).max() / max(np.abs(Ww1).max(), 1e-9)
+assert err_w < 1e-3, f"cross-host BWLS diverged from single-host: {err_w}"
+
 # --- kernel ridge regression across hosts ------------------------------
 # XOR-style task (KernelModelSuite.scala:13-39): linearly inseparable,
 # so success requires the kernel path — permuted column blocks, the
@@ -122,8 +147,6 @@ from keystone_tpu.pipelines.random_patch_cifar import (
     RandomPatchCifarConfig,
     build_pipeline,
 )
-
-from keystone_tpu.parallel.mesh import make_mesh
 
 n_img = 64  # per the global job; each process contributes half
 # generate on a LOCAL 1-device mesh so the host copy below is
