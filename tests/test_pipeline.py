@@ -492,3 +492,60 @@ def test_label_estimator_with_data_raw_and_pipeline_data():
         Neg().to_pipeline()(train), Neg().to_pipeline()(labels)
     )
     assert pipe2(test).get().items == [1 - 15, 2 - 15]
+
+
+def test_gather_incremental_construction():
+    """PipelineSuite.scala:429-482: gathering already-fitted pipelines
+    reuses their fits; the gathered output matches each branch applied
+    separately for both single datums and datasets."""
+    from keystone_tpu import HostDataset
+
+    n_fits = [0]
+
+    class FirstAdder(Estimator):
+        def fit(self, data):
+            n_fits[0] += 1
+            first = data.items[0]
+
+            class A(Transformer):
+                def apply(self, x):
+                    return x + first
+
+            return A()
+
+    class FirstSumAdder(LabelEstimator):
+        def fit(self, data, labels):
+            n_fits[0] += 1
+            s = data.items[0] + int(labels.items[0])
+
+            class A(Transformer):
+                def apply(self, x):
+                    return x + s
+
+            return A()
+
+    fit_data = HostDataset([32, 94, 12])
+    first = Scale(2).to_pipeline() >> Add(-3)
+    second = Scale(2).to_pipeline().and_then(FirstAdder(), fit_data)
+    third = Scale(4).to_pipeline().and_then(
+        FirstSumAdder(), fit_data, HostDataset(["10", "7", "14"])
+    )
+
+    assert n_fits[0] == 0, "nothing may have been fit yet"
+    assert first(4).get() == 5
+    assert second(4).get() == 8 + 64
+    assert third(4).get() == 16 + (128 + 10)
+    assert n_fits[0] == 2, "both estimators fit by now"
+
+    gathered = Pipeline.gather([first, second, third])
+    single = 7
+    assert list(gathered(single).get()) == [
+        first(single).get(), second(single).get(), third(single).get()
+    ]
+    data = [13, 2, 83]
+    want = [
+        [first(x).get(), second(x).get(), third(x).get()] for x in data
+    ]
+    got = [list(row) for row in gathered(HostDataset(data)).get().items]
+    assert got == want
+    assert n_fits[0] == 2, "gather must not refit"
