@@ -98,7 +98,13 @@ def run_child(args, timeout_s: float):
                     phases.append(json.loads(line[len("BENCH_PHASE "):]))
                     log(f"phase: {phases[-1]}")
                 elif line.startswith("BENCH_DETAIL "):
+                    # The child emits a detail record per completed phase
+                    # (headline → staged → complete); keep the latest so
+                    # a mid-run wedge still yields a live partial record
+                    # instead of a stale fallback.
                     detail[0] = json.loads(line[len("BENCH_DETAIL "):])
+                    log(f"detail checkpoint: progress="
+                        f"{detail[0].get('progress', 'complete')}")
             except ValueError as e:
                 log(f"unparseable child line {line[:120]!r}: {e}")
 
@@ -113,15 +119,18 @@ def run_child(args, timeout_s: float):
             proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             log(f"child timed out after {timeout_s:.0f}s; killing")
-            return None, phases
+            proc.kill()
+            proc.wait()
+            # drain: a BENCH_DETAIL line may still sit unread in the pipe
+            reader.join(timeout=10.0)
+            return detail[0], phases
         reader.join(timeout=10.0)
         if proc.returncode != 0:
             log(f"child exited rc={proc.returncode}")
-            return None, phases
         return detail[0], phases
     except Exception as e:  # never let an exception skip the JSON record
         log(f"child failed: {e!r}")
-        return None, phases
+        return detail[0], phases
     finally:
         if proc is not None and proc.poll() is None:
             proc.kill()
@@ -155,8 +164,8 @@ def finalize_record(detail):
     rec = result_record(detail)
     if not detail.get("accuracy_in_band", True):
         rec["error"] = (
-            f"test_accuracy {detail.get('test_accuracy')} outside "
-            f"calibrated band {detail.get('accuracy_band')}")
+            f"test_accuracy {detail.get('test_accuracy')} below calibrated "
+            f"lower bound {detail.get('accuracy_band', [None])[0]}")
         return rec, False
     return rec, detail.get("platform") != "cpu"
 
@@ -187,6 +196,8 @@ def main():
 
     t_start = time.monotonic()
     error = None
+    best = None  # best LIVE (possibly partial) detail seen this window
+    progress_rank = {"headline": 1, "staged": 2, "complete": 3}
     for attempt in range(1, args.attempts + 1):
         remaining = args.deadline - (time.monotonic() - t_start)
         if remaining <= args.liveness_timeout:
@@ -203,20 +214,35 @@ def main():
         remaining = args.deadline - (time.monotonic() - t_start)
         detail, phases = run_child(args, min(args.run_timeout, remaining))
         if detail is not None:
-            rec, persist = finalize_record(detail)
-            if persist:
-                try:
-                    with open(LAST_GOOD, "w") as f:
-                        json.dump(rec, f, indent=1)
-                except OSError as e:
-                    log(f"could not persist last-good record: {e}")
-            emit(rec)
-            return 0
+            rank = progress_rank.get(detail.get("progress", "complete"), 0)
+            if best is None or rank >= progress_rank.get(
+                    best.get("progress", "complete"), 0):
+                best = detail
+            if rank >= 3:
+                rec, persist = finalize_record(detail)
+                if persist:
+                    try:
+                        with open(LAST_GOOD, "w") as f:
+                            json.dump(rec, f, indent=1)
+                    except OSError as e:
+                        log(f"could not persist last-good record: {e}")
+                emit(rec)
+                return 0
         error = ("workload run failed/timed out"
                  + (f"; last phase: {phases[-1]}" if phases else " before any phase"))
         if attempt < args.attempts:
             time.sleep(max(0.0, min(args.retry_wait,
                                     args.deadline - (time.monotonic() - t_start))))
+
+    if best is not None:
+        # A live-but-incomplete measurement beats a stale carry-over:
+        # emit it, marked partial, but never persist it as last-good.
+        rec, _ = finalize_record(best)
+        rec["partial"] = best.get("progress")
+        note = f"incomplete run ({best.get('progress')}): {error}"
+        rec["error"] = f"{rec['error']}; {note}" if "error" in rec else note
+        emit(rec)
+        return 0
 
     # Persistent failure: valid JSON with the last-known-good measurement.
     stale = None
@@ -252,7 +278,11 @@ def phase(name, **kw):
 # band, so solver-quality regressions (centering, BCD convergence,
 # precision) FAIL the bench instead of hiding behind a separable task.
 # Calibration (CPU mesh, 2026-07): noise=1.2/confusion=0.6 → test acc
-# 0.745-0.797 at n=2-3k, rising with n; chance = 0.10.
+# 0.745-0.797 at n=2-3k, rising with n; chance = 0.10. The regression
+# gate is ONE-SIDED (accuracy >= lower bound): the upper edge was
+# calibrated only at n=2-3k and accuracy legitimately rises with n, so a
+# good large-n run must not be stamped an error (ADVICE r3). The upper
+# bound stays informational in the record as acc_above_calibrated_band.
 BENCH_NOISE = 1.2
 BENCH_CONFUSION = 0.6
 ACC_BAND = (0.72, 0.96)
@@ -406,6 +436,32 @@ def child_main(args):
     phase("timed_done", seconds=round(elapsed, 3))
     test_metrics = evaluator(predictor(test.data), test.labels)
 
+    acc = test_metrics.accuracy
+    in_band = (not synthetic) or (acc >= ACC_BAND[0])
+    detail = {
+        "progress": "headline",
+        "n_train": train.data.count,
+        "train_seconds": round(elapsed, 3),
+        "images_per_sec": round(train.data.count / elapsed, 2),
+        "train_error": round(train_metrics.error, 4),
+        "test_accuracy": round(acc, 4),
+        "accuracy_band": list(ACC_BAND),
+        "accuracy_in_band": in_band,
+        "acc_above_calibrated_band": bool(synthetic and acc > ACC_BAND[1]),
+        "task_difficulty": {"noise": BENCH_NOISE, "confusion": BENCH_CONFUSION},
+        "num_filters": config.num_filters,
+        "synthetic": synthetic,
+        "platform": jax.devices()[0].platform,
+        "data_note": (None if not synthetic else
+                      "real CIFAR-10 binaries are not obtainable in this "
+                      "zero-egress environment; synthetic learnable task at "
+                      "identical shapes/scale with CALIBRATED difficulty "
+                      "(see BENCH notes in README)"),
+    }
+    # Checkpoint: a wedge during the staged/flagship phases still leaves
+    # a live headline measurement in the parent's hands.
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
     # Stage breakdown: same components, scalar-pull sync after each
     # stage, so the stages SUM to the staged end-to-end by construction
     # (VERDICT r2 #1/#4 — no unaccounted time).
@@ -439,8 +495,17 @@ def child_main(args):
                                   stages["predict_eval"]),
     }
 
-    acc = test_metrics.accuracy
-    in_band = (not synthetic) or (ACC_BAND[0] <= acc <= ACC_BAND[1])
+    total_flops = conv_flops + solve_flops
+    detail.update({
+        "progress": "staged",
+        "stages_seconds": {kk: round(vv, 4) for kk, vv in stages.items()},
+        "stages_sum_seconds": round(staged_total, 3),
+        "rooflines": rooflines,
+        "analytic_tflops": round(total_flops / 1e12, 2),
+        "mfu_vs_v5e_peak": round(total_flops / elapsed / V5E_PEAK_FLOPS, 4),
+    })
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
     flagship = None
     if not args.skip_flagship:
         phase("flagship_solver")
@@ -450,31 +515,7 @@ def child_main(args):
         )
         phase("flagship_done", seconds=flagship["fit_seconds"])
 
-    total_flops = conv_flops + solve_flops
-    detail = {
-        "n_train": train.data.count,
-        "train_seconds": round(elapsed, 3),
-        "images_per_sec": round(train.data.count / elapsed, 2),
-        "train_error": round(train_metrics.error, 4),
-        "test_accuracy": round(acc, 4),
-        "accuracy_band": list(ACC_BAND),
-        "accuracy_in_band": in_band,
-        "task_difficulty": {"noise": BENCH_NOISE, "confusion": BENCH_CONFUSION},
-        "num_filters": config.num_filters,
-        "stages_seconds": {kk: round(vv, 4) for kk, vv in stages.items()},
-        "stages_sum_seconds": round(staged_total, 3),
-        "rooflines": rooflines,
-        "flagship_bcd_d8192": flagship,
-        "analytic_tflops": round(total_flops / 1e12, 2),
-        "mfu_vs_v5e_peak": round(total_flops / elapsed / V5E_PEAK_FLOPS, 4),
-        "synthetic": synthetic,
-        "platform": jax.devices()[0].platform,
-        "data_note": (None if not synthetic else
-                      "real CIFAR-10 binaries are not obtainable in this "
-                      "zero-egress environment; synthetic learnable task at "
-                      "identical shapes/scale with CALIBRATED difficulty "
-                      "(see BENCH notes in README)"),
-    }
+    detail.update({"progress": "complete", "flagship_bcd_d8192": flagship})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
     return 0
 

@@ -45,9 +45,17 @@ def sync_pull(leaf) -> None:
     """THE scalar-pull sync idiom, in one place: transfer one element of
     a (device) array to host. `jax.block_until_ready` does not actually
     block through the axon tunnel (PERF.md methodology), so every honest
-    timing fence in the library routes through this helper."""
+    timing fence in the library routes through this helper.
+
+    In a multi-process job a cross-host global array's element-0 slice is
+    not addressable from every host, so np.asarray would raise; those
+    leaves fall back to block_until_ready (the tunnel pathology is a
+    single-host phenomenon — multihost runs use real local devices)."""
     if hasattr(leaf, "ndim") and hasattr(leaf, "dtype") and leaf.ndim > 0:
-        np.asarray(leaf[(0,) * leaf.ndim])
+        if getattr(leaf, "is_fully_addressable", True):
+            np.asarray(leaf[(0,) * leaf.ndim])
+        else:
+            jax.block_until_ready(leaf)
 
 
 class Dataset:

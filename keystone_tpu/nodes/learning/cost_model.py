@@ -46,6 +46,41 @@ _ANALYTIC = (ANALYTIC_CPU_WEIGHT, ANALYTIC_MEM_WEIGHT, ANALYTIC_NETWORK_WEIGHT)
 _weights_cache = None
 
 
+def _live_platform_no_init():
+    """Best-effort live JAX platform WITHOUT initializing a backend.
+
+    Backend initialization goes through the (wedge-prone) axon tunnel on
+    this machine, so merely constructing a LeastSquaresEstimator must not
+    trigger it. Order: (1) an already-initialized default backend,
+    (2) the configured jax_platforms setting / JAX_PLATFORMS env var
+    (first entry), (3) None — caller treats unknown as platform mismatch
+    and uses analytic weights; the (mode, platform)-keyed cache re-resolves
+    once a backend does exist.
+    """
+    import os
+
+    try:
+        from jax._src import xla_bridge as xb
+
+        backend = getattr(xb, "_default_backend", None)
+        if backend is not None:
+            return backend.platform
+    except Exception:
+        pass
+    try:
+        import jax
+
+        plats = jax.config.jax_platforms
+        if plats:
+            return str(plats).split(",")[0].strip() or None
+    except Exception:
+        pass
+    env = os.environ.get("JAX_PLATFORMS") or os.environ.get("JAX_PLATFORM_NAME")
+    if env:
+        return env.split(",")[0].strip() or None
+    return None
+
+
 def _resolve_weights():
     """Measured weights from tpu_calibration.json (committed with
     provenance; produced by calibrate.calibrate_cost_weights() on real
@@ -55,8 +90,12 @@ def _resolve_weights():
 
     KEYSTONE_COST_CALIBRATION=analytic ignores the file entirely;
     KEYSTONE_COST_CALIBRATION=force applies it regardless of platform.
-    Resolution is lazy (first weight access) so importing the package
-    never initializes a JAX backend through a possibly-wedged tunnel.
+    Resolution is lazy (first weight access) AND never initializes a JAX
+    backend: the platform check consults only an already-initialized
+    backend or the configured platform setting (_live_platform_no_init).
+    The cache is keyed on (mode, live_platform) so a later programmatic
+    platform flip (jax.config.update('jax_platforms', ...)) or first real
+    backend init re-resolves instead of freezing a stale decision.
     """
     global _weights_cache
     import json
@@ -64,10 +103,12 @@ def _resolve_weights():
     import os
 
     mode = os.environ.get("KEYSTONE_COST_CALIBRATION", "")
-    if _weights_cache is not None and _weights_cache[0] == mode:
+    live = None if mode in ("analytic", "force") else _live_platform_no_init()
+    cache_key = (mode, live)
+    if _weights_cache is not None and _weights_cache[0] == cache_key:
         return _weights_cache[1]
     if mode == "analytic":
-        _weights_cache = (mode, _ANALYTIC)
+        _weights_cache = (cache_key, _ANALYTIC)
         return _ANALYTIC
     path = os.path.join(os.path.dirname(__file__), "tpu_calibration.json")
     log = logging.getLogger(__name__)
@@ -82,30 +123,24 @@ def _resolve_weights():
         prov = cal.get("provenance")
         cal_platform = prov.get("platform") if isinstance(prov, dict) else None
     except FileNotFoundError:
-        _weights_cache = (mode, _ANALYTIC)
+        _weights_cache = (cache_key, _ANALYTIC)
         return _ANALYTIC
     except (OSError, KeyError, ValueError, TypeError, AttributeError) as e:
         log.warning(
             "cost-model calibration file %s exists but failed to parse "
             "(%s); falling back to analytic weights", path, e)
-        _weights_cache = (mode, _ANALYTIC)
+        _weights_cache = (cache_key, _ANALYTIC)
         return _ANALYTIC
-    if mode != "force":
-        try:
-            import jax
-
-            live = jax.default_backend()
-        except Exception:
-            live = None
-        if live != cal_platform:
-            log.info(
-                "cost-model calibration was measured on platform=%r but "
-                "backend is %r; using analytic weights "
-                "(KEYSTONE_COST_CALIBRATION=force to override)",
-                cal_platform, live)
-            _weights_cache = (mode, _ANALYTIC)
-            return _ANALYTIC
-    _weights_cache = (mode, weights)
+    if mode != "force" and (live is None or cal_platform is None
+                            or live != cal_platform):
+        log.info(
+            "cost-model calibration was measured on platform=%r but "
+            "the live/configured platform is %r; using analytic weights "
+            "(KEYSTONE_COST_CALIBRATION=force to override)",
+            cal_platform, live)
+        _weights_cache = (cache_key, _ANALYTIC)
+        return _ANALYTIC
+    _weights_cache = (cache_key, weights)
     return weights
 
 

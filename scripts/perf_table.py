@@ -17,6 +17,16 @@ def main():
         text = text[len("BENCH_DETAIL "):]
     rec = json.loads(text)
     d = rec.get("detail", rec)
+    # Incomplete / stale / error records must not render as clean results
+    flags = []
+    if rec.get("partial"):
+        flags.append(f"PARTIAL ({rec['partial']})")
+    if d.get("stale"):
+        flags.append("STALE carry-over")
+    if rec.get("error"):
+        flags.append(f"ERROR: {rec['error']}")
+    if flags:
+        print("**" + " | ".join(flags) + "**\n")
     value = rec.get("value", d.get("images_per_sec"))
     vsb = rec.get("vs_baseline")
     vsb = f"{vsb}x" if vsb is not None else "n/a"

@@ -139,8 +139,8 @@ def test_bench_band_gate():
     assert persist and "error" not in rec
 
     rec, persist = bench.finalize_record(
-        dict(base, test_accuracy=1.0, accuracy_in_band=False))
-    assert not persist and "outside calibrated band" in rec["error"]
+        dict(base, test_accuracy=0.3, accuracy_in_band=False))
+    assert not persist and "below calibrated lower bound" in rec["error"]
 
     rec, persist = bench.finalize_record(
         dict(base, platform="cpu", accuracy_in_band=True))
