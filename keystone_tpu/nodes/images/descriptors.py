@@ -18,7 +18,13 @@ import numpy as np
 from ...data.dataset import Dataset, HostDataset
 from ...utils.images import depthwise_conv2d
 from ...workflow.pipeline import Transformer
-from .sift import _gaussian_kernel
+def _gaussian_kernel(sigma: float):
+    """3-sigma-support normalized Gaussian taps (DAISY's blur layers;
+    distinct from SIFT's vl_imsmooth 4-sigma convention)."""
+    radius = max(int(np.ceil(3 * sigma)), 1)
+    x = np.arange(-radius, radius + 1, dtype=np.float32)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return k / k.sum()
 
 
 class _GridDescriptorExtractor(Transformer):

@@ -1,23 +1,43 @@
-"""Dense multi-scale SIFT.
+"""Dense multi-scale SIFT — vl_dsift fast-mode numerics, TPU-native.
 
 Reference: nodes/images/external/SIFTExtractor.scala:16-40 → JNI →
-VLFeat.cxx:1-292 (per scale: `vl_imsmooth_f` Gaussian smoothing,
-`vl_dsift_new_basic` + `vl_dsift_process` with the flat-window fast
-mode at :100-104, bounds offset so scales align :95-99; descriptors
-concatenated ×512 as jshort).
+VLFeat.cxx:40-210: per scale s, binSize = bin + 2s, sample step =
+step + s·scaleStep, `vl_imsmooth_f` of the ORIGINAL image with
+sigma = binSize/6 (magnif, VLFeat.cxx:45,87), bounds offset
+off = (1+2·numScales) − 3s so scales align (:95-99), vl_dsift in
+flat-window fast mode with windowSize 1.5 (:100-104), contrast
+threshold 0.005 zeroing (:63,140-147), descriptors transposed and
+×512 short-scaled with a 255 clamp (:252-259).
 
-TPU-native formulation (the vl_dsift fast path is already convolutional,
-so it maps directly onto XLA):
-  1. Gaussian-smooth the image per scale (separable depthwise conv).
-  2. Gradients via central differences; magnitude + orientation.
+The vl_dsift fast path is convolutional, so it maps directly onto XLA
+(one jitted program, vmapped over the batch):
+
+  1. Gaussian-smooth per scale: separable depthwise conv, support
+     ceil(4σ), edge-replicate padding (vl_imsmooth semantics).
+  2. Gradients: central differences inside, one-sided at borders
+     (dsift.c's update pass) — exactly `jnp.gradient`.
   3. Soft-assign magnitude into 8 orientation channels (linear
      interpolation between adjacent bins).
-  4. Flat-window spatial aggregation = box-filter conv per channel.
-  5. A 4×4 spatial grid of bins sampled at stride `step` gives each
-     descriptor; all descriptors of a scale are strided slices of the
-     aggregated maps — one gather, no per-keypoint loop.
-  6. L2 normalize → clamp 0.2 → renormalize → ×512 (vlfeat's short
-     scaling).
+  4. Spatial binning = per-channel TRIANGULAR convolution of unit
+     integral and half-width binSize, edge-replicate padding
+     (vl_imconvcoltri_f — bilinear bin interpolation under a flat
+     window), NOT a box filter.
+  5. Descriptors are strided gathers of the aggregated maps at bin
+     centers frame + bin·binSize; each spatial bin is reweighted by
+     the mean of a Gaussian window (σ = 1.5·binSize) over its support,
+     ×binSize (flat-window Gaussian reweighting).
+  6. L2 normalize (+VL_EPSILON_F) → clamp 0.2 → renormalize; zero
+     descriptors whose first-pass norm < 0.005; ×512, floor, clamp 255
+     (the JNI short quantization).
+
+The reference feeds vlfeat the TRANSPOSED image (Image.scala:89-104
+flattening with xDim = height) and un-transposes each descriptor at the
+end; this module computes the algebraically identical direct form: the
+output orientation bins land on atan2(d/drow, d/dcol) and the descriptor
+layout is [row-bin (slow), col-bin, orientation (fast)], with frames
+ordered column-outer / row-inner. Golden-tested against the scalar-loop
+oracle `tests/descriptor_reference_impls.vl_dsift_multiscale` (which
+implements the literal transposed pipeline) on a real image.
 
 Descriptor counts per (image size, params) are static, so the whole
 extractor is one jitted program and vmaps over the batch.
@@ -25,42 +45,67 @@ extractor is one jitted program and vmaps over the batch.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...data.dataset import Dataset, HostDataset
-from ...utils.images import depthwise_conv2d
+from ...data.dataset import HostDataset
 from ...workflow.pipeline import Transformer
 
 NUM_ORIENTATIONS = 8
 GRID = 4  # 4x4 spatial bins
+VL_EPSILON_F = 1.19209290e-07
+CONTRAST_THRESHOLD = 0.005  # VLFeat.cxx:63
+WINDOW_SIZE = 1.5           # VLFeat.cxx:104
+MAGNIF = 6.0                # VLFeat.cxx:45
 
 
-def _gaussian_kernel(sigma: float):
-    radius = max(int(np.ceil(3 * sigma)), 1)
-    x = np.arange(-radius, radius + 1, dtype=np.float32)
+def _gaussian_taps(sigma: float) -> np.ndarray:
+    """vl_imsmooth_f kernel: support ceil(4σ), normalized."""
+    radius = max(int(np.ceil(4.0 * sigma)), 1)
+    x = np.arange(-radius, radius + 1, dtype=np.float64)
     k = np.exp(-0.5 * (x / sigma) ** 2)
-    return k / k.sum()
+    return (k / k.sum()).astype(np.float32)
 
 
-def _sift_one_scale(gray, bin_size: int, step: int, sigma: float):
-    """All descriptors of one scale: (num_desc, 128)."""
-    if sigma > 0.01:
-        k = jnp.asarray(_gaussian_kernel(sigma))
-        gray = depthwise_conv2d(gray[:, :, None], k, k)[:, :, 0]
-    h, w = gray.shape
-    # central-difference gradients
-    dy = jnp.zeros_like(gray).at[1:-1, :].set((gray[2:, :] - gray[:-2, :]) * 0.5)
-    dx = jnp.zeros_like(gray).at[:, 1:-1].set((gray[:, 2:] - gray[:, :-2]) * 0.5)
+def _triangular_taps(bin_size: int) -> np.ndarray:
+    """vl_imconvcoltri_f kernel: unit integral, taps (bs−|k|)/bs²."""
+    bs = bin_size
+    k = (bs - np.abs(np.arange(-(bs - 1), bs))).astype(np.float64)
+    return (k / (bs * bs)).astype(np.float32)
+
+
+def _bin_window_mean(bin_size: int, bin_index: int) -> float:
+    """_vl_dsift_get_bin_window_mean × binSize: Gaussian-window mean over
+    the bin's triangular support, restoring unit kernel height."""
+    delta = bin_size * (bin_index - (GRID - 1) / 2.0)
+    sigma = bin_size * WINDOW_SIZE
+    xs = np.arange(-bin_size + 1, bin_size, dtype=np.float64)
+    return float(np.mean(np.exp(-0.5 * ((xs + delta) / sigma) ** 2))) * bin_size
+
+
+def _sep_conv_edge(maps, taps):
+    """Separable depthwise convolution with EDGE-REPLICATE padding
+    (VL_PAD_BY_CONTINUITY) over the two leading axes of (H, W, C)."""
+    from ...utils.images import depthwise_conv2d
+
+    return depthwise_conv2d(maps, taps, taps, padding="edge")
+
+
+def _sift_one_scale(gray, bin_size: int, step: int, off: int):
+    """All descriptors of one scale: (num_desc, 128) quantized floats."""
+    sigma = bin_size / MAGNIF
+    sm = _sep_conv_edge(gray[:, :, None], _gaussian_taps(sigma))[:, :, 0]
+    h, w = sm.shape
+    # gradients: central interior, one-sided borders (vl semantics ==
+    # jnp.gradient); dy is d/drow, dx is d/dcol
+    dy = jnp.gradient(sm, axis=0)
+    dx = jnp.gradient(sm, axis=1)
     mag = jnp.sqrt(dx * dx + dy * dy)
-    ang = jnp.arctan2(dy, dx)  # [-pi, pi]
+    ang = jnp.arctan2(dy, dx)
 
     # soft orientation binning: linear interp between adjacent bins
-    t = (ang / (2.0 * jnp.pi)) * NUM_ORIENTATIONS  # [-4, 4]
-    t = jnp.mod(t, NUM_ORIENTATIONS)
+    t = jnp.mod(ang / (2.0 * jnp.pi) * NUM_ORIENTATIONS, NUM_ORIENTATIONS)
     lo = jnp.floor(t)
     frac = t - lo
     lo = lo.astype(jnp.int32) % NUM_ORIENTATIONS
@@ -70,34 +115,34 @@ def _sift_one_scale(gray, bin_size: int, step: int, sigma: float):
         + jax.nn.one_hot(hi, NUM_ORIENTATIONS) * (mag * frac)[..., None]
     )  # (h, w, 8)
 
-    # flat-window spatial aggregation: box filter of bin_size
-    box = jnp.ones((bin_size,), jnp.float32)
-    agg = depthwise_conv2d(maps, box, box)  # (h, w, 8), same padding
+    # flat-window spatial binning: triangular conv per channel
+    agg = _sep_conv_edge(maps, _triangular_taps(bin_size))
 
-    # bin centers: a descriptor anchored at (y, x) covers 4 bins per axis
-    # spaced bin_size apart. Sample the aggregated maps at those centers.
-    span = GRID * bin_size  # descriptor footprint
-    n_y = max((h - span) // step + 1, 0)
-    n_x = max((w - span) // step + 1, 0)
-    off = bin_size // 2  # center of the first bin
-    ys = jnp.arange(n_y) * step + off
-    xs = jnp.arange(n_x) * step + off
+    # frames span [off, dim-1] with footprint 3·binSize+1
+    span = bin_size * (GRID - 1) + 1
+    n_r = max(((h - 1) - span + 1 - off) // step + 1, 0)
+    n_c = max(((w - 1) - span + 1 - off) // step + 1, 0)
+    rows = off + jnp.arange(n_r) * step
+    cols = off + jnp.arange(n_c) * step
     bin_off = jnp.arange(GRID) * bin_size
-    # (n_y, GRID) absolute bin-center rows; same for cols
-    yy = ys[:, None] + bin_off[None, :]
-    xx = xs[:, None] + bin_off[None, :]
-    # gather: descriptors (n_y, n_x, GRID, GRID, 8)
-    desc = agg[yy[:, None, :, None, None], xx[None, :, None, :, None],
+    rr = rows[:, None] + bin_off[None, :]  # (n_r, GRID) bin-center rows
+    cc = cols[:, None] + bin_off[None, :]
+    # gather, frames column-outer / row-inner (the reference's frame
+    # order): desc (n_c, n_r, GRID_row, GRID_col, 8)
+    desc = agg[rr[None, :, :, None, None], cc[:, None, None, :, None],
                jnp.arange(NUM_ORIENTATIONS)[None, None, None, None, :]]
-    desc = desc.reshape(n_y * n_x, GRID * GRID * NUM_ORIENTATIONS)
+    wmean = jnp.asarray([_bin_window_mean(bin_size, b) for b in range(GRID)])
+    desc = desc * wmean[None, None, :, None, None] * wmean[None, None, None, :, None]
+    desc = desc.reshape(n_c * n_r, GRID * GRID * NUM_ORIENTATIONS)
 
-    # vlfeat normalization: L2 -> clamp 0.2 -> L2 -> x512
-    norm = jnp.linalg.norm(desc, axis=1, keepdims=True)
-    desc = desc / jnp.maximum(norm, 1e-8)
+    # vl normalization: L2+eps -> clamp 0.2 -> L2+eps; contrast zeroing
+    norm = jnp.linalg.norm(desc, axis=1, keepdims=True) + VL_EPSILON_F
+    desc = desc / norm
     desc = jnp.minimum(desc, 0.2)
-    norm2 = jnp.linalg.norm(desc, axis=1, keepdims=True)
-    desc = desc / jnp.maximum(norm2, 1e-8)
-    return desc * 512.0
+    desc = desc / (jnp.linalg.norm(desc, axis=1, keepdims=True) + VL_EPSILON_F)
+    desc = jnp.where(norm < CONTRAST_THRESHOLD, 0.0, desc)
+    # JNI short quantization: floor(512·v) clamped to 255
+    return jnp.minimum(jnp.floor(512.0 * desc), 255.0)
 
 
 class SIFTExtractorInterface(Transformer):
@@ -105,15 +150,17 @@ class SIFTExtractorInterface(Transformer):
 
 
 class SIFTExtractor(SIFTExtractorInterface):
-    """Dense multi-scale SIFT: grayscale (H, W) or (H, W, 1) image →
-    (num_descriptors, 128) float matrix (the reference returns
-    DenseMatrix[Float] of shorts ×512; external/SIFTExtractor.scala:16-40).
+    """Dense multi-scale SIFT: grayscale (H, W) or (H, W, 1) image in
+    [0, 1] → (num_descriptors, 128) float matrix of quantized shorts in
+    [0, 255] (external/SIFTExtractor.scala:16-40 semantics, scales
+    concatenated).
 
-    scale_step doubles the bin size per scale; scales are aligned via the
-    shared grid origin (VLFeat.cxx:95-99 bounds offset).
+    Defaults mirror SIFTExtractor.scala:17 (step 3, bin 4, 4 scales,
+    scale_step 1); the reference's VLFeatSuite/enceval configuration uses
+    scale_step=0 (VLFeat.cxx:77-79 note).
     """
 
-    def __init__(self, step: int = 3, bin_size: int = 4, num_scales: int = 3,
+    def __init__(self, step: int = 3, bin_size: int = 4, num_scales: int = 4,
                  scale_step: int = 1):
         self.step = step
         self.bin_size = bin_size
@@ -121,17 +168,22 @@ class SIFTExtractor(SIFTExtractorInterface):
         self.scale_step = scale_step
 
     def _fn(self):
-        step, b0 = self.step, self.bin_size
-        scales = [b0 * (2 ** (s * self.scale_step)) for s in range(self.num_scales)]
+        step0, b0, S = self.step, self.bin_size, self.num_scales
+        scale_step = self.scale_step
 
         @jax.jit
         def fn(gray):
             if gray.ndim == 3:
                 gray = gray[:, :, 0]
             parts = []
-            for bin_size in scales:
-                sigma = bin_size / 3.0  # vl_dsift smoothing convention
-                parts.append(_sift_one_scale(gray, bin_size, step, sigma))
+            for s in range(S):
+                bin_size = b0 + 2 * s
+                step = step0 + s * scale_step
+                # clamp like vl_dsift clamps its bounds to the image:
+                # for num_scales >= 5 the raw offset goes negative, which
+                # would WRAP gather indices to the opposite image edge
+                off = max((1 + 2 * S) - 3 * s, 0)
+                parts.append(_sift_one_scale(gray, bin_size, step, off))
             return jnp.concatenate(parts, axis=0)
 
         return fn

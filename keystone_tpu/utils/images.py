@@ -67,24 +67,36 @@ def flip_horizontal(image):
 flip_horizontal.jax_traceable = True
 
 
-def depthwise_conv2d(image, kernel_y, kernel_x):
-    """Separable depthwise 2-D convolution, 'same' padding — one
+def depthwise_conv2d(image, kernel_y, kernel_x, padding: str = "same"):
+    """Separable depthwise 2-D convolution — one
     `lax.conv_general_dilated` per axis with `feature_group_count=C`
     (ImageUtils.conv2D's separable path — used by DAISY's Gaussian
-    blur layers)."""
+    blur layers and SIFT's vl_imsmooth/triangular binning).
+
+    padding: 'same' (zero pad, XLA SAME) or 'edge' (edge-replicate pad,
+    vlfeat VL_PAD_BY_CONTINUITY semantics)."""
     from jax import lax
 
-    img = jnp.asarray(image, jnp.float32)[None]  # (1, H, W, C)
+    img = jnp.asarray(image, jnp.float32)
+    ky = jnp.asarray(kernel_y, jnp.float32)
+    kx = jnp.asarray(kernel_x, jnp.float32)
+    if padding == "edge":
+        ry, rx = (len(ky) - 1) // 2, (len(kx) - 1) // 2
+        img = jnp.pad(img, ((ry, ry), (rx, rx), (0, 0)), mode="edge")
+        conv_pad = "VALID"
+    else:
+        conv_pad = "SAME"
+    img = img[None]  # (1, H, W, C)
     c = img.shape[-1]
-    ky = jnp.asarray(kernel_y, jnp.float32).reshape(-1, 1, 1, 1)
-    kx = jnp.asarray(kernel_x, jnp.float32).reshape(1, -1, 1, 1)
+    ky = ky.reshape(-1, 1, 1, 1)
+    kx = kx.reshape(1, -1, 1, 1)
     dn = lax.conv_dimension_numbers(img.shape, (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC"))
     out = lax.conv_general_dilated(
-        img, jnp.tile(ky, (1, 1, 1, c)), (1, 1), "SAME",
+        img, jnp.tile(ky, (1, 1, 1, c)), (1, 1), conv_pad,
         dimension_numbers=dn, feature_group_count=c,
     )
     out = lax.conv_general_dilated(
-        out, jnp.tile(kx, (1, 1, 1, c)), (1, 1), "SAME",
+        out, jnp.tile(kx, (1, 1, 1, c)), (1, 1), conv_pad,
         dimension_numbers=dn, feature_group_count=c,
     )
     return out[0]

