@@ -13,11 +13,22 @@ import numpy as np
 from .multiclass import MulticlassClassifierEvaluator, MulticlassMetrics
 
 
+def _borda(stacked: np.ndarray) -> np.ndarray:
+    """Borda rank-sum policy (AugmentedExamplesEvaluator.scala:27-34):
+    per variant, each class scores its rank in the ascending ordering of
+    that variant's score vector (0 = lowest); ranks sum across variants."""
+    order = np.argsort(stacked, axis=1, kind="stable")
+    ranks = np.empty_like(order)
+    cols = np.arange(stacked.shape[1])
+    np.put_along_axis(ranks, order, np.broadcast_to(cols, order.shape), axis=1)
+    return ranks.sum(axis=0).astype(np.float64)
+
+
 class AugmentedExamplesEvaluator:
     def __init__(self, num_classes: int, agg: str = "mean"):
         self.num_classes = num_classes
-        if agg not in ("mean", "max"):
-            raise ValueError("agg must be 'mean' or 'max'")
+        if agg not in ("mean", "max", "borda"):
+            raise ValueError("agg must be 'mean', 'max', or 'borda'")
         self.agg = agg
 
     def evaluate(self, ids: Sequence, scores, actuals) -> MulticlassMetrics:
@@ -44,11 +55,22 @@ class AugmentedExamplesEvaluator:
         labels = {}
         for i, ex_id in enumerate(ids):
             groups[ex_id].append(scores[i])
-            labels[ex_id] = int(actuals[i])
+            label = int(actuals[i])
+            if labels.setdefault(ex_id, label) != label:
+                # reference asserts one distinct label per name group
+                # (AugmentedExamplesEvaluator.scala:55)
+                raise ValueError(
+                    f"inconsistent labels within augmented group {ex_id!r}: "
+                    f"{labels[ex_id]} vs {label}")
         preds, trues = [], []
         for ex_id, rows in groups.items():
             stacked = np.stack(rows)
-            agg = stacked.mean(axis=0) if self.agg == "mean" else stacked.max(axis=0)
+            if self.agg == "mean":
+                agg = stacked.mean(axis=0)
+            elif self.agg == "max":
+                agg = stacked.max(axis=0)
+            else:
+                agg = _borda(stacked)
             preds.append(int(np.argmax(agg)))
             trues.append(labels[ex_id])
         return MulticlassClassifierEvaluator(self.num_classes)(preds, trues)

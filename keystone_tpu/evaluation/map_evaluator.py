@@ -37,7 +37,7 @@ class MeanAveragePrecisionEvaluator:
         for c in range(self.num_classes):
             y_true = np.array([c in set(np.atleast_1d(a).tolist()) for a in actuals])
             s = scores[:, c]
-            order = np.argsort(-s)
+            order = np.argsort(-s, kind="stable")
             tp = y_true[order]
             npos = tp.sum()
             if npos == 0:

@@ -53,7 +53,10 @@ def test_dense_sift_matches_vl_dsift_oracle(gray):
     diff = np.abs(got - want)
     frac_off = float(np.mean(diff > 1.0))
     assert frac_off < 0.005, f"{frac_off:.4%} of entries off by more than 1"
-    # stated max deviation: quantization flips at f32-vs-f64 bin edges
+    # Measured max deviation is 1 quantization level (f32-vs-f64 flips at
+    # floor(512·v) bin edges); 2 is an intentional guard band so benign
+    # compiler/platform reassociation doesn't flake the suite. The
+    # frac_off bound above is the tight fidelity assertion.
     assert diff.max() <= 2.0, diff.max()
     # and they genuinely vary across the image (not a degenerate match)
     assert np.std(want) > 1.0

@@ -1,11 +1,15 @@
 """Evaluator tests with hand-computed confusion matrices (model:
-reference MulticlassClassifierEvaluatorSuite / BinaryClassifierEvaluatorSuite)."""
+reference MulticlassClassifierEvaluatorSuite / BinaryClassifierEvaluatorSuite /
+MeanAveragePrecisionSuite / AugmentedExamplesEvaluator)."""
 
 import numpy as np
+import pytest
 
 from keystone_tpu import Dataset
 from keystone_tpu.evaluation import (
+    AugmentedExamplesEvaluator,
     BinaryClassifierEvaluator,
+    MeanAveragePrecisionEvaluator,
     MulticlassClassifierEvaluator,
 )
 
@@ -54,3 +58,113 @@ def test_binary_contingency():
     assert abs(m.precision - 2 / 3) < 1e-6
     assert abs(m.recall - 2 / 3) < 1e-6
     assert abs(m.accuracy - 3 / 5) < 1e-6
+
+
+# --------------------------------------------------------------------- mAP
+# (reference MeanAveragePrecisionSuite.scala:11-33 + adversarial edges)
+
+
+def test_map_reference_matlab_fixture():
+    """The reference suite's 4-class fixture with MATLAB-derived expected
+    APs (MeanAveragePrecisionSuite.scala:15-31)."""
+    actuals = [[0, 3], [2], [1, 2], [0]]
+    scores = np.array(
+        [
+            [0.1, -0.05, 0.12, 0.5],
+            [-0.23, -0.45, 0.23, 0.1],
+            [-0.34, -0.32, -0.66, 1.52],
+            [-0.1, -0.2, 0.5, 0.8],
+        ]
+    )
+    aps = MeanAveragePrecisionEvaluator(4)(scores, actuals)
+    np.testing.assert_allclose(aps, [1.0, 0.3333, 0.5, 0.3333], atol=1e-4)
+
+
+def test_map_tied_scores_stable_order():
+    """All scores equal: ranking degenerates to the (stable) original
+    order [pos at index 1 of 3] → precision [0, 1/2, 1/3], recall
+    [0, 1, 1]; max precision at every recall level is 1/2 → AP = 0.5."""
+    scores = np.array([[0.5], [0.5], [0.5]])
+    actuals = [[], [0], []]
+    aps = MeanAveragePrecisionEvaluator(1)(scores, actuals)
+    assert abs(aps[0] - 0.5) < 1e-9
+
+
+def test_map_all_positive_class_is_one():
+    """Every example positive → precision 1 at every rank → AP = 1
+    regardless of score ordering."""
+    scores = np.array([[0.1], [0.9], [0.5]])
+    actuals = [[0], [0], [0]]
+    aps = MeanAveragePrecisionEvaluator(1)(scores, actuals)
+    assert abs(aps[0] - 1.0) < 1e-9
+
+
+def test_map_single_example():
+    scores = np.array([[0.3, 0.7]])
+    aps = MeanAveragePrecisionEvaluator(2)(scores, [[1]])
+    assert aps[0] == 0.0 and abs(aps[1] - 1.0) < 1e-9
+
+
+def test_map_worst_ranking_hand_value():
+    """One positive ranked dead last of 3: precision [0, 0, 1/3], recall
+    [0, 0, 1] → max precision ≥ every recall level is 1/3 → AP = 1/3."""
+    scores = np.array([[0.9], [0.8], [0.1]])
+    actuals = [[], [], [0]]
+    aps = MeanAveragePrecisionEvaluator(1)(scores, actuals)
+    assert abs(aps[0] - 1 / 3) < 1e-9
+
+
+# -------------------------------------------------------- augmented examples
+# (reference AugmentedExamplesEvaluator.scala:16-69)
+
+
+def test_augmented_average_policy_hand_fixture():
+    """Two originals, two variants each; per-group mean then argmax
+    (dyadic values so the arithmetic is exact).
+    Group 'a': mean([0.75,0.125],[0.25,0.875]) = [0.5,0.5] → argmax tie
+    → class 0 (true 0, right).
+    Group 'b': mean([0.75,0.25],[0.25,0.25]) = [0.5,0.25] → class 0
+    (true 1, wrong — a single high-scoring variant outvotes) → acc 1/2."""
+    ids = ["a", "a", "b", "b"]
+    scores = np.array(
+        [[0.75, 0.125], [0.25, 0.875], [0.75, 0.25], [0.25, 0.25]]
+    )
+    actuals = [0, 0, 1, 1]
+    m = AugmentedExamplesEvaluator(2, agg="mean")(ids, scores, actuals)
+    assert m.total == 2.0
+    assert abs(m.accuracy - 0.5) < 1e-9
+
+
+def test_augmented_borda_policy_hand_fixture():
+    """Borda (AugmentedExamplesEvaluator.scala:27-34): per variant each
+    class scores its ascending-sort rank. Group 'a' variants [1,3,2] →
+    ranks [0,2,1]; [9,1,5] → ranks [2,0,1]; [2,8,4] → ranks [0,2,1].
+    Rank sums = [2,4,3] → argmax class 1, even though plain score-mean
+    ([4,4,11/3]) would tie classes 0/1 and argmax to 0."""
+    ids = ["a", "a", "a"]
+    scores = np.array([[1.0, 3.0, 2.0], [9.0, 1.0, 5.0], [2.0, 8.0, 4.0]])
+    actuals = [1, 1, 1]
+    m = AugmentedExamplesEvaluator(3, agg="borda")(ids, scores, actuals)
+    assert m.accuracy == 1.0
+    mean_m = AugmentedExamplesEvaluator(3, agg="mean")(ids, scores, actuals)
+    assert mean_m.accuracy == 0.0
+
+
+def test_augmented_inconsistent_group_labels_raise():
+    """Reference asserts one distinct label per name group
+    (AugmentedExamplesEvaluator.scala:55)."""
+    ids = ["a", "a"]
+    scores = np.array([[0.9, 0.1], [0.2, 0.8]])
+    with pytest.raises(ValueError, match="inconsistent labels"):
+        AugmentedExamplesEvaluator(2)(ids, scores, [0, 1])
+
+
+def test_augmented_single_variant_groups_match_plain_multiclass():
+    ids = [0, 1, 2]
+    scores = np.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+    actuals = [0, 1, 1]
+    m = AugmentedExamplesEvaluator(2)(ids, scores, actuals)
+    plain = MulticlassClassifierEvaluator(2)([0, 1, 0], actuals)
+    assert m.total == plain.total == 3.0
+    assert abs(m.accuracy - plain.accuracy) < 1e-9
+    np.testing.assert_array_equal(m.confusion, plain.confusion)
