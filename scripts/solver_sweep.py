@@ -31,7 +31,8 @@ import numpy as np
 # allow `python scripts/solver_sweep.py` without an installed package
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Reference rows (BASELINE.md, times in ms on 16x r3.4xlarge).
+# Reference rows (BASELINE.md / solver-comparisons-final.csv:1-27, times
+# in ms on 16x r3.4xlarge). The reference has no Exact row at d=16384.
 REFERENCE_MS = {
     ("timit", "exact", 1024): 7_323,
     ("timit", "block", 1024): 33_521,
@@ -42,9 +43,16 @@ REFERENCE_MS = {
     ("timit", "exact", 4096): 76_562,
     ("timit", "block", 4096): 120_998,
     ("timit", "lbfgs", 4096): 259_498,
+    ("timit", "exact", 8192): 315_183,
+    ("timit", "block", 8192): 255_570,
+    ("timit", "lbfgs", 8192): 810_286,
+    ("timit", "block", 16384): 580_555,
+    ("timit", "lbfgs", 16384): 1_589_308,
     ("amazon", "lbfgs", 1024): 33_704,
     ("amazon", "lbfgs", 2048): 33_643,
     ("amazon", "lbfgs", 4096): 40_606,
+    ("amazon", "lbfgs", 8192): 45_407,
+    ("amazon", "lbfgs", 16384): 52_290,
 }
 
 TIMIT_N, TIMIT_K = 2_200_000, 138  # constantEstimator.R:33-36
@@ -94,20 +102,35 @@ def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9):
     )
 
     rows = []
-    dims = (256,) if quick else (1024, 2048, 4096)
+    dims = (256,) if quick else (1024, 2048, 4096, 8192, 16384)
     n_full = 20_000 if quick else TIMIT_N
     k = TIMIT_K
     rng = np.random.default_rng(0)
+
+    import jax.numpy as jnp
+
+    def gen_problem(n, d, k, seed):
+        """Generate the regression problem ON DEVICE (jitted PRNG +
+        GEMM): host numpy generation + device_put of multi-GB arrays is
+        both slow through the tunnel and, if the process dies
+        mid-transfer, can wedge it (same rationale as bench._flagship_bcd)."""
+
+        @jax.jit
+        def make(key):
+            kx, kw, ke = jax.random.split(key, 3)
+            X = jax.random.normal(kx, (n, d), jnp.float32)
+            W = jax.random.normal(kw, (d, k), jnp.float32) * 0.1
+            Y = X @ W + 0.01 * jax.random.normal(ke, (n, k), jnp.float32)
+            return X, Y
+
+        X, Y = make(jax.random.PRNGKey(seed))
+        return Dataset(X), Dataset(Y)
 
     for d in dims:
         # fit (X, Y, residual copies ~3 n·d f32 buffers) in HBM
         n = min(n_full, int(hbm_budget_bytes / (3 * 4 * d)))
         n_scale = n / n_full
-        W_true = rng.normal(size=(d, k)).astype(np.float32) * 0.1
-        X = rng.normal(size=(n, d)).astype(np.float32)
-        Y = X @ W_true + 0.01 * rng.normal(size=(n, k)).astype(np.float32)
-        data, labels = Dataset(X), Dataset(Y)
-        del X, Y
+        data, labels = gen_problem(n, d, k, seed=d)
         solvers = {
             "exact": LinearMapEstimator(lam=1e-2),
             "block": BlockLeastSquaresEstimator(
@@ -176,11 +199,33 @@ def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9):
     }
 
 
+def write_csv(result, path):
+    """Emit the sweep in the reference table's column style
+    (solver-comparisons-final.csv header + our scaling columns)."""
+    import csv
+
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow([
+            "Experiment", "Solver", "Num Features", "n", "n_scale",
+            "Time (ms)", "Scaled Time at ref n (ms)",
+            "Reference (ms, 16x r3.4xlarge)", "Speedup vs reference",
+        ])
+        for r in result["rows"]:
+            w.writerow([
+                r["experiment"], r["solver"], r["d"], r["n"], r["n_scale"],
+                r["time_ms"], r["scaled_time_ms"],
+                r.get("reference_ms_16xr3.4xlarge") or "",
+                r.get("speedup_vs_reference") or "",
+            ])
+
+
 def main():
     import os
 
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="SOLVERS_BENCH.json")
+    p.add_argument("--csv", default="SOLVERS_SWEEP.csv")
     p.add_argument("--quick", action="store_true")
     args = p.parse_args()
     if os.environ.get("KEYSTONE_BACKEND") == "cpu":
@@ -192,7 +237,8 @@ def main():
     result = run_sweep(quick=args.quick)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
-    print(f"wrote {args.out} ({len(result['rows'])} rows)")
+    write_csv(result, args.csv)
+    print(f"wrote {args.out} + {args.csv} ({len(result['rows'])} rows)")
 
 
 if __name__ == "__main__":
