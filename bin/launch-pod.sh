@@ -1,0 +1,126 @@
+#!/usr/bin/env bash
+# Pod launcher — the TPU-native analog of the reference's push-button
+# cluster bring-up (bin/keystone-ec2.sh:1-14 + EC2.md:14-34: spark-ec2
+# provisions master+slaves with KeystoneML preinstalled). Here the
+# "cluster" is a Cloud TPU pod slice: `launch` provisions it (queued
+# resource or direct VM create), `push` rsyncs this repo to every host,
+# `run` starts one keystone_tpu process per host with the per-host
+# coordinator/process-id flags consumed by `python -m keystone_tpu`
+# (bin/run-pipeline.sh + keystone_tpu/__main__.py --coordinator/
+# --num-processes/--process-id -> parallel.init_multihost), and
+# `delete` tears it down.
+#
+#   ./bin/launch-pod.sh launch my-pod --accelerator v5litepod-16 \
+#       --zone us-west4-a --project my-proj [--spot] [--queued]
+#   ./bin/launch-pod.sh push   my-pod --zone ... --project ...
+#   ./bin/launch-pod.sh run    my-pod --zone ... --project ... -- \
+#       pipelines.images.cifar.RandomPatchCifar --num-filters 256
+#   ./bin/launch-pod.sh delete my-pod --zone ... --project ...
+#
+# --dry-run (or KEYSTONE_POD_DRY_RUN=1) prints every command instead of
+# executing — this is what the argument-assembly test drives; the gcloud
+# path needs a configured gcloud, which CI does not have.
+set -euo pipefail
+
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+
+usage() { sed -n '2,22p' "$0" | sed 's/^# \{0,1\}//'; exit 1; }
+
+[ $# -ge 2 ] || usage
+ACTION="$1"; NAME="$2"; shift 2
+
+ZONE=""; PROJECT=""; ACCEL="v5litepod-16"; VERSION="tpu-ubuntu2204-base"
+SPOT=0; QUEUED=0; DRY=${KEYSTONE_POD_DRY_RUN:-0}; PORT=8476
+REMOTE_DIR="/tmp/keystone_tpu"
+APP_ARGS=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --zone) ZONE="$2"; shift 2 ;;
+    --project) PROJECT="$2"; shift 2 ;;
+    --accelerator) ACCEL="$2"; shift 2 ;;
+    --version) VERSION="$2"; shift 2 ;;
+    --port) PORT="$2"; shift 2 ;;
+    --remote-dir) REMOTE_DIR="$2"; shift 2 ;;
+    --spot) SPOT=1; shift ;;
+    --queued) QUEUED=1; shift ;;
+    --dry-run) DRY=1; shift ;;
+    --) shift; APP_ARGS=("$@"); break ;;
+    *) echo "unknown flag: $1" >&2; usage ;;
+  esac
+done
+
+# chips from the accelerator suffix (v5litepod-16 -> 16); v5e packs 4
+# chips per host VM, so a v5litepod-16 slice is 4 worker hosts.
+CHIPS="${ACCEL##*-}"
+case "$ACCEL" in
+  v5litepod-*|v5e-*) CHIPS_PER_HOST=4 ;;
+  v4-*) CHIPS_PER_HOST=8 ;;  # v4 counts suffix in TensorCores (2/chip)
+  *) CHIPS_PER_HOST=4 ;;
+esac
+NUM_HOSTS=$(( (CHIPS + CHIPS_PER_HOST - 1) / CHIPS_PER_HOST ))
+[ "$NUM_HOSTS" -ge 1 ] || NUM_HOSTS=1
+
+run() {  # print in dry-run mode, execute otherwise
+  if [ "$DRY" = 1 ]; then
+    printf 'DRYRUN:'; printf ' %q' "$@"; printf '\n'
+  else
+    "$@"
+  fi
+}
+
+GCLOUD_COMMON=(--zone "$ZONE")
+[ -n "$PROJECT" ] && GCLOUD_COMMON+=(--project "$PROJECT")
+
+case "$ACTION" in
+  launch)
+    if [ "$QUEUED" = 1 ]; then
+      # queued resource: the way capacity is actually obtained for
+      # larger slices (waits in queue until the slice is available)
+      CMD=(gcloud compute tpus queued-resources create "$NAME"
+           --node-id "$NAME" "${GCLOUD_COMMON[@]}"
+           --accelerator-type "$ACCEL" --runtime-version "$VERSION")
+      [ "$SPOT" = 1 ] && CMD+=(--spot)
+    else
+      CMD=(gcloud compute tpus tpu-vm create "$NAME" "${GCLOUD_COMMON[@]}"
+           --accelerator-type "$ACCEL" --version "$VERSION")
+      [ "$SPOT" = 1 ] && CMD+=(--spot)
+    fi
+    run "${CMD[@]}"
+    echo "# next: $0 push $NAME --zone $ZONE ${PROJECT:+--project $PROJECT}"
+    ;;
+  push)
+    # distribute the package to every worker host (≈ spark-ec2's rsync
+    # of /root/keystone to the cluster, EC2.md:33-34)
+    run gcloud compute tpus tpu-vm scp --recurse "${GCLOUD_COMMON[@]}" \
+        --worker=all "$REPO_DIR" "$NAME":"$REMOTE_DIR"
+    ;;
+  run)
+    [ ${#APP_ARGS[@]} -gt 0 ] || { echo "run needs '-- <pipeline> [flags]'" >&2; exit 1; }
+    # host 0's name resolves inside the pod; workers reach the
+    # coordinator over the pod's internal network
+    COORD="${NAME}-0:${PORT}"
+    # shell-quote each app arg for the remote shell (spaces/metachars)
+    APP_Q=""
+    for a in "${APP_ARGS[@]}"; do APP_Q+=" $(printf '%q' "$a")"; done
+    for i in $(seq 0 $((NUM_HOSTS - 1))); do
+      REMOTE_CMD="cd $REMOTE_DIR && ./bin/run-pipeline.sh \
+--coordinator $COORD --num-processes $NUM_HOSTS --process-id $i$APP_Q"
+      if [ "$DRY" = 1 ]; then
+        # sequential in dry-run: backgrounded printfs can interleave
+        run gcloud compute tpus tpu-vm ssh "$NAME" "${GCLOUD_COMMON[@]}" \
+            --worker="$i" --command "$REMOTE_CMD"
+      else
+        run gcloud compute tpus tpu-vm ssh "$NAME" "${GCLOUD_COMMON[@]}" \
+            --worker="$i" --command "$REMOTE_CMD" &
+      fi
+    done
+    if [ "$DRY" != 1 ]; then
+      echo "# started $NUM_HOSTS processes (coordinator $COORD); waiting"
+      wait
+    fi
+    ;;
+  delete)
+    run gcloud compute tpus tpu-vm delete "$NAME" "${GCLOUD_COMMON[@]}" --quiet
+    ;;
+  *) usage ;;
+esac

@@ -1,0 +1,73 @@
+"""Dry-run tests of the pod launcher's argument assembly (VERDICT r3 #8;
+reference analog: bin/keystone-ec2.sh + EC2.md — provision, distribute,
+run with per-host flags)."""
+
+import subprocess
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "bin", "launch-pod.sh")
+
+
+def _run(*args):
+    # env-var dry-run: the flag form would land in APP_ARGS after "--"
+    env = dict(os.environ, KEYSTONE_POD_DRY_RUN="1")
+    r = subprocess.run(
+        [SCRIPT, *args],
+        capture_output=True, text=True, cwd=REPO, timeout=30, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    # undo the launcher's %q space-escaping for substring assertions
+    return [l.replace("\\ ", " ")
+            for l in r.stdout.splitlines() if l.startswith("DRYRUN:")]
+
+
+def test_launch_assembles_tpu_vm_create():
+    (line,) = _run("launch", "kp-test", "--zone", "us-west4-a",
+                   "--project", "proj", "--accelerator", "v5litepod-16")
+    assert "gcloud compute tpus tpu-vm create kp-test" in line
+    assert "--zone us-west4-a" in line and "--project proj" in line
+    assert "--accelerator-type v5litepod-16" in line
+
+
+def test_launch_queued_resource_with_spot():
+    (line,) = _run("launch", "kp-test", "--zone", "z", "--queued", "--spot")
+    assert "queued-resources create kp-test" in line
+    assert "--node-id kp-test" in line and "--spot" in line
+
+
+def test_push_distributes_repo_to_all_workers():
+    (line,) = _run("push", "kp-test", "--zone", "z")
+    assert "tpu-vm scp --recurse" in line
+    assert "--worker=all" in line
+    assert "kp-test:/tmp/keystone_tpu" in line
+
+
+def test_run_emits_one_process_per_host_with_coordinator_flags():
+    """v5litepod-16 = 4 hosts: process ids 0..3, all pointing at host 0's
+    coordinator, each invoking run-pipeline.sh with the multihost flags
+    keystone_tpu.__main__ consumes."""
+    lines = _run("run", "kp-test", "--zone", "z",
+                 "--accelerator", "v5litepod-16", "--",
+                 "pipelines.images.cifar.RandomPatchCifar",
+                 "--num-filters", "256")
+    assert len(lines) == 4
+    for i, line in enumerate(sorted(lines, key=lambda l: l.split("--worker=")[1])):
+        assert f"--worker={i}" in line
+        assert "--coordinator kp-test-0:8476" in line
+        assert "--num-processes 4" in line
+        assert f"--process-id {i}" in line
+        assert "run-pipeline.sh" in line
+        assert "RandomPatchCifar" in line and "--num-filters 256" in line
+
+
+def test_run_single_host_accelerator():
+    lines = _run("run", "kp", "--zone", "z", "--accelerator", "v5litepod-4",
+                 "--", "pipelines.speech.TimitPipeline")
+    assert len(lines) == 1
+    assert "--num-processes 1" in lines[0] and "--process-id 0" in lines[0]
+
+
+def test_delete():
+    (line,) = _run("delete", "kp-test", "--zone", "z")
+    assert "tpu-vm delete kp-test" in line and "--quiet" in line
