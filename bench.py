@@ -79,6 +79,8 @@ def run_child(args, timeout_s: float):
     ]
     if args.skip_flagship:
         cmd += ["--skip-flagship"]
+    if args.cifar_dir:
+        cmd += ["--cifar-dir", args.cifar_dir]
     if args.train_path:
         cmd += ["--train-path", args.train_path]
     if args.test_path:
@@ -163,9 +165,13 @@ def finalize_record(detail):
     the stale-fallback record; CPU runs never persist either."""
     rec = result_record(detail)
     if not detail.get("accuracy_in_band", True):
+        band = detail.get("accuracy_band") or [None]
+        bound = (band[0] if detail.get("synthetic", True)
+                 else (detail.get("north_star") or {}).get("target_accuracy"))
         rec["error"] = (
-            f"test_accuracy {detail.get('test_accuracy')} below calibrated "
-            f"lower bound {detail.get('accuracy_band', [None])[0]}")
+            f"test_accuracy {detail.get('test_accuracy')} below "
+            f"{'calibrated lower bound' if detail.get('synthetic', True) else 'north-star target'} "
+            f"{bound}")
         return rec, False
     return rec, detail.get("platform") != "cpu"
 
@@ -173,6 +179,12 @@ def finalize_record(detail):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--cifar-dir",
+                   help="directory with real CIFAR-10 binaries "
+                        "(data_batch_*.bin + test_batch.bin); when present "
+                        "the bench consumes them and asserts the north star "
+                        "(>=84%% accuracy, <60 s train); otherwise it falls "
+                        "back to the calibrated synthetic task")
     p.add_argument("--train-path")
     p.add_argument("--test-path")
     p.add_argument("--n-train", type=int, default=50_000)
@@ -402,9 +414,45 @@ def child_main(args):
           n=len(jax.devices()))
 
     config = RandomPatchCifarConfig(num_filters=args.num_filters)
-    if args.train_path:
-        train = cifar_loader(args.train_path)
-        test = cifar_loader(args.test_path or args.train_path)
+    train_path, test_path = args.train_path, args.test_path
+    north_star_gate = False  # the >=84% gate is calibrated for FULL
+    # CIFAR-10 via --cifar-dir; arbitrary --train-path data keeps the
+    # old always-pass behavior (no calibrated target exists for it)
+    if args.cifar_dir:
+        cdir = os.path.abspath(args.cifar_dir)
+        batches = sorted(
+            f for f in os.listdir(cdir)
+            if f.startswith("data_batch") and f.endswith(".bin")
+        ) if os.path.isdir(cdir) else []
+        tb = os.path.join(cdir, "test_batch.bin")
+        if batches and os.path.exists(tb):
+            # standard CIFAR-10 binary layout (CifarLoader.scala:13-52):
+            # the loader handles a directory of *.bin; point train at
+            # the data batches and test at the held-out batch
+            train_path = (os.path.join(cdir, batches[0])
+                          if len(batches) == 1 else cdir)
+            if len(batches) > 1:
+                # directory mode globs every .bin incl. test_batch; stage
+                # train batches alone via a temp dir of symlinks
+                import tempfile
+
+                tdir = tempfile.mkdtemp(prefix="cifar_train_")
+                for f in batches:
+                    os.symlink(os.path.join(cdir, f), os.path.join(tdir, f))
+                train_path = tdir
+            test_path = tb
+            north_star_gate = True
+        else:
+            # LOUD: a typo'd/empty --cifar-dir must not silently report
+            # calibrated-band success on the synthetic task
+            print(f"BENCH ERROR: --cifar-dir {args.cifar_dir!r} has no "
+                  "data_batch_*.bin + test_batch.bin; refusing to fall "
+                  "back silently", file=sys.stderr, flush=True)
+            phase("cifar_dir_unusable", dir=args.cifar_dir)
+            return 2
+    if train_path:
+        train = cifar_loader(train_path)
+        test = cifar_loader(test_path or train_path)
         synthetic = False
     else:
         train, test = synthetic_cifar(
@@ -437,7 +485,24 @@ def child_main(args):
     test_metrics = evaluator(predictor(test.data), test.labels)
 
     acc = test_metrics.accuracy
-    in_band = (not synthetic) or (acc >= ACC_BAND[0])
+    north_star = None
+    if synthetic:
+        in_band = acc >= ACC_BAND[0]
+    elif not north_star_gate:
+        in_band = True  # ad-hoc --train-path data: no calibrated target
+    else:
+        # real CIFAR present: the driver-defined north star becomes the
+        # gate — >=84% test accuracy, <60 s train (BASELINE.md; the 60 s
+        # target is the v5e-16 pod budget, so single-chip time is
+        # recorded against it but only accuracy fails the record)
+        north_star = {
+            "target_accuracy": 0.84,
+            "target_seconds_v5e16": 60.0,
+            "accuracy_ok": bool(acc >= 0.84),
+            "train_seconds_single_chip": round(elapsed, 3),
+            "time_ok_single_chip": bool(elapsed < 60.0),
+        }
+        in_band = north_star["accuracy_ok"]
     detail = {
         "progress": "headline",
         "n_train": train.data.count,
@@ -445,7 +510,8 @@ def child_main(args):
         "images_per_sec": round(train.data.count / elapsed, 2),
         "train_error": round(train_metrics.error, 4),
         "test_accuracy": round(acc, 4),
-        "accuracy_band": list(ACC_BAND),
+        "accuracy_band": list(ACC_BAND) if synthetic else None,
+        "north_star": north_star,
         "accuracy_in_band": in_band,
         "acc_above_calibrated_band": bool(synthetic and acc > ACC_BAND[1]),
         "task_difficulty": {"noise": BENCH_NOISE, "confusion": BENCH_CONFUSION},
