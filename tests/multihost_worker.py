@@ -106,6 +106,29 @@ with use_mesh(make_mesh(jax.local_devices()[:1])):
 err_w = np.abs(Ww - Ww1).max() / max(np.abs(Ww1).max(), 1e-9)
 assert err_w < 1e-3, f"cross-host BWLS diverged from single-host: {err_w}"
 
+# --- distributed PCA (TSQR) across hosts -------------------------------
+# The per-shard QR runs on every device of both hosts; the R-combine and
+# SVD are replicated. Principal subspace must match the host-side SVD of
+# the same global matrix (columns up to the sign convention, which
+# _sign_convention pins).
+from keystone_tpu.nodes.learning import DistributedPCAEstimator
+
+rng_p = np.random.default_rng(2)
+Xp = (rng_p.normal(size=(48, 5)) * np.array([4.0, 2.0, 1.0, 0.5, 0.1])).astype(
+    np.float32
+)
+lo_p, hi_p = proc_id * 24, (proc_id + 1) * 24
+with use_mesh(mesh):
+    Xpds = multihost.dataset_from_process_local(Xp[lo_p:hi_p], mesh=mesh)
+    V = np.asarray(DistributedPCAEstimator(dims=3).fit(Xpds).components)
+Xc = Xp - Xp.mean(axis=0)
+_, _, Vt_ref = np.linalg.svd(Xc, full_matrices=False)
+V_ref = Vt_ref.T[:, :3]
+# compare subspaces column-by-column up to sign
+for j in range(3):
+    dot = abs(float(V[:, j] @ V_ref[:, j]))
+    assert dot > 0.999, f"distributed PCA col {j} off: |cos|={dot}"
+
 # --- kernel ridge regression across hosts ------------------------------
 # XOR-style task (KernelModelSuite.scala:13-39): linearly inseparable,
 # so success requires the kernel path — permuted column blocks, the
