@@ -189,9 +189,33 @@ _LEMMA_EXCEPTIONS = {
 _VOWELS = "aeiou"
 
 
+# Stems that do NOT take a silent e after -ed/-ing stripping: the
+# common unstressed-final-syllable verbs (visit+ed -> visit, not
+# visite). English stress is not recoverable from spelling, so this is
+# a closed exception set over the frequent cases — the DEFAULT restores
+# the e, which is right for the much larger -ite/-ide/-ape/-ose class
+# (invited -> invite, decided -> decide, escaped -> escape).
+_NO_E_STEMS = {
+    "visit", "edit", "exit", "audit", "limit", "profit", "credit",
+    "orbit", "open", "offer", "enter", "happen", "listen", "deliver",
+    "consider", "remember", "suffer", "differ", "gather", "wonder",
+    "answer", "cover", "discover", "recover", "travel", "cancel",
+    "model", "level", "label", "develop", "benefit", "interpret",
+    "market", "target", "budget", "number", "order", "iron", "season",
+    "reason", "pilot", "elicit", "inherit", "borrow", "follow",
+}
+
+
 def _restore_e(stem: str) -> str:
-    """mak -> make, writ -> write: consonant-vowel-consonant stems whose
-    final consonant isn't doubled usually dropped a silent e."""
+    """mak -> make, invit -> invite: consonant-vowel-consonant stems
+    whose final consonant isn't doubled usually dropped a silent e;
+    `_NO_E_STEMS` lists the frequent unstressed-final-syllable verbs
+    that didn't. Stems ending in v/z (believ, siz) virtually always
+    take the e back."""
+    if stem in _NO_E_STEMS:
+        return stem
+    if len(stem) >= 3 and stem[-1] in "vz" and stem[-2] in _VOWELS:
+        return stem + "e"
     if (
         len(stem) >= 3
         and stem[-1] not in _VOWELS + "wxy"

@@ -56,17 +56,18 @@ class _RectifyPoolStage(Transformer):
         )[0]
 
     def fuse(self):
-        from ...ops import use_pallas
+        from ...ops import use_rectify_pallas
 
         a, mv, p, s = self.alpha, self.max_val, self.pool, self.stride
-        pal = use_pallas()  # part of the key: flag flips must not reuse
-        # the other path's cached program
+        pal = use_rectify_pallas()  # part of the key: flag flips must
+        # not reuse the other path's cached program
 
         def fn(params, x):
-            from ...ops import rectify_pool_pallas, rectify_pool_reference
+            # the dispatcher picks the VMEM-safe block size
+            from ...ops import rectify_pool, rectify_pool_reference
 
             if pal:
-                return rectify_pool_pallas(x, a, mv, p, s)
+                return rectify_pool(x, a, mv, p, s)
             return rectify_pool_reference(x, a, mv, p, s)
 
         return (("RectifyPool", a, mv, p, s, pal), (), fn)

@@ -15,6 +15,7 @@ from .pallas_kernels import (
     rectify_pool_reference,
     use_fused_conv,
     use_pallas,
+    use_rectify_pallas,
 )
 
 __all__ = [
@@ -32,4 +33,5 @@ __all__ = [
     "rectify_pool_reference",
     "use_fused_conv",
     "use_pallas",
+    "use_rectify_pallas",
 ]

@@ -20,15 +20,22 @@ Every op has `*_reference` (pure jnp — the XLA path, also the CPU/test
 oracle) and a dispatcher. Kernels are runnable in interpret mode on CPU
 for unit tests.
 
-**Measured on v5e (1 chip, 2026-07):** XLA's own fusion already reaches
-parity on both ops — rectify+pool (2048×27×27×256): XLA ~15 ms vs
-Pallas ~15.8 ms per pass; RBF block (8192×2048, d=1024, HIGHEST):
-XLA 8.04 ms vs Pallas 8.26 ms; end-to-end RandomPatchCifar bench is
-~20 % *slower* with the Pallas featurizer path (the 4-image grid blocks
-pay DMA overhead XLA's fused reduce_window avoids). The dispatchers
-therefore default to the XLA paths; set `KEYSTONE_ENABLE_PALLAS=1` to
-route to the Pallas kernels on TPU (e.g. to re-measure on larger pods
-or future toolchains where the fusion trade-off may flip).
+**Measured on v5e (1 chip, round 4, 2026-07-30; fresh-valued chained
+timing — the transport memoizes byte-identical executions, so earlier
+repeat-same-values timings were unreliable):**
+
+- rectify+pool: Pallas wins at EVERY measured shape —
+  (2048,27,27,256): 23.2 vs 25.4 ms; (512,27,27,512): 8.3 vs 12.8 ms
+  (1.54×); (4096,13,13,128): 6.3 vs 7.9 ms; (1024,54,54,64): 11.2 vs
+  12.4 ms. → **default-ON on TPU** (`KEYSTONE_DISABLE_PALLAS_RECTIFY=1`
+  reverts). Round 2's parity readings came from the memo-tainted
+  methodology.
+- RBF block: parity across shapes — (8192×2048,d=1024): 5.36 vs
+  5.13 ms; (32768×1024,d=256): 4.85 vs 4.75; (4096×4096,d=2048): 10.4
+  vs 11.0; (16384×512,d=64): 2.10 vs 2.12. → stays opt-in
+  (`KEYSTONE_ENABLE_PALLAS=1`), kept because the VMEM-epilogue
+  structure is the right shape for pods/toolchains where XLA's fusion
+  regresses, with parity documented here.
 """
 
 from __future__ import annotations
@@ -48,9 +55,22 @@ def _round_up(x: int, m: int) -> int:
 
 
 def use_pallas() -> bool:
-    """Trace-time gate: Pallas kernels are opt-in (see module docstring
-    for the measured XLA-parity rationale) and TPU-only."""
+    """Trace-time gate for the RBF kernel: opt-in (measured XLA parity,
+    module docstring) and TPU-only."""
     if os.environ.get("KEYSTONE_ENABLE_PALLAS") != "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def use_rectify_pallas() -> bool:
+    """Trace-time gate for the standalone rectify+pool kernel:
+    default-ON on TPU (measured 1.1-1.54× over XLA's fusion at every
+    shape point, module docstring); KEYSTONE_DISABLE_PALLAS_RECTIFY=1
+    reverts to the XLA path."""
+    if os.environ.get("KEYSTONE_DISABLE_PALLAS_RECTIFY") == "1":
         return False
     try:
         return jax.default_backend() == "tpu"
@@ -123,8 +143,8 @@ def rectify_pool_pallas(
 
 
 def rectify_pool(x, alpha: float, max_val: float, pool: int, stride: int):
-    """Dispatcher: Pallas on TPU, XLA elsewhere."""
-    if use_pallas():
+    """Dispatcher: Pallas on TPU (default-on), XLA elsewhere."""
+    if use_rectify_pallas():
         # VMEM budget: the pipelined input block is double-buffered, and
         # tiling pads the sublane dim (W) to 8 and the lane dim (K) to
         # 128 — keep the nominal input block under ~3 MB of the 16 MB VMEM
@@ -352,7 +372,11 @@ def _conv_rect_pool_kernel(
     *, alpha, max_val, d_real, k, normalize,
 ):
     pat = pat_ref[:]                                   # (b·posp, dp) bf16
-    z = jnp.dot(pat, g_ref[:], preferred_element_type=jnp.float32)
+    # precision pinned DEFAULT: bf16 operands under an ambient
+    # default_matmul_precision("highest") context would ask Mosaic for an
+    # fp32-contract bf16 matmul, which it rejects ("Bad lhs type")
+    z = jnp.dot(pat, g_ref[:], preferred_element_type=jnp.float32,
+                precision=lax.Precision.DEFAULT)
     if normalize:
         means = jnp.sum(pat.astype(jnp.float32), axis=1, keepdims=True) * (
             1.0 / d_real
@@ -383,19 +407,25 @@ def _fused_conv_block_images(posp: int, dp: int, k: int, cells: int) -> int:
     import math
 
     b = 8 // math.gcd(8, cells)  # smallest b with b·cells % 8 == 0
+    # Mosaic pads the lane (minor) dimension to 128: every (rows, k)
+    # f32 buffer really occupies (rows, round_up(k, 128)) of VMEM. For
+    # small k this is the dominant term — ignoring it produced a real
+    # scoped-vmem OOM at k=16 (21.5 MB actual vs 8.9 MB estimated).
+    kp = -(-k // 128) * 128
+    k2p = -(-(2 * k) // 128) * 128
     best = 0
     cand = b
     while cand <= 64:
         # peak liveness: z stays live throughout, but pos is dead before
         # neg materializes (each is consumed by its pool dot), so two
-        # (b·posp, k) f32 buffers, not three; the 10 MB cap of the 16 MB
-        # VMEM absorbs scheduling slop
+        # (b·posp, kp) f32 buffers, not three; the 10 MB cap of the
+        # 16 MB VMEM absorbs scheduling slop
         bytes_needed = (
             2 * cand * posp * dp * 2          # patches, double-buffered bf16
-            + 2 * cand * posp * k * 4         # z + one rectified sign (f32)
-            + 2 * cand * cells * 2 * k * 4    # pooled out, double-buffered
+            + 2 * cand * posp * kp * 4        # z + one rectified sign (f32)
+            + 2 * cand * cells * k2p * 4      # pooled out, double-buffered
             + cand * cells * cand * posp * 4  # pool matrix
-            + dp * k * 2
+            + dp * kp * 2
         )
         if bytes_needed > 10 * (1 << 20):
             break

@@ -64,16 +64,37 @@ class RandomPatchCifarConfig:
     synth_test: int = 500
 
 
-def _learn_filters_device(images, idx, sub_idx, filter_idx, eps, patch: int, step: int):
+def _learn_filters_device(images, key, eps, patch: int, step: int,
+                          n_valid: int, n_sample: int, m: int,
+                          num_filters: int):
     """The WHOLE filter-learning computation in one XLA program: sampled
     patch extraction + normalization, covariance, ZCA eigendecomposition,
     whitening, and filter selection. One dispatch, one packed transfer —
     per-call latency (not FLOPs) dominates this phase, so fusing the
     reference's driver-side LAPACK step (ZCAWhitener.scala:53-60) into
-    the device program is the win."""
+    the device program is the win. Sample indices are drawn ON DEVICE
+    from ``key`` (with replacement — statistically equivalent for
+    sampling 100k of ~360k patches): shipping fresh host-side index
+    arrays cost a measured ~93 ms per call through the tunnel, ~3/4 of
+    the whole phase."""
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
+    k_img, k_patch, k_filt = jax.random.split(key, 3)
+
+    def draw_without_replacement(k, pop: int, size: int):
+        # top-k over per-element uniforms ≡ a uniform no-replacement
+        # draw; compiles to a cheap partial selection (jax.random.choice
+        # with replace=False lowers to a full sort of the population)
+        _, picked = jax.lax.top_k(jax.random.uniform(k, (pop,)), size)
+        return picked
+
+    # without-replacement draws where duplicates would hurt (matching
+    # the replaced host-side rng.choice semantics — with-replacement
+    # filter selection would duplicate ~28% of runs' filters at
+    # 256-of-100k)
+    idx = draw_without_replacement(k_img, n_valid, n_sample)
     sel = jnp.take(images, idx, axis=0) / 255.0
     c = sel.shape[-1]
     # shared exact-extraction helper (HIGHEST precision, (ph, pw, C)
@@ -83,6 +104,10 @@ def _learn_filters_device(images, idx, sub_idx, filter_idx, eps, patch: int, ste
     flat = extract_patches_device(sel, patch, step).reshape(
         -1, patch * patch * c
     )
+    # patch subsample WITH replacement: collisions among 100k-of-364k
+    # only reweight a few patches of a covariance estimate (immaterial),
+    # and it avoids a full 364k selection in the program
+    sub_idx = jax.random.randint(k_patch, (m,), 0, flat.shape[0])
     flat = jnp.take(flat, sub_idx, axis=0)
     # normalizeRows(_, 10.0): subtract patch mean, divide by max(norm, 10/255)
     flat = flat - flat.mean(axis=1, keepdims=True)
@@ -103,6 +128,7 @@ def _learn_filters_device(images, idx, sub_idx, filter_idx, eps, patch: int, ste
     whitened = jnp.matmul(flat - mu, W, precision=lax.Precision.HIGHEST)
     wnorms = jnp.linalg.norm(whitened, axis=1, keepdims=True)
     whitened = whitened / jnp.maximum(wnorms, 1e-8)
+    filter_idx = draw_without_replacement(k_filt, m, num_filters)
     filters = jnp.take(whitened, filter_idx, axis=0)
     # pack: one host transfer instead of three (tunnel latency)
     return jnp.concatenate([filters.ravel(), W.ravel(), mu])
@@ -121,25 +147,27 @@ def learn_filters(train_data: Dataset, config) -> tuple:
 
     if _learn_filters_device_jit is None:
         _learn_filters_device_jit = jax.jit(
-            _learn_filters_device, static_argnames=("patch", "step")
+            _learn_filters_device,
+            static_argnames=("patch", "step", "n_valid", "n_sample", "m",
+                             "num_filters"),
         )
 
-    rng = np.random.default_rng(config.seed)
     n = train_data.count
     n_sample = min(n, max(config.sample_patches // 100, 64))
-    idx = np.sort(rng.choice(n, size=n_sample, replace=False))
     h, w, c = train_data.array.shape[1:]
     gy = (h - config.patch_size) // config.patch_steps + 1
     gx = (w - config.patch_size) // config.patch_steps + 1
     total = n_sample * gy * gx
     m = min(total, config.sample_patches)
-    sub_idx = rng.choice(total, size=m, replace=False)
-    filter_idx = rng.choice(m, size=config.num_filters, replace=False)
 
+    # only the 8-byte PRNG key crosses host->device: the index draws
+    # happen inside the program (a fresh 100k-index host array cost a
+    # measured ~93 ms per call through the tunnel)
     packed = _learn_filters_device_jit(
-        train_data.array, jnp.asarray(idx), jnp.asarray(sub_idx),
-        jnp.asarray(filter_idx), jnp.float32(0.1),
+        train_data.array, jax.random.PRNGKey(config.seed),
+        jnp.float32(0.1),
         patch=config.patch_size, step=config.patch_steps,
+        n_valid=n, n_sample=n_sample, m=m, num_filters=config.num_filters,
     )
     # stay on device: slicing the packed result is an async dispatch, so
     # pipeline construction never blocks on a host round trip (the
@@ -192,6 +220,174 @@ def build_pipeline(train, config):
         >> MaxClassifier()
     )
     return predictor
+
+
+def _fused_step(images, labels_i, count, test_images, test_labels_i,
+                test_count, key, *, config, h, w, c, n_valid, n_sample, m):
+    """The ENTIRE RandomPatchCifar training run as one traced
+    computation: filter learning → chunked fused featurization → scaler
+    folded algebraically into a single-block ridge solve → train/test
+    prediction + confusion. One XLA program, one device execution, one
+    packed host transfer.
+
+    This is the TPU-first collapse of the reference's driver-side
+    orchestration (RandomPatchCifar.scala:21-86): where Spark runs each
+    stage as a separate distributed job, XLA traces the whole fit into
+    one program, so the per-dispatch latency that dominates the staged
+    path (measured ~65-95 ms per executed program through this
+    environment's tunnel) is paid ONCE. Exactness: with block_size ≥ d
+    and num_iter=1 the pipeline's BCD is a single exact ridge solve on
+    scaled features; scaling by (μ, σ) is a linear reparameterization,
+    so Gram/cross terms are computed from raw features and rescaled —
+    same math, no second pass over X."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..nodes.images.core import Convolver
+    from ..nodes.learning.zca import ZCAWhitener
+    from ..ops import conv_rectify_pool
+
+    # --- filters (same program as learn_filters, inlined) --------------
+    packed = _learn_filters_device(
+        images, key, jnp.float32(0.1),
+        patch=config.patch_size, step=config.patch_steps,
+        n_valid=n_valid, n_sample=n_sample, m=m,
+        num_filters=config.num_filters,
+    )
+    D = config.patch_size * config.patch_size * c
+    K = config.num_filters
+    filters = packed[: K * D].reshape(K, D)
+    Wz = packed[K * D : K * D + D * D].reshape(D, D)
+    mu_z = packed[K * D + D * D :]
+    conv = Convolver(filters, h, w, c, whitener=ZCAWhitener(Wz, mu_z),
+                     normalize_patches=True)
+    kern, cs, bias = conv.kernel, conv.colsum, conv.bias
+
+    # --- chunked featurize (bounded HBM, same kernel as the pipeline) --
+    def featurize(imgs):
+        n = imgs.shape[0]
+        chunk = min(config.microbatch, n)
+        n_chunks = -(-n // chunk)
+        padded = n_chunks * chunk
+        if padded != n:
+            imgs = jnp.pad(imgs, ((0, padded - n), (0, 0), (0, 0), (0, 0)))
+        xs = imgs.reshape((n_chunks, chunk) + imgs.shape[1:])
+
+        def one(xb):
+            pooled = conv_rectify_pool(
+                xb / 255.0, kern, cs, bias, config.alpha, 0.0,
+                config.pool_size, config.pool_stride, True,
+            )
+            return pooled.reshape(xb.shape[0], -1)
+
+        ys = lax.map(one, xs)
+        return ys.reshape(padded, -1)[:n]
+
+    X = featurize(images)
+    n_pad, d = X.shape
+    mask = (jnp.arange(n_pad) < count).astype(X.dtype)
+    X = X * mask[:, None]
+    Y = (2.0 * jax.nn.one_hot(labels_i, config.num_classes, dtype=X.dtype)
+         - 1.0) * mask[:, None]
+
+    with jax.default_matmul_precision("highest"):
+        # --- moments (the StandardScaler fit, one pass) ----------------
+        s = jnp.sum(X, axis=0)
+        s2 = jnp.sum(X * X, axis=0)
+        mu = s / count
+        var = (s2 - count * mu * mu) / jnp.maximum(count - 1.0, 1.0)
+        sd = jnp.sqrt(jnp.maximum(var, 0.0))
+        sd = jnp.where(sd == 0.0, 1.0, sd)
+        # --- scaled ridge from raw Gram --------------------------------
+        # Z = (X-μ)/σ over valid rows; ZᵀZ = D⁻¹(XᵀX − n μμᵀ)D⁻¹,
+        # ZᵀYc = D⁻¹(XᵀY − n μ ȳᵀ) — padded rows are zero in X AND Y.
+        G = X.T @ X
+        ym = jnp.sum(Y, axis=0) / count
+        Cxy = X.T @ Y
+        Gs = (G - count * jnp.outer(mu, mu)) / jnp.outer(sd, sd)
+        Cs = (Cxy - count * jnp.outer(mu, ym)) / sd[:, None]
+        A = Gs + config.lam * jnp.eye(d, dtype=X.dtype)
+        Ws = jax.scipy.linalg.solve(A, Cs, assume_a="pos")
+        # fold scaling back: ŷ = X W_raw + b_raw on RAW features
+        W_raw = Ws / sd[:, None]
+        b_raw = ym - (mu / sd) @ Ws
+
+        def confusion(feats, labels, m_mask):
+            scores = feats @ W_raw + b_raw
+            pred = jnp.argmax(scores, axis=-1)
+            oh_p = jax.nn.one_hot(pred, config.num_classes, dtype=jnp.float32)
+            oh_a = jax.nn.one_hot(labels, config.num_classes, dtype=jnp.float32)
+            return (oh_a * m_mask[:, None]).T @ oh_p
+
+        conf_train = confusion(X, labels_i, mask)
+    # test featurize outside the HIGHEST-precision context (the fused
+    # conv kernel pins its own bf16 GEMM precision)
+    Xt = featurize(test_images)
+    t_mask = (jnp.arange(Xt.shape[0]) < test_count).astype(X.dtype)
+    with jax.default_matmul_precision("highest"):
+        conf_test = confusion(Xt * t_mask[:, None], test_labels_i, t_mask)
+    return W_raw, b_raw, conf_train, conf_test
+
+
+_fused_step_jit_cache: dict = {}
+
+
+def run_fused(train, test, config):
+    """One-execution training run (see `_fused_step`). Returns a dict
+    with the fitted raw-feature model and train/test metrics computed
+    from the on-device confusion matrices."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..evaluation.multiclass import MulticlassMetrics
+
+    h, w, c = train.data.array.shape[1:]
+    n = train.data.count
+    n_sample = min(n, max(config.sample_patches // 100, 64))
+    gy = (h - config.patch_size) // config.patch_steps + 1
+    gx = (w - config.patch_size) // config.patch_steps + 1
+    m = min(n_sample * gy * gx, config.sample_patches)
+    # the fused path's single ridge solve is exactly the pipeline's BCD
+    # only when one block covers all features
+    gpy = (gy - config.pool_size) // config.pool_stride + 1
+    gpx = (gx - config.pool_size) // config.pool_stride + 1
+    d = gpy * gpx * 2 * config.num_filters
+    if config.block_size < d:
+        raise ValueError(
+            f"run_fused requires block_size >= d ({config.block_size} < {d}); "
+            "use the pipeline path (build_pipeline) for multi-block BCD")
+
+    # key on EVERY config field baked into the program via partial —
+    # solver/featurizer parameters included, else a second config would
+    # silently reuse the first's compiled fit
+    from dataclasses import astuple
+
+    key = (astuple(config), h, w, c, n, n_sample, m,
+           train.data.padded_count, test.data.padded_count,
+           test.data.count)
+    fn = _fused_step_jit_cache.get(key)
+    if fn is None:
+        from functools import partial
+
+        fn = jax.jit(partial(
+            _fused_step, config=config, h=h, w=w, c=c,
+            n_valid=n, n_sample=n_sample, m=m,
+        ))
+        _fused_step_jit_cache[key] = fn
+
+    W, b, conf_train, conf_test = fn(
+        train.data.array, train.labels.array, jnp.float32(train.data.count),
+        test.data.array, test.labels.array, jnp.float32(test.data.count),
+        jax.random.PRNGKey(config.seed),
+    )
+    train_m = MulticlassMetrics(np.asarray(conf_train))
+    test_m = MulticlassMetrics(np.asarray(conf_test))
+    return {
+        "W": W, "b": b,
+        "train_metrics": train_m, "test_metrics": test_m,
+        "train_error": train_m.error, "test_accuracy": test_m.accuracy,
+    }
 
 
 def _sync_leaf(x):

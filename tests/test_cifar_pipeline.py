@@ -145,6 +145,51 @@ def test_calibrated_difficulty_accuracy_band():
     assert 0.68 <= acc <= 0.92, f"accuracy {acc} left the calibrated band"
 
 
+def test_run_fused_matches_pipeline_path():
+    """`run_fused` collapses the whole fit (filters → featurize → scaler
+    → single-block ridge → eval) into ONE traced program; with
+    block_size ≥ d and num_iter=1 it must reproduce the pipeline path's
+    accuracy exactly (the scaler fold is a linear reparameterization,
+    not an approximation)."""
+    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+    from keystone_tpu.loaders.cifar_loader import synthetic_cifar
+    from keystone_tpu.pipelines.random_patch_cifar import (
+        RandomPatchCifarConfig,
+        build_pipeline,
+        run_fused,
+    )
+    from keystone_tpu.workflow import PipelineEnv
+
+    train, test = synthetic_cifar(1000, 500, seed=0, noise=1.2, confusion=0.6)
+    config = RandomPatchCifarConfig(num_filters=64)
+    res = run_fused(train, test, config)
+
+    PipelineEnv.reset()
+    ev = MulticlassClassifierEvaluator(10)
+    predictor = build_pipeline(train, config)
+    acc = ev(predictor(test.data), test.labels).accuracy
+    assert abs(res["test_accuracy"] - acc) < 0.02, (res["test_accuracy"], acc)
+    assert res["train_error"] < 0.2
+
+
+def test_fused_conv_vmem_accounting_lane_padding():
+    """The fused conv kernel's VMEM block chooser must lane-pad k to 128
+    (Mosaic pads the minor dim): ignoring it produced a real scoped-vmem
+    OOM at k=16 on v5e (21.5 MB actual vs 8.9 MB estimated)."""
+    from keystone_tpu.ops.pallas_kernels import _fused_conv_block_images
+
+    # CIFAR geometry: 27x27 valid conv -> posp=736, dp=128, cells=4
+    b16 = _fused_conv_block_images(736, 128, 16, 4)
+    b256 = _fused_conv_block_images(736, 128, 256, 4)
+    # k=16 must be budgeted like k=128 (lane padding) -> same block as
+    # an actual k=128; b=8 verified live on v5e (the pre-fix choice of
+    # b=14 OOM'd at 21.5 MB scoped)
+    b128 = _fused_conv_block_images(736, 128, 128, 4)
+    assert b16 == b128 == 8, (b16, b128)
+    # the flagship k=256 choice is unchanged by the fix (no perf drift)
+    assert b256 == 4, b256
+
+
 def test_bench_band_gate():
     """bench.py's record gate: out-of-band accuracy is marked as an
     error and never persists as the stale-fallback record; in-band TPU

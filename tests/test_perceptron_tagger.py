@@ -146,6 +146,12 @@ def test_lemmatizer_rules_and_exceptions():
     assert _lemma("cats") == "cat"           # plain -s
     assert _lemma("running") == "run"        # doubled consonant
     assert _lemma("making") == "make"        # silent-e restore
+    assert _lemma("visited") == "visit"      # no-e exception set
+    assert _lemma("opened") == "open"
+    assert _lemma("believed") == "believe"   # v-final always restores
+    assert _lemma("invited") == "invite"     # default restores the e
+    assert _lemma("decided") == "decide"
+    assert _lemma("escaped") == "escape"
     assert _lemma("studied") == "study"      # -ied -> y
     assert _lemma("walked") == "walk"
     assert _lemma("sizes") == "size"         # -zes: -ze stem class
