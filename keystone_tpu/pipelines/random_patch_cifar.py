@@ -451,7 +451,7 @@ def run_staged(train, config, evaluator):
     return stages, train_metrics, parts
 
 
-def run(config: RandomPatchCifarConfig):
+def run(config: RandomPatchCifarConfig, fused: bool = False):
     if config.train_path:
         train = cifar_loader(config.train_path)
         test = cifar_loader(config.test_path or config.train_path)
@@ -459,6 +459,29 @@ def run(config: RandomPatchCifarConfig):
         train, test = synthetic_cifar(
             config.synth_train, config.synth_test, config.num_classes, config.seed
         )
+
+    if fused:
+        # the whole fit as ONE XLA execution (run_fused docstring). The
+        # single program also featurizes+scores the TEST set, so the
+        # throughput is reported over train+test images — dividing only
+        # the train count by this window would deflate the rate ~17% on
+        # CIFAR shapes and make --fused incomparable to the default path
+        t0 = time.perf_counter()
+        res = run_fused(train, test, config)
+        t_total = time.perf_counter() - t0
+        test_metrics = res["test_metrics"]
+        n_imgs = train.data.count + test.data.count
+        return {
+            "train_error": res["train_error"],
+            "test_error": test_metrics.error,
+            "test_accuracy": test_metrics.accuracy,
+            "train_seconds": t_total,
+            "images_per_sec": n_imgs / t_total,
+            "rate_basis": "train+test images (fused program includes "
+                          "test featurize+eval)",
+            "summary": test_metrics.summary(),
+            "model": (res["W"], res["b"]),
+        }
 
     t0 = time.perf_counter()
     predictor = build_pipeline(train, config)
@@ -491,11 +514,16 @@ def main(argv=None):
     p.add_argument("--synth-train", dest="synth_train", type=int, default=2000)
     p.add_argument("--synth-test", dest="synth_test", type=int, default=500)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fused", action="store_true",
+                   help="run the whole fit as one XLA execution "
+                        "(single-block ridge; requires block_size >= d)")
     args = p.parse_args(argv)
+    fused = args.fused
+    del args.fused
     config = RandomPatchCifarConfig(
         **{k: v for k, v in vars(args).items() if v is not None}
     )
-    result = run(config)
+    result = run(config, fused=fused)
     print(result["summary"])
     print(
         f"train_error={result['train_error']:.4f} "

@@ -117,6 +117,29 @@ def test_lbfgs_dense_with_and_without_intercept(problem):
         np.testing.assert_allclose(W, Wref, atol=5e-2, rtol=5e-2)
 
 
+def test_sparse_gram_on_device_matches_dense():
+    """The on-device padded-CSR Gram (blockwise densify + MXU
+    accumulate) must equal the dense XᵀX / XᵀY / colsum — including
+    empty rows, ragged nnz, and a row count not divisible by the row
+    block (sentinel-column padding must contribute nothing)."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.nodes.learning.lbfgs import _sparse_gram_on_device
+
+    rng = np.random.default_rng(7)
+    n, d, k = 203, 37, 3
+    dense = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.08)
+    dense[5] = 0.0  # empty row
+    dense[77] = 0.0
+    X = sp.csr_matrix(dense.astype(np.float32))
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    G, C, s = _sparse_gram_on_device(X, Y, block_rows=64)
+    Xd = dense.astype(np.float32)
+    np.testing.assert_allclose(np.asarray(G), Xd.T @ Xd, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(C), Xd.T @ Y, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s), Xd.sum(axis=0), atol=1e-3)
+
+
 def test_sparse_lbfgs_gram_form_matches_ridge():
     import scipy.sparse as sp
 
