@@ -89,6 +89,7 @@ def run_child(args, timeout_s: float):
 
     phases = []
     detail = [None]
+    last_progress = [time.monotonic()]
 
     def consume(pipe):
         # Reader thread: a wedged child stops producing output without
@@ -98,6 +99,7 @@ def run_child(args, timeout_s: float):
             try:
                 if line.startswith("BENCH_PHASE "):
                     phases.append(json.loads(line[len("BENCH_PHASE "):]))
+                    last_progress[0] = time.monotonic()
                     log(f"phase: {phases[-1]}")
                 elif line.startswith("BENCH_DETAIL "):
                     # The child emits a detail record per completed phase
@@ -105,6 +107,7 @@ def run_child(args, timeout_s: float):
                     # a mid-run wedge still yields a live partial record
                     # instead of a stale fallback.
                     detail[0] = json.loads(line[len("BENCH_DETAIL "):])
+                    last_progress[0] = time.monotonic()
                     log(f"detail checkpoint: progress="
                         f"{detail[0].get('progress', 'complete')}")
             except ValueError as e:
@@ -117,15 +120,31 @@ def run_child(args, timeout_s: float):
         )
         reader = threading.Thread(target=consume, args=(proc.stdout,), daemon=True)
         reader.start()
-        try:
-            proc.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            log(f"child timed out after {timeout_s:.0f}s; killing")
-            proc.kill()
-            proc.wait()
-            # drain: a BENCH_DETAIL line may still sit unread in the pipe
-            reader.join(timeout=10.0)
-            return detail[0], phases
+        deadline = time.monotonic() + timeout_s
+        last_progress[0] = time.monotonic()
+        while True:
+            try:
+                proc.wait(timeout=5.0)
+                break
+            except subprocess.TimeoutExpired:
+                now = time.monotonic()
+                if now >= deadline:
+                    log(f"child timed out after {timeout_s:.0f}s; killing")
+                    proc.kill()
+                    proc.wait()
+                    reader.join(timeout=10.0)
+                    return detail[0], phases
+                # phase-progress watchdog: a tunnel wedge mid-compile
+                # stops phase markers without killing the child; killing
+                # early (instead of burning the whole run timeout) buys
+                # extra retries inside the driver's deadline
+                if now - last_progress[0] > args.phase_timeout:
+                    log(f"no phase progress for {args.phase_timeout:.0f}s "
+                        "(tunnel wedged mid-phase); killing child early")
+                    proc.kill()
+                    proc.wait()
+                    reader.join(timeout=10.0)
+                    return detail[0], phases
         reader.join(timeout=10.0)
         if proc.returncode != 0:
             log(f"child exited rc={proc.returncode}")
@@ -196,6 +215,10 @@ def main():
     p.add_argument("--skip-flagship", action="store_true")
     p.add_argument("--liveness-timeout", type=float, default=90.0)
     p.add_argument("--run-timeout", type=float, default=1500.0)
+    p.add_argument("--phase-timeout", type=float, default=480.0,
+                   help="kill the child if no phase marker arrives for "
+                        "this long (mid-phase tunnel wedge); generous "
+                        "enough for a cold multi-minute compile")
     p.add_argument("--retry-wait", type=float, default=120.0)
     p.add_argument("--attempts", type=int, default=3)
     p.add_argument("--deadline", type=float, default=2700.0,

@@ -191,12 +191,16 @@ class SparseLBFGSwithL2(LabelEstimator):
         Y = labels.numpy() if hasattr(labels, "numpy") else np.asarray(labels)
         n, d = X.shape
         k = Y.shape[1]
+        device_gram = None
         if sparse_in:
             # G/C/col_sum stay device arrays: a (d, d) Gram at d=16384 is
             # 1 GB — pulling it to host for the intercept correction and
             # pushing it back would reintroduce the O(d²) host traffic
-            # this path exists to avoid
-            G, C, col_sum = _sparse_gram_on_device(X, Y, self.block_rows)
+            # this path exists to avoid. Returns None when width-padding
+            # would blow up (outlier dense row) — host path below.
+            device_gram = _sparse_gram_on_device(X, Y, self.block_rows)
+        if device_gram is not None:
+            G, C, col_sum = device_gram
         else:
             G = np.zeros((d, d), np.float32)
             C = np.zeros((d, k), np.float32)
@@ -204,7 +208,10 @@ class SparseLBFGSwithL2(LabelEstimator):
             for start in range(0, n, self.block_rows):
                 Xb = X[start : start + self.block_rows]
                 Yb = Y[start : start + self.block_rows]
-                G += np.asarray(Xb.T @ Xb, np.float32)
+                Gb = Xb.T @ Xb
+                G += np.asarray(
+                    Gb.todense() if hasattr(Gb, "todense") else Gb, np.float32
+                )
                 C += np.asarray(Xb.T @ Yb, np.float32)
                 col_sum += np.asarray(Xb.sum(axis=0)).ravel()
         if self.fit_intercept:
@@ -268,7 +275,9 @@ def _sparse_gram_on_device(X, Y, block_rows: int):
     """Host CSR → width-padded (n, w) index/value arrays (one transfer)
     → on-device blockwise densify + MXU Gram. This is the TPU-native
     sparse reduction: the previous host-scipy Gram was d²-bound on CPU
-    (209 s at d=16384, n=500k vs ~seconds of MXU work)."""
+    (209 s at d=16384, n=500k vs ~seconds of MXU work). Returns None
+    when the width-padded form would be pathologically large (outlier
+    dense rows) — the caller falls back to the host path."""
     import numpy as np
     import scipy.sparse as sp
 
@@ -276,6 +285,17 @@ def _sparse_gram_on_device(X, Y, block_rows: int):
     n, d = X.shape
     lens = np.diff(X.indptr)
     w = max(1, int(lens.max()) if n else 1)
+    # Width-padding costs O(n·w): a single outlier dense row (a bias/ones
+    # column, one long document) would turn an O(nnz) problem into tens
+    # of GB of padding. Bail to the caller's host-scipy path when the
+    # padded form is much bigger than the data or just plain large —
+    # a row cannot be split across padded slots (the Gram needs each
+    # row's full outer product; splitting drops the cross terms).
+    padded_bytes = 8.0 * n * w
+    if padded_bytes > 4e9 or (
+        padded_bytes > 32e6 and padded_bytes > 16.0 * 8.0 * max(X.nnz, 1)
+    ):
+        return None
     # flat scatter positions: row r occupies slots [r*w, r*w + lens[r])
     row_ids = np.repeat(np.arange(n, dtype=np.int64), lens)
     pos_in_row = np.arange(X.nnz, dtype=np.int64) - np.repeat(

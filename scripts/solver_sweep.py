@@ -90,7 +90,8 @@ def _fit_once(est, data, labels):
     return (time.perf_counter() - t0) * 1e3
 
 
-def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9):
+def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9,
+              experiments: tuple = ("timit", "amazon")):
     import jax
 
     from keystone_tpu.data.dataset import Dataset
@@ -126,7 +127,7 @@ def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9):
         X, Y = make(jax.random.PRNGKey(seed))
         return Dataset(X), Dataset(Y)
 
-    for d in dims:
+    for d in (dims if "timit" in experiments else ()):
         # fit (X, Y, residual copies ~3 n·d f32 buffers) in HBM
         n = min(n_full, int(hbm_budget_bytes / (3 * 4 * d)))
         n_scale = n / n_full
@@ -158,7 +159,7 @@ def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9):
 
     # Amazon-shaped sparse: one pass to Gram form + on-device L-BFGS.
     amz_n_full = 20_000 if quick else AMAZON_N
-    for d in dims:
+    for d in (dims if "amazon" in experiments else ()):
         n = min(amz_n_full, 500_000 if not quick else 20_000)
         n_scale = n / amz_n_full
         import scipy.sparse as sp
@@ -227,6 +228,9 @@ def main():
     p.add_argument("--out", default="SOLVERS_BENCH.json")
     p.add_argument("--csv", default="SOLVERS_SWEEP.csv")
     p.add_argument("--quick", action="store_true")
+    p.add_argument("--experiments", nargs="+", default=["timit", "amazon"],
+                   choices=["timit", "amazon"],
+                   help="subset to run (e.g. re-measure amazon alone)")
     args = p.parse_args()
     if os.environ.get("KEYSTONE_BACKEND") == "cpu":
         # programmatic forcing works where env-var platform selection
@@ -234,7 +238,8 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    result = run_sweep(quick=args.quick)
+    result = run_sweep(quick=args.quick,
+                       experiments=tuple(args.experiments))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
     write_csv(result, args.csv)

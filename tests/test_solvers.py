@@ -140,6 +140,33 @@ def test_sparse_gram_on_device_matches_dense():
     np.testing.assert_allclose(np.asarray(s), Xd.sum(axis=0), atol=1e-3)
 
 
+def test_sparse_lbfgs_outlier_dense_row_falls_back_to_host():
+    """One fully-dense row (a ones/bias column pattern) makes the
+    width-padded device form O(n·d); the device path must decline and
+    the fit must still succeed via the host-scipy Gram path."""
+    import scipy.sparse as sp
+
+    from keystone_tpu.data.sparse import SparseDataset
+    from keystone_tpu.nodes.learning import SparseLBFGSwithL2
+    from keystone_tpu.nodes.learning.lbfgs import _sparse_gram_on_device
+
+    rng = np.random.default_rng(11)
+    n, d, k = 5000, 1000, 2
+    dense = (rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.002)).astype(
+        np.float32
+    )
+    dense[0] = 1.0  # outlier: one fully dense row -> w = d
+    X = sp.csr_matrix(dense)
+    # padded bytes = 8·n·d = 40 MB >> 16× the ~11k-nnz data -> declined
+    assert _sparse_gram_on_device(X, np.zeros((n, k), np.float32), 256) is None
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    model = SparseLBFGSwithL2(lam=1.0, num_iters=120).fit(
+        SparseDataset(X), Dataset(Y)
+    )
+    Wref, bref = ridge_closed_form(dense, Y, 1.0)
+    np.testing.assert_allclose(np.asarray(model.W), Wref, atol=1e-1, rtol=1e-1)
+
+
 def test_sparse_lbfgs_gram_form_matches_ridge():
     import scipy.sparse as sp
 
