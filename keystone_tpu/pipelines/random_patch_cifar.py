@@ -56,6 +56,7 @@ class RandomPatchCifarConfig:
     lam: float = 10.0
     sample_patches: int = 100_000
     block_size: int = 4096
+    bcd_iters: int = 1  # shared by the pipeline AND fused solve paths
     num_classes: int = 10
     microbatch: int = 2048
     seed: int = 0
@@ -213,7 +214,7 @@ def build_pipeline(train, config):
         featurizer
         .and_then(StandardScaler(), train.data)
         .and_then(
-            BlockLeastSquaresEstimator(config.block_size, num_iter=1, lam=config.lam),
+            BlockLeastSquaresEstimator(config.block_size, num_iter=config.bcd_iters, lam=config.lam),
             train.data,
             labels,
         )
@@ -223,10 +224,11 @@ def build_pipeline(train, config):
 
 
 def _fused_step(images, labels_i, count, test_images, test_labels_i,
-                test_count, key, *, config, h, w, c, n_valid, n_sample, m):
+                test_count, key, *, config, h, w, c, n_valid, n_sample, m,
+                x_sharding=None):
     """The ENTIRE RandomPatchCifar training run as one traced
     computation: filter learning → chunked fused featurization → scaler
-    folded algebraically into a single-block ridge solve → train/test
+    applied in-program, the pipeline's own BCD solve → train/test
     prediction + confusion. One XLA program, one device execution, one
     packed host transfer.
 
@@ -235,11 +237,11 @@ def _fused_step(images, labels_i, count, test_images, test_labels_i,
     stage as a separate distributed job, XLA traces the whole fit into
     one program, so the per-dispatch latency that dominates the staged
     path (measured ~65-95 ms per executed program through this
-    environment's tunnel) is paid ONCE. Exactness: with block_size ≥ d
-    and num_iter=1 the pipeline's BCD is a single exact ridge solve on
-    scaled features; scaling by (μ, σ) is a linear reparameterization,
-    so Gram/cross terms are computed from raw features and rescaled —
-    same math, no second pass over X."""
+    environment's tunnel) is paid ONCE. Exactness: the solve calls the
+    SAME `_bcd_fit_impl` the pipeline's BlockLeastSquaresEstimator jits
+    (on features scaled in-program), so it matches the pipeline path for
+    any block_size; the scaling is a linear reparameterization folded
+    back into a raw-feature (W, b) afterwards."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -299,19 +301,26 @@ def _fused_step(images, labels_i, count, test_images, test_labels_i,
         var = (s2 - count * mu * mu) / jnp.maximum(count - 1.0, 1.0)
         sd = jnp.sqrt(jnp.maximum(var, 0.0))
         sd = jnp.where(sd == 0.0, 1.0, sd)
-        # --- scaled ridge from raw Gram --------------------------------
-        # Z = (X-μ)/σ over valid rows; ZᵀZ = D⁻¹(XᵀX − n μμᵀ)D⁻¹,
-        # ZᵀYc = D⁻¹(XᵀY − n μ ȳᵀ) — padded rows are zero in X AND Y.
-        G = X.T @ X
-        ym = jnp.sum(Y, axis=0) / count
-        Cxy = X.T @ Y
-        Gs = (G - count * jnp.outer(mu, mu)) / jnp.outer(sd, sd)
-        Cs = (Cxy - count * jnp.outer(mu, ym)) / sd[:, None]
-        A = Gs + config.lam * jnp.eye(d, dtype=X.dtype)
-        Ws = jax.scipy.linalg.solve(A, Cs, assume_a="pos")
+        # --- the REAL block solver on scaled features ------------------
+        # same _bcd_fit_impl the pipeline's BlockLeastSquaresEstimator
+        # jits, so the fused path matches it for ANY block_size/num_iter
+        # (not just the single-block case)
+        from ..nodes.learning.block_ls import _bcd_fit_impl
+
+        Xs = ((X - mu) / sd) * mask[:, None]
+        B = min(config.block_size, d)
+        nb = -(-d // B)
+        d_pad = nb * B
+        if d_pad != d:
+            Xs = jnp.pad(Xs, ((0, 0), (0, d_pad - d)))
+        Ws_full, b_s = _bcd_fit_impl(
+            Xs, Y, mask, jnp.float32(config.lam),
+            B, nb, config.bcd_iters, True, x_sharding=x_sharding,
+        )
+        Ws = Ws_full[:d]
         # fold scaling back: ŷ = X W_raw + b_raw on RAW features
         W_raw = Ws / sd[:, None]
-        b_raw = ym - (mu / sd) @ Ws
+        b_raw = b_s - (mu / sd) @ Ws
 
         def confusion(feats, labels, m_mask):
             scores = feats @ W_raw + b_raw
@@ -348,16 +357,17 @@ def run_fused(train, test, config):
     gy = (h - config.patch_size) // config.patch_steps + 1
     gx = (w - config.patch_size) // config.patch_steps + 1
     m = min(n_sample * gy * gx, config.sample_patches)
-    # the fused path's single ridge solve is exactly the pipeline's BCD
-    # only when one block covers all features
+    # same dp×tp feature sharding the pipeline's solver constrains X
+    # with (block_ls.py) — on a ('data','model') mesh the scaled feature
+    # matrix model-shards instead of replicating its full width per chip
+    from ..parallel import mesh as meshlib
+
     gpy = (gy - config.pool_size) // config.pool_stride + 1
     gpx = (gx - config.pool_size) // config.pool_stride + 1
     d = gpy * gpx * 2 * config.num_filters
-    if config.block_size < d:
-        raise ValueError(
-            f"run_fused requires block_size >= d ({config.block_size} < {d}); "
-            "use the pipeline path (build_pipeline) for multi-block BCD")
-
+    B = min(config.block_size, d)
+    d_pad = -(-d // B) * B
+    x_sharding = meshlib.feature_sharding(train.data.mesh, d_pad)
     # key on EVERY config field baked into the program via partial —
     # solver/featurizer parameters included, else a second config would
     # silently reuse the first's compiled fit
@@ -365,14 +375,14 @@ def run_fused(train, test, config):
 
     key = (astuple(config), h, w, c, n, n_sample, m,
            train.data.padded_count, test.data.padded_count,
-           test.data.count)
+           test.data.count, x_sharding)
     fn = _fused_step_jit_cache.get(key)
     if fn is None:
         from functools import partial
 
         fn = jax.jit(partial(
             _fused_step, config=config, h=h, w=w, c=c,
-            n_valid=n, n_sample=n_sample, m=m,
+            n_valid=n, n_sample=n_sample, m=m, x_sharding=x_sharding,
         ))
         _fused_step_jit_cache[key] = fn
 
@@ -434,7 +444,7 @@ def run_staged(train, config, evaluator):
     t0 = t()
     labels = ClassLabelIndicatorsFromInt(config.num_classes)(train.labels).get()
     model = BlockLeastSquaresEstimator(
-        config.block_size, num_iter=1, lam=config.lam
+        config.block_size, num_iter=config.bcd_iters, lam=config.lam
     ).fit(scaled, labels)
     _sync_leaf(model.W)
     stages["bcd_solve"] = t() - t0
@@ -516,7 +526,7 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--fused", action="store_true",
                    help="run the whole fit as one XLA execution "
-                        "(single-block ridge; requires block_size >= d)")
+                        "(same BCD solve as the pipeline path)")
     args = p.parse_args(argv)
     fused = args.fused
     del args.fused

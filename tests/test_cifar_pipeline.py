@@ -172,6 +172,31 @@ def test_run_fused_matches_pipeline_path():
     assert res["train_error"] < 0.2
 
 
+def test_run_fused_multiblock_matches_pipeline():
+    """The fused path calls the SAME _bcd_fit_impl as the pipeline's
+    BlockLeastSquaresEstimator, so it must agree even when block_size <
+    d (multi-block coordinate descent, not a single ridge solve)."""
+    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+    from keystone_tpu.loaders.cifar_loader import synthetic_cifar
+    from keystone_tpu.pipelines.random_patch_cifar import (
+        RandomPatchCifarConfig,
+        build_pipeline,
+        run_fused,
+    )
+    from keystone_tpu.workflow import PipelineEnv
+
+    train, test = synthetic_cifar(600, 300, seed=1, noise=1.2, confusion=0.6)
+    # d = 2·2·2·32 = 256 features; block_size=64 -> 4 BCD blocks
+    config = RandomPatchCifarConfig(num_filters=32, block_size=64)
+    res = run_fused(train, test, config)
+
+    PipelineEnv.reset()
+    ev = MulticlassClassifierEvaluator(10)
+    predictor = build_pipeline(train, config)
+    acc = ev(predictor(test.data), test.labels).accuracy
+    assert abs(res["test_accuracy"] - acc) < 0.02, (res["test_accuracy"], acc)
+
+
 def test_fused_conv_vmem_accounting_lane_padding():
     """The fused conv kernel's VMEM block chooser must lane-pad k to 128
     (Mosaic pads the minor dim): ignoring it produced a real scoped-vmem
