@@ -225,7 +225,7 @@ def build_pipeline(train, config):
 
 def _fused_step(images, labels_i, count, test_images, test_labels_i,
                 test_count, key, *, config, h, w, c, n_valid, n_sample, m,
-                x_sharding=None):
+                mesh=None):
     """The ENTIRE RandomPatchCifar training run as one traced
     computation: filter learning → chunked fused featurization → scaler
     applied in-program, the pipeline's own BCD solve → train/test
@@ -313,6 +313,11 @@ def _fused_step(images, labels_i, count, test_images, test_labels_i,
         d_pad = nb * B
         if d_pad != d:
             Xs = jnp.pad(Xs, ((0, 0), (0, d_pad - d)))
+        # same dp×tp feature sharding the pipeline's solver constrains X
+        # with, built from the REAL featurized width (not re-derived)
+        from ..parallel import mesh as meshlib
+
+        x_sharding = meshlib.feature_sharding(mesh, d_pad) if mesh else None
         Ws_full, b_s = _bcd_fit_impl(
             Xs, Y, mask, jnp.float32(config.lam),
             B, nb, config.bcd_iters, True, x_sharding=x_sharding,
@@ -357,32 +362,24 @@ def run_fused(train, test, config):
     gy = (h - config.patch_size) // config.patch_steps + 1
     gx = (w - config.patch_size) // config.patch_steps + 1
     m = min(n_sample * gy * gx, config.sample_patches)
-    # same dp×tp feature sharding the pipeline's solver constrains X
-    # with (block_ls.py) — on a ('data','model') mesh the scaled feature
-    # matrix model-shards instead of replicating its full width per chip
-    from ..parallel import mesh as meshlib
-
-    gpy = (gy - config.pool_size) // config.pool_stride + 1
-    gpx = (gx - config.pool_size) // config.pool_stride + 1
-    d = gpy * gpx * 2 * config.num_filters
-    B = min(config.block_size, d)
-    d_pad = -(-d // B) * B
-    x_sharding = meshlib.feature_sharding(train.data.mesh, d_pad)
     # key on EVERY config field baked into the program via partial —
     # solver/featurizer parameters included, else a second config would
-    # silently reuse the first's compiled fit
+    # silently reuse the first's compiled fit. The mesh is part of the
+    # key: the solver's feature-sharding constraint is built from it
+    # inside _fused_step (next to the real featurized width).
     from dataclasses import astuple
 
+    mesh = train.data.mesh
     key = (astuple(config), h, w, c, n, n_sample, m,
            train.data.padded_count, test.data.padded_count,
-           test.data.count, x_sharding)
+           test.data.count, mesh)
     fn = _fused_step_jit_cache.get(key)
     if fn is None:
         from functools import partial
 
         fn = jax.jit(partial(
             _fused_step, config=config, h=h, w=w, c=c,
-            n_valid=n, n_sample=n_sample, m=m, x_sharding=x_sharding,
+            n_valid=n, n_sample=n_sample, m=m, mesh=mesh,
         ))
         _fused_step_jit_cache[key] = fn
 
