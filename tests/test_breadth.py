@@ -1,6 +1,8 @@
 """Tests for the breadth wave: weighted solvers, kernel methods,
 classifiers, NLP stack, sparse features, MAP/augmented evaluators."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -405,3 +407,117 @@ def test_apply_and_evaluate_chunked_matches_unchunked():
     assert len(one) == len(big) == 4
     for a, b in zip(one, big):
         np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+# ------------------------------------------------- reference aMat/bMat fixtures
+# (the exact 15x12 / 15x3 matrices the reference's BWLS suite loads —
+# BlockWeightedLeastSquaresSuite.scala:63-223)
+
+
+def _load_amat_bmat(a="aMat.csv", b="bMat.csv"):
+    base = os.path.join(os.path.dirname(__file__), "resources")
+    A = np.loadtxt(os.path.join(base, a), delimiter=",").astype(np.float32)
+    B = np.loadtxt(os.path.join(base, b), delimiter=",").astype(np.float32)
+    if B.ndim == 1:
+        B = B[:, None]
+    return A, B
+
+
+def test_bwls_reference_fixture_zero_gradient():
+    """The reference's exact zero-gradient configuration: aMat/bMat,
+    blockSize=4, numIter=10, lambda=0.1, mixtureWeight=0.3, |grad|<1e-2
+    (BlockWeightedLeastSquaresSuite.scala:142-166)."""
+    A, B = _load_amat_bmat()
+    n, k = B.shape
+    lam, mw = 0.1, 0.3
+    model = BlockWeightedLeastSquaresEstimator(4, 10, lam, mw).fit(
+        Dataset(A), Dataset(B)
+    )
+    W = np.asarray(model.W, np.float64)
+    b = np.asarray(model.b, np.float64)
+    A64, B64 = A.astype(np.float64), B.astype(np.float64)
+    grad_norm2 = 0.0
+    for c in range(k):
+        member = (B64[:, c] > 0).astype(np.float64)
+        wts = mw * member / member.sum() + (1 - mw) / n
+        resid = A64 @ W[:, c] + b[c] - B64[:, c]
+        grad = A64.T @ (wts * resid) + lam * W[:, c]
+        grad_norm2 += float(grad @ grad)
+    assert np.sqrt(grad_norm2) < 1e-2
+
+
+def test_bwls_reference_fixture_per_class_matches_blockweighted():
+    """Per-class delegate ≈ BlockWeighted on the reference fixture
+    (BlockWeightedLeastSquaresSuite.scala:115-140)."""
+    A, B = _load_amat_bmat()
+    lam, mw = 0.1, 0.3
+    bw = BlockWeightedLeastSquaresEstimator(4, 10, lam, mw).fit(
+        Dataset(A), Dataset(B)
+    )
+    pc = PerClassWeightedLeastSquares(lam, mw).fit(Dataset(A), Dataset(B))
+    np.testing.assert_allclose(
+        np.asarray(bw.W), np.asarray(pc.W), atol=5e-2, rtol=5e-2
+    )
+
+
+def test_bwls_reference_fixture_single_class():
+    """1-class fixture satisfies its weighted normal equations
+    (BlockWeightedLeastSquaresSuite.scala:168-186). With one class the
+    per-example weights collapse to mw/n_c + (1-mw)/n = 1/n."""
+    A, B = _load_amat_bmat("aMat-1class.csv", "bMat-1class.csv")
+    n, k = B.shape
+    lam, mw = 0.1, 0.3
+    model = BlockWeightedLeastSquaresEstimator(4, 10, lam, mw).fit(
+        Dataset(A), Dataset(B)
+    )
+    W = np.asarray(model.W, np.float64)
+    b = np.asarray(model.b, np.float64)
+    assert W.shape == (A.shape[1], k)
+    A64, B64 = A.astype(np.float64), B.astype(np.float64)
+    for c in range(k):
+        member = (B64[:, c] > 0).astype(np.float64)
+        wts = mw * member / max(member.sum(), 1.0) + (1 - mw) / n
+        resid = A64 @ W[:, c] + b[c] - B64[:, c]
+        grad = A64.T @ (wts * resid) + lam * W[:, c]
+        assert np.abs(grad).max() < 1e-2, f"class {c}: {np.abs(grad).max()}"
+
+
+def test_bwls_reference_fixture_nondivisible_blocksize():
+    """nFeatures=12 not divisible by blockSize=5
+    (BlockWeightedLeastSquaresSuite.scala:188-223): same solution as a
+    divisible blocking."""
+    A, B = _load_amat_bmat()
+    lam, mw = 0.1, 0.3
+    m5 = BlockWeightedLeastSquaresEstimator(5, 12, lam, mw).fit(
+        Dataset(A), Dataset(B)
+    )
+    m4 = BlockWeightedLeastSquaresEstimator(4, 12, lam, mw).fit(
+        Dataset(A), Dataset(B)
+    )
+    np.testing.assert_allclose(
+        np.asarray(m5.W), np.asarray(m4.W), atol=5e-2, rtol=5e-2
+    )
+
+
+def test_lda_iris_matches_published_eigenvectors():
+    """The reference's iris fixture (LinearDiscriminantAnalysisSuite.
+    scala:13-38): LDA(2) on standardized iris must reproduce the
+    published discriminant directions (Raschka's LDA tutorial), up to
+    sign and scale — the reference normalizes to unit length."""
+    path = os.path.join(os.path.dirname(__file__), "resources", "iris.data")
+    rows = [l.strip() for l in open(path) if l.strip()]
+    X = np.array([[float(v) for v in r.split(",")[:4]] for r in rows],
+                 np.float64)
+    name_to_label = {"Iris-setosa": 1, "Iris-versicolor": 2,
+                     "Iris-virginica": 3}
+    y = np.array([name_to_label[r.split(",")[-1]] for r in rows], np.int32)
+    Xs = ((X - X.mean(0)) / X.std(0, ddof=1)).astype(np.float32)
+
+    model = LinearDiscriminantAnalysis(2).fit(Dataset(Xs), Dataset(y))
+    W = np.asarray(model.components, np.float64)
+    major = np.array([-0.1498, -0.1482, 0.8511, 0.4808])
+    minor = np.array([0.0095, 0.3272, -0.5748, 0.75])
+    for col, want in ((W[:, 0], major), (W[:, 1], minor)):
+        got = col / np.linalg.norm(col)
+        err = min(np.abs(got - want).max(), np.abs(got + want).max())
+        assert err < 1e-3, (got, want)
