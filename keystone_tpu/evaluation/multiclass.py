@@ -58,6 +58,17 @@ class MulticlassMetrics:
         p, r = self.class_precision(c), self.class_recall(c)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
+    def class_fbeta(self, c: int, beta: float) -> float:
+        """F_β = (1+β²)·P·R / (β²·P + R) (the reference's
+        classMetrics(c).fScore(beta), MulticlassMetrics.scala)."""
+        p, r = self.class_precision(c), self.class_recall(c)
+        denom = beta * beta * p + r
+        return (1 + beta * beta) * p * r / denom if denom else 0.0
+
+    def macro_fbeta(self, beta: float) -> float:
+        return float(np.mean(
+            [self.class_fbeta(c, beta) for c in range(self.num_classes)]))
+
     @property
     def macro_precision(self) -> float:
         return float(np.mean([self.class_precision(c) for c in range(self.num_classes)]))

@@ -60,6 +60,33 @@ def test_binary_contingency():
     assert abs(m.accuracy - 3 / 5) < 1e-6
 
 
+def test_multiclass_reference_suite_fixture():
+    """The reference suite's complete 9-instance 3-class fixture
+    (MulticlassClassifierEvaluatorSuite.scala:9-63): per-class P/R/F1 and
+    F2, micro (= accuracy for single-label), and macro aggregates."""
+    preds = [0, 0, 0, 1, 1, 1, 1, 2, 2]
+    actual = [0, 1, 0, 0, 1, 1, 1, 2, 0]
+    m = MulticlassClassifierEvaluator(3)(preds, actual)
+    want_conf = np.array([[2, 1, 1], [1, 3, 0], [0, 0, 1]], float)
+    np.testing.assert_array_equal(m.confusion, want_conf)
+    p = [2 / 3, 3 / 4, 1 / 2]
+    r = [2 / 4, 3 / 4, 1 / 1]
+    f1 = [2 * pi * ri / (pi + ri) for pi, ri in zip(p, r)]
+    f2 = [5 * pi * ri / (4 * pi + ri) for pi, ri in zip(p, r)]
+    for c in range(3):
+        assert abs(m.class_precision(c) - p[c]) < 1e-7
+        assert abs(m.class_recall(c) - r[c]) < 1e-7
+        assert abs(m.class_f1(c) - f1[c]) < 1e-7
+        assert abs(m.class_fbeta(c, 2.0) - f2[c]) < 1e-7
+    assert abs(m.micro_recall - 6 / 9) < 1e-7
+    assert abs(m.micro_recall - m.micro_precision) < 1e-7
+    assert abs(m.micro_recall - m.micro_f1) < 1e-7
+    assert abs(m.macro_precision - sum(p) / 3) < 1e-7
+    assert abs(m.macro_recall - sum(r) / 3) < 1e-7
+    assert abs(m.macro_f1 - sum(f1) / 3) < 1e-7
+    assert abs(m.macro_fbeta(2.0) - sum(f2) / 3) < 1e-7
+
+
 # --------------------------------------------------------------------- mAP
 # (reference MeanAveragePrecisionSuite.scala:11-33 + adversarial edges)
 
