@@ -215,10 +215,11 @@ def main():
     p.add_argument("--skip-flagship", action="store_true")
     p.add_argument("--liveness-timeout", type=float, default=90.0)
     p.add_argument("--run-timeout", type=float, default=1500.0)
-    p.add_argument("--phase-timeout", type=float, default=480.0,
+    p.add_argument("--phase-timeout", type=float, default=900.0,
                    help="kill the child if no phase marker arrives for "
                         "this long (mid-phase tunnel wedge); generous "
-                        "enough for a cold multi-minute compile")
+                        "enough for a fully cold multi-minute compile "
+                        "of the 50k-scale programs")
     p.add_argument("--retry-wait", type=float, default=120.0)
     p.add_argument("--attempts", type=int, default=3)
     p.add_argument("--deadline", type=float, default=2700.0,
