@@ -222,9 +222,11 @@ def main():
                         "of the 50k-scale programs")
     p.add_argument("--retry-wait", type=float, default=120.0)
     p.add_argument("--attempts", type=int, default=3)
-    p.add_argument("--deadline", type=float, default=2700.0,
+    p.add_argument("--deadline", type=float, default=3300.0,
                    help="total seconds before giving up and emitting the "
-                        "error record")
+                        "error record (sized so a third attempt still "
+                        "fits a full --phase-timeout cold-compile window "
+                        "after two wedged ones)")
     args = p.parse_args()
 
     if args.child:

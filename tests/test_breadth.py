@@ -521,3 +521,36 @@ def test_lda_iris_matches_published_eigenvectors():
         got = col / np.linalg.norm(col)
         err = min(np.abs(got - want).max(), np.abs(got + want).max())
         assert err < 1e-3, (got, want)
+
+
+def test_stupid_backoff_reference_corpus_exact_scores():
+    """The reference suite's exact corpus and score assertions
+    (StupidBackoffSuite.scala:15-79): 'Winter is coming' / 'Finals are
+    coming' / 'Summer is coming really soon', n-grams of orders 2-5 via
+    the node chain, separate unigram counts fed to the estimator."""
+    from collections import Counter
+
+    data = ["Winter is coming", "Finals are coming",
+            "Summer is coming really soon"]
+    tok = Tokenizer()
+    ngrams = Counter()
+    unigrams = Counter()
+    for s in data:
+        toks = tok.apply(s)
+        for ng in NGramsFeaturizer(range(2, 6)).apply(toks):
+            ngrams[tuple(ng)] += 1
+        for ng in NGramsFeaturizer([1]).apply(toks):
+            unigrams[ng[0]] += 1
+
+    lm = StupidBackoffEstimator(unigram_counts=dict(unigrams)).fit(
+        HostDataset([ngrams])
+    )
+    num_tokens = sum(unigrams.values())  # 11
+    assert abs(lm.score(("is", "coming")) - 2.0 / 2.0) < 1e-12
+    assert abs(lm.score(("is", "coming", "really")) - 1.0 / 2.0) < 1e-12
+    # backed off once AND current word unseen -> 0
+    assert lm.score(("is", "unseen-coming")) == 0.0
+    # backed off once, current word seen -> alpha * count/numTokens
+    assert abs(
+        lm.score(("is-unseen", "coming")) - lm.alpha * 3.0 / num_tokens
+    ) < 1e-12
