@@ -232,6 +232,21 @@ def main():
     if args.child:
         return child_main(args)
 
+    # The child registers atexit cleanup for its cifar_train_* symlink
+    # staging dir, but the watchdog kills wedged children with SIGKILL
+    # (atexit never runs) — sweep strays here. Only dirs older than one
+    # full deadline window: anything younger may belong to a concurrent
+    # bench invocation whose child is still using it.
+    import glob
+    import shutil
+    import tempfile
+    for stray in glob.glob(os.path.join(tempfile.gettempdir(), "cifar_train_*")):
+        try:
+            if time.time() - os.path.getmtime(stray) > args.deadline:
+                shutil.rmtree(stray, ignore_errors=True)
+        except OSError:
+            pass
+
     t_start = time.monotonic()
     error = None
     best = None  # best LIVE (possibly partial) detail seen this window
@@ -251,6 +266,14 @@ def main():
             continue
         remaining = args.deadline - (time.monotonic() - t_start)
         detail, phases = run_child(args, min(args.run_timeout, remaining))
+        bad_dir = next((ph for ph in phases
+                        if ph.get("phase") == "cifar_dir_unusable"), None)
+        if bad_dir is not None:
+            # a bad --cifar-dir fails deterministically; retrying burns
+            # minutes with no chance of success (ADVICE r4)
+            emit(error_record(f"--cifar-dir {bad_dir.get('dir')!r} unusable: "
+                              + bad_dir.get("reason", "missing CIFAR batches")))
+            return 2
         if detail is not None:
             rank = progress_rank.get(detail.get("progress", "complete"), 0)
             if best is None or rank >= progress_rank.get(
@@ -296,14 +319,19 @@ def main():
         stale["error"] = error
         emit(stale)
     else:
-        emit({
-            "metric": "cifar_randompatch_train_images_per_sec",
-            "value": 0.0,
-            "unit": "images/sec (1 chip, warm)",
-            "vs_baseline": 0.0,
-            "error": error,
-        })
+        emit(error_record(error))
     return 0
+
+
+def error_record(error):
+    """Zero-value record in the headline metric's shape, for failures."""
+    return {
+        "metric": "cifar_randompatch_train_images_per_sec",
+        "value": 0.0,
+        "unit": "images/sec (1 chip, warm)",
+        "vs_baseline": 0.0,
+        "error": error,
+    }
 
 
 def phase(name, **kw):
@@ -460,9 +488,12 @@ def child_main(args):
             if len(batches) > 1:
                 # directory mode globs every .bin incl. test_batch; stage
                 # train batches alone via a temp dir of symlinks
+                import atexit
+                import shutil
                 import tempfile
 
                 tdir = tempfile.mkdtemp(prefix="cifar_train_")
+                atexit.register(shutil.rmtree, tdir, ignore_errors=True)
                 for f in batches:
                     os.symlink(os.path.join(cdir, f), os.path.join(tdir, f))
                 train_path = tdir
@@ -474,7 +505,8 @@ def child_main(args):
             print(f"BENCH ERROR: --cifar-dir {args.cifar_dir!r} has no "
                   "data_batch_*.bin + test_batch.bin; refusing to fall "
                   "back silently", file=sys.stderr, flush=True)
-            phase("cifar_dir_unusable", dir=args.cifar_dir)
+            phase("cifar_dir_unusable", dir=args.cifar_dir,
+                  reason="no data_batch_*.bin + test_batch.bin")
             return 2
     if train_path:
         train = cifar_loader(train_path)

@@ -96,9 +96,19 @@ case "$ACTION" in
     ;;
   run)
     [ ${#APP_ARGS[@]} -gt 0 ] || { echo "run needs '-- <pipeline> [flags]'" >&2; exit 1; }
-    # host 0's name resolves inside the pod; workers reach the
-    # coordinator over the pod's internal network
-    COORD="${NAME}-0:${PORT}"
+    # TPU VM workers are NOT resolvable as "<tpu-name>-0" — internal DNS
+    # uses auto-generated instance hostnames (t1v-n-…-w-0) — so resolve
+    # worker 0's internal IP from the API and hand THAT to every process
+    DESCRIBE=(gcloud compute tpus tpu-vm describe "$NAME" "${GCLOUD_COMMON[@]}"
+              --format='value(networkEndpoints[0].ipAddress)')
+    if [ "$DRY" = 1 ]; then
+      run "${DESCRIBE[@]}"
+      COORD_IP='${WORKER0_IP}'   # placeholder: dry-run cannot call gcloud
+    else
+      COORD_IP="$("${DESCRIBE[@]}")"
+      [ -n "$COORD_IP" ] || { echo "could not resolve worker 0 internal IP for $NAME" >&2; exit 1; }
+    fi
+    COORD="${COORD_IP}:${PORT}"
     # shell-quote each app arg for the remote shell (spaces/metachars)
     APP_Q=""
     for a in "${APP_ARGS[@]}"; do APP_Q+=" $(printf '%q' "$a")"; done

@@ -74,8 +74,10 @@ def _learn_filters_device(images, key, eps, patch: int, step: int,
     per-call latency (not FLOPs) dominates this phase, so fusing the
     reference's driver-side LAPACK step (ZCAWhitener.scala:53-60) into
     the device program is the win. Sample indices are drawn ON DEVICE
-    from ``key`` (with replacement — statistically equivalent for
-    sampling 100k of ~360k patches): shipping fresh host-side index
+    from ``key``: image and filter draws use the top-k trick (without
+    replacement, matching the replaced host rng.choice semantics); only
+    the patch subsample is with replacement — statistically equivalent
+    for sampling 100k of ~360k patches. Shipping fresh host-side index
     arrays cost a measured ~93 ms per call through the tunnel, ~3/4 of
     the whole phase."""
     import jax

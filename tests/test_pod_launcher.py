@@ -51,10 +51,16 @@ def test_run_emits_one_process_per_host_with_coordinator_flags():
                  "--accelerator", "v5litepod-16", "--",
                  "pipelines.images.cifar.RandomPatchCifar",
                  "--num-filters", "256")
+    # first line resolves worker 0's internal IP (TPU VM hostnames are
+    # auto-generated; "<name>-0" does not resolve inside the pod)
+    assert "tpus tpu-vm describe kp-test" in lines[0]
+    assert "networkEndpoints" in lines[0] and "ipAddress" in lines[0]
+    lines = lines[1:]
     assert len(lines) == 4
     for i, line in enumerate(sorted(lines, key=lambda l: l.split("--worker=")[1])):
         assert f"--worker={i}" in line
-        assert "--coordinator kp-test-0:8476" in line
+        assert "--coordinator" in line
+        assert "WORKER0_IP" in line and ":8476" in line
         assert "--num-processes 4" in line
         assert f"--process-id {i}" in line
         assert "run-pipeline.sh" in line
@@ -64,8 +70,9 @@ def test_run_emits_one_process_per_host_with_coordinator_flags():
 def test_run_single_host_accelerator():
     lines = _run("run", "kp", "--zone", "z", "--accelerator", "v5litepod-4",
                  "--", "pipelines.speech.TimitPipeline")
-    assert len(lines) == 1
-    assert "--num-processes 1" in lines[0] and "--process-id 0" in lines[0]
+    assert len(lines) == 2  # describe (IP resolve) + one host process
+    assert "tpus tpu-vm describe kp" in lines[0]
+    assert "--num-processes 1" in lines[1] and "--process-id 0" in lines[1]
 
 
 def test_delete():
