@@ -18,13 +18,6 @@ import numpy as np
 from ...data.dataset import Dataset, HostDataset
 from ...utils.images import depthwise_conv2d
 from ...workflow.pipeline import Transformer
-def _gaussian_kernel(sigma: float):
-    """3-sigma-support normalized Gaussian taps (DAISY's blur layers;
-    distinct from SIFT's vl_imsmooth 4-sigma convention)."""
-    radius = max(int(np.ceil(3 * sigma)), 1)
-    x = np.arange(-radius, radius + 1, dtype=np.float32)
-    k = np.exp(-0.5 * (x / sigma) ** 2)
-    return k / k.sum()
 
 
 class _GridDescriptorExtractor(Transformer):
@@ -202,60 +195,113 @@ class HogExtractor(_GridDescriptorExtractor):
         return fn
 
 
-class DaisyExtractor(_GridDescriptorExtractor):
-    """Dense DAISY: 8 half-rectified orientation maps, Gaussian-smoothed
-    at 3 radial levels, sampled at the center + 8 points on 3 rings →
-    (num_keypoints, 200) (DaisyExtractor.scala:28-201)."""
+def daisy_blur_kernels(radius: int, rings: int):
+    """The reference's incremental DAISY blur taps
+    (DaisyExtractor.scala:48-63): per-level variance increments
+    t_q = σ²(q+1) − σ²(q) with σ(n) = R·n/(2Q), support from the
+    conv-threshold formula, and UN-normalized discrete Gaussian taps
+    exp(−n²/2t)/√(2πt) (their sum is only ≈1; normalizing them would
+    break the MATLAB golden sums)."""
+    R, Q = radius, rings
+    sigma_sq = [(R * n / (2.0 * Q)) ** 2 for n in range(Q + 1)]
+    diffs = [sigma_sq[n + 1] - sigma_sq[n] for n in range(Q)]
+    kernels = []
+    for t in diffs:
+        support = int(np.ceil(np.sqrt(
+            -2.0 * t * np.log(1e-6) - t * np.log(2.0 * np.pi * t))))
+        n = np.arange(-support, support + 1, dtype=np.float64)
+        kernels.append(np.exp(-(n ** 2) / (2.0 * t)) / np.sqrt(2.0 * np.pi * t))
+    return kernels
 
-    def __init__(self, stride: int = 4, radius: int = 15, rings: int = 3,
-                 ring_points: int = 8, num_orientations: int = 8):
+
+def _round_half_up(v: float) -> int:
+    """Scala math.round: floor(v + 0.5) — NOT numpy's banker's round."""
+    import math
+
+    return int(math.floor(v + 0.5))
+
+
+class DaisyExtractor(_GridDescriptorExtractor):
+    """Dense DAISY (DaisyExtractor.scala:28-201): H half-rectified
+    orientation maps from [1,0,-1]⊗[1,2,1] gradients, incrementally
+    Gaussian-blurred at Q radial levels (variance increments from the
+    σ(n)=R·n/2Q schedule), sampled at the keypoint center (level-0
+    blur) + T points per ring at angle 2π(t−1)/T, each H-histogram
+    L2-normalized separately → (num_keypoints, H·(T·Q+1)).
+
+    The reference returns the transpose (featureSize × keypoints, to
+    match SIFT); rows here are keypoints in the same x-major order.
+    Validated against the reference suite's MATLAB golden sums on
+    gantrycrane (DaisyExtractorSuite.scala:20-30)."""
+
+    def __init__(self, stride: int = 4, radius: int = 7, rings: int = 3,
+                 ring_points: int = 8, num_orientations: int = 8,
+                 pixel_border: int = 16):
+        if pixel_border < radius:
+            # outermost ring offset is ±radius; a smaller border would
+            # make gathers go out of bounds, which jit silently clamps
+            raise ValueError(
+                f"pixel_border ({pixel_border}) must be >= radius ({radius})")
         self.stride = stride
         self.radius = radius
         self.rings = rings
         self.ring_points = ring_points
         self.num_orientations = num_orientations
+        self.pixel_border = pixel_border
 
     def _fn(self):
-        stride, R = self.stride, self.radius
+        stride, R, border = self.stride, self.radius, self.pixel_border
         Q, T, H = self.rings, self.ring_points, self.num_orientations
+        blur_taps = [jnp.asarray(k, jnp.float32)
+                     for k in daisy_blur_kernels(R, Q)]
+        # static per-(ring-point, level) grid offsets; angle has the
+        # reference's (t−1) phase (DaisyExtractor.scala:83)
+        offsets = []
+        for t in range(T):
+            theta = 2.0 * np.pi * (t - 1) / T
+            for q in range(Q):
+                r = R * (1.0 + q) / Q
+                offsets.append((q, _round_half_up(r * np.sin(theta)),
+                                _round_half_up(r * np.cos(theta))))
 
         def fn(img):
             gray = img[:, :, 0] if img.ndim == 3 else img
-            dy = jnp.zeros_like(gray).at[1:-1].set((gray[2:] - gray[:-2]) * 0.5)
-            dx = jnp.zeros_like(gray).at[:, 1:-1].set((gray[:, 2:] - gray[:, :-2]) * 0.5)
-            angles = jnp.arange(H) * (2 * jnp.pi / H)
-            # half-rectified directional derivatives (Daisy's G_o maps)
+            g1 = gray[:, :, None]
+            # true convolution with filter1=[1,0,-1]/filter2=[1,2,1]
+            # (conv2D reverses its taps before correlating —
+            # ImageUtils.scala:267-268 — so pass them pre-reversed)
+            d = jnp.asarray([-1.0, 0.0, 1.0], jnp.float32)
+            s = jnp.asarray([1.0, 2.0, 1.0], jnp.float32)
+            ix = depthwise_conv2d(g1, d, s)[:, :, 0]  # ∂/∂x (rows)
+            iy = depthwise_conv2d(g1, s, d)[:, :, 0]  # ∂/∂y (cols)
+            angles = np.arange(H) * (2.0 * np.pi / H)
+            # half-rectified directional derivatives (scala:117-124)
             omaps = jnp.stack(
-                [jnp.maximum(jnp.cos(a) * dx + jnp.sin(a) * dy, 0.0) for a in angles],
+                [jnp.maximum(np.cos(a) * ix + np.sin(a) * iy, 0.0)
+                 for a in angles],
                 axis=-1,
             )  # (h, w, H)
-            # cumulative Gaussian smoothing per ring level
+            # incremental blurs: level q smooths level q−1 (scala:126-133)
             level_maps = []
             acc = omaps
             for q in range(Q):
-                sigma = R * (q + 1) / (Q * 2.0)
-                k = jnp.asarray(_gaussian_kernel(sigma))
-                acc = depthwise_conv2d(acc, k, k)
+                acc = depthwise_conv2d(acc, blur_taps[q], blur_taps[q])
                 level_maps.append(acc)
             h, w = gray.shape
-            margin = R + 1
-            n_y = max((h - 2 * margin) // stride + 1, 0)
-            n_x = max((w - 2 * margin) // stride + 1, 0)
-            ys = jnp.arange(n_y) * stride + margin
-            xs = jnp.arange(n_x) * stride + margin
-            cy = ys[:, None].repeat(n_x, 1)
-            cx = xs[None, :].repeat(n_y, 0)
-            descs = [level_maps[0][cy, cx, :]]  # center histogram
-            for q in range(Q):
-                r = R * (q + 1) / Q
-                for t in range(T):
-                    a = 2 * jnp.pi * t / T
-                    oy = jnp.round(r * jnp.sin(a)).astype(jnp.int32)
-                    ox = jnp.round(r * jnp.cos(a)).astype(jnp.int32)
-                    descs.append(level_maps[q][cy + oy, cx + ox, :])
-            out = jnp.concatenate(descs, axis=-1)  # (n_y, n_x, (1+Q*T)*H)
-            out = out.reshape(n_y * n_x, -1)
-            norm = jnp.linalg.norm(out, axis=1, keepdims=True)
-            return out / jnp.maximum(norm, 1e-8)
+            n_x = max((h - 2 * border - 1) // stride + 1, 0)  # x = rows
+            n_y = max((w - 2 * border - 1) // stride + 1, 0)
+            cx = (jnp.arange(n_x) * stride + border)[:, None].repeat(n_y, 1)
+            cy = (jnp.arange(n_y) * stride + border)[None, :].repeat(n_x, 0)
+            hists = [level_maps[0][cx, cy, :]]  # center, level-0 blur
+            for q, ox, oy in offsets:
+                hists.append(level_maps[q][cx + ox, cy + oy, :])
+            # (n_x, n_y, 1+T·Q, H): per-histogram L2 normalization with
+            # the reference's zeroing threshold (scala:193-200); column
+            # order center, then (t, q) t-major matches the packing at
+            # scala:165-184
+            hist = jnp.stack(hists, axis=2)
+            norm = jnp.linalg.norm(hist, axis=-1, keepdims=True)
+            hist = jnp.where(norm > 1e-8, hist / jnp.where(norm == 0.0, 1.0, norm), 0.0)
+            return hist.reshape(n_x * n_y, (1 + T * Q) * H)
 
         return fn

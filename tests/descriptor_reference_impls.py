@@ -14,13 +14,6 @@ from __future__ import annotations
 import numpy as np
 
 
-def gaussian_kernel(sigma: float) -> np.ndarray:
-    radius = max(int(np.ceil(3 * sigma)), 1)
-    x = np.arange(-radius, radius + 1, dtype=np.float64)
-    k = np.exp(-0.5 * (x / sigma) ** 2)
-    return k / k.sum()
-
-
 def corr1d_same(a: np.ndarray, k: np.ndarray, axis: int) -> np.ndarray:
     """Cross-correlation along `axis` with XLA 'SAME' zero padding
     (pad_lo = (len-1)//2, remainder high)."""
@@ -39,14 +32,6 @@ def corr1d_same(a: np.ndarray, k: np.ndarray, axis: int) -> np.ndarray:
 
 def sep_filter(img: np.ndarray, k: np.ndarray) -> np.ndarray:
     return corr1d_same(corr1d_same(img, k, 0), k, 1)
-
-
-def central_gradients(gray: np.ndarray):
-    dy = np.zeros_like(gray)
-    dx = np.zeros_like(gray)
-    dy[1:-1, :] = (gray[2:, :] - gray[:-2, :]) * 0.5
-    dx[:, 1:-1] = (gray[:, 2:] - gray[:, :-2]) * 0.5
-    return dy, dx
 
 
 def orientation_maps(mag, ang, n_bins):
@@ -323,46 +308,96 @@ def hog(img, cell_size: int):
     return out
 
 
-def daisy(gray, stride: int, radius: int, rings: int, ring_points: int,
-          num_orientations: int):
-    """Reference for descriptors.DaisyExtractor: (n_y*n_x, (1+Q*T)*H)."""
+def _conv2d_same(img, xf, yf):
+    """ImageUtils.conv2D (ImageUtils.scala:226-338): zero-pad to
+    (h+lx−1, w+ly−1) with floor/ceil split, reverse both filters, then
+    valid separable correlation — i.e. same-size TRUE convolution, xf
+    along axis 0 (the reference's x = row), yf along axis 1."""
+    img = np.asarray(img, np.float64)
+    xf = np.asarray(xf, np.float64)[::-1]
+    yf = np.asarray(yf, np.float64)[::-1]
+    lx, ly = len(xf), len(yf)
+    pad_x = ((lx - 1) // 2, lx - 1 - (lx - 1) // 2)
+    pad_y = ((ly - 1) // 2, ly - 1 - (ly - 1) // 2)
+    pad = [pad_x, pad_y] + [(0, 0)] * (img.ndim - 2)
+    p = np.pad(img, pad)
+    mid = np.zeros((img.shape[0],) + p.shape[1:], np.float64)
+    for i in range(lx):
+        mid += xf[i] * p[i : i + img.shape[0]]
+    out = np.zeros(img.shape, np.float64)
+    for i in range(ly):
+        out += yf[i] * mid[:, i : i + img.shape[1]]
+    return out
+
+
+def daisy(gray, stride: int = 4, radius: int = 7, rings: int = 3,
+          ring_points: int = 8, num_orientations: int = 8,
+          pixel_border: int = 16):
+    """Scalar-structure oracle for DaisyExtractor.scala:28-201:
+    [1,0,-1]⊗[1,2,1] gradients, H rectified orientation maps,
+    incremental un-normalized Gaussian blur levels on the
+    σ(n)=R·n/2Q variance schedule, center (level-0) + T×Q ring
+    histograms at angle 2π(t−1)/T with Scala round-half-up offsets,
+    each H-vector L2-normalized (zeroed under 1e-8). Returns
+    (num_keypoints, H·(T·Q+1)) — the transpose of the Scala output,
+    rows in the reference's x-major keypoint order, columns in its
+    packing order (center, then t-major (t,q) blocks)."""
+    import math
+
     gray = np.asarray(gray, np.float64)
-    R, Q, T, H = radius, rings, ring_points, num_orientations
-    dy, dx = central_gradients(gray)
-    omaps = np.stack(
-        [
-            np.maximum(np.cos(a) * dx + np.sin(a) * dy, 0.0)
-            for a in np.arange(H) * (2 * np.pi / H)
-        ],
-        axis=-1,
-    )
+    R, Q, T, H, border = radius, rings, ring_points, num_orientations, pixel_border
+    f1 = [1.0, 0.0, -1.0]
+    f2 = [1.0, 2.0, 1.0]
+    ix = _conv2d_same(gray, f1, f2)
+    iy = _conv2d_same(gray, f2, f1)
+
+    sigma_sq = [(R * n / (2.0 * Q)) ** 2 for n in range(Q + 1)]
+    diffs = [sigma_sq[n + 1] - sigma_sq[n] for n in range(Q)]
+    taps = []
+    for t in diffs:
+        support = int(math.ceil(math.sqrt(
+            -2.0 * t * math.log(1e-6) - t * math.log(2.0 * math.pi * t))))
+        n = np.arange(-support, support + 1, dtype=np.float64)
+        taps.append(np.exp(-(n ** 2) / (2.0 * t))
+                    / math.sqrt(2.0 * math.pi * t))
+
     level_maps = []
-    acc = omaps
-    for q in range(Q):
-        sigma = R * (q + 1) / (Q * 2.0)
-        acc = sep_filter(acc, gaussian_kernel(sigma))
-        level_maps.append(acc)
+    accs = []
+    for o in range(H):
+        a = 2.0 * math.pi * o / H
+        omap = np.maximum(math.cos(a) * ix + math.sin(a) * iy, 0.0)
+        layers = []
+        acc = omap
+        for q in range(Q):
+            acc = _conv2d_same(acc, taps[q], taps[q])
+            layers.append(acc)
+        accs.append(layers)
+    # level_maps[q][o] mirrors the Scala daisyLayers(level)(angle)
+    level_maps = [[accs[o][q] for o in range(H)] for q in range(Q)]
+
+    def norm_hist(v):
+        nrm = math.sqrt(float(np.sum(v * v)))
+        return v / nrm if nrm > 1e-8 else np.zeros_like(v)
+
     h, w = gray.shape
-    margin = R + 1
-    n_y = max((h - 2 * margin) // stride + 1, 0)
-    n_x = max((w - 2 * margin) // stride + 1, 0)
+    kx = list(range(border, h - border, stride))
+    ky = list(range(border, w - border, stride))
     rows = []
-    for iy in range(n_y):
-        for ix in range(n_x):
-            y0 = iy * stride + margin
-            x0 = ix * stride + margin
-            d = [level_maps[0][y0, x0, :]]
-            for q in range(Q):
-                r = R * (q + 1) / Q
-                for t in range(T):
-                    a = 2 * np.pi * t / T
-                    oy = int(np.round(r * np.sin(a)))
-                    ox = int(np.round(r * np.cos(a)))
-                    d.append(level_maps[q][y0 + oy, x0 + ox, :])
+    for x0 in kx:
+        for y0 in ky:
+            d = [norm_hist(np.asarray(
+                [level_maps[0][o][x0, y0] for o in range(H)]))]
+            for t in range(T):
+                theta = 2.0 * math.pi * (t - 1) / T
+                for q in range(Q):
+                    r = R * (1.0 + q) / Q
+                    ox = int(math.floor(r * math.sin(theta) + 0.5))
+                    oy = int(math.floor(r * math.cos(theta) + 0.5))
+                    d.append(norm_hist(np.asarray(
+                        [level_maps[q][o][x0 + ox, y0 + oy]
+                         for o in range(H)])))
             rows.append(np.concatenate(d))
-    out = np.stack(rows)
-    norm = np.linalg.norm(out, axis=1, keepdims=True)
-    return out / np.maximum(norm, 1e-8)
+    return np.stack(rows)
 
 
 def lcs(img, stride: int, subpatch_size: int, subpatches: int):

@@ -112,16 +112,40 @@ def test_hog_matches_numpy_reference(real_image):
     assert np.std(want) > 0.01
 
 
-def test_daisy_matches_numpy_reference(gray):
+def test_daisy_matches_reference_oracle(gray):
+    """XLA DAISY vs the scalar-structure oracle of
+    DaisyExtractor.scala:28-201 semantics (conv2D gradients, incremental
+    un-normalized Gaussian levels, (t−1) ring-angle phase, per-histogram
+    normalization) on a real-image crop."""
     from keystone_tpu.nodes.images.descriptors import DaisyExtractor
 
-    ext = DaisyExtractor(stride=8, radius=15)
-    got = np.asarray(ext.apply(gray))
-    want = ref.daisy(gray, stride=8, radius=15, rings=3, ring_points=8,
-                     num_orientations=8)
+    got = np.asarray(DaisyExtractor().apply(gray))
+    want = ref.daisy(gray)
     assert got.shape == want.shape
-    np.testing.assert_allclose(got, want, atol=2e-4)
+    np.testing.assert_allclose(got, want, atol=5e-5)
     assert np.std(want) > 0.01
+
+
+def test_daisy_matches_matlab_golden_sums():
+    """The reference suite's own golden (DaisyExtractorSuite.scala:20-30):
+    MATLAB-computed first-keypoint and full-feature sums on the FULL
+    gantrycrane gray image, at the reference's first-keypoint tolerance
+    (1e-5) and a f32-relaxed full-sum tolerance (reference asserts 1e-7
+    in f64; the f64 oracle hits rel 1.2e-6 / 6.2e-8 on both)."""
+    from PIL import Image
+
+    from keystone_tpu.nodes.images.descriptors import DaisyExtractor
+
+    img = np.asarray(Image.open(RESOURCE), np.float64)
+    g = 0.2989 * img[:, :, 0] + 0.5870 * img[:, :, 1] + 0.1140 * img[:, :, 2]
+    out = np.asarray(DaisyExtractor().apply(g.astype(np.float32)))
+    assert out.shape == (5336, 200)
+    first_kp = float(out[0].sum())
+    full = float(out.sum())
+    matlab_first = 55.127217737738533
+    matlab_full = 3.240635661296463e5
+    assert abs(first_kp - matlab_first) / matlab_first < 1e-5
+    assert abs(full - matlab_full) / matlab_full < 1e-6
 
 
 def test_lcs_matches_numpy_reference(real_image):
