@@ -13,3 +13,5 @@ from .text import (
 from .indexers import BackoffIndexer, NaiveBitPackIndexer, NGramIndexer
 from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel
 from .annotators import NER, CoreNLPFeatureExtractor, POSTagger
+from .crf import LinearChainCRFTagger
+from .synthetic_corpus import generate_ner_corpus, generate_pos_corpus

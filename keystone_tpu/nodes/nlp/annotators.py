@@ -52,6 +52,26 @@ def bundled_tagger(corpus: str):
         _TRAINED_CACHE[corpus] = tagger
     return tagger
 
+
+def crf_tagger(task: str, n_sentences: int = 4000, seed: int = 0,
+               max_iter: int = 60):
+    """Train (once per process) the jitted linear-chain CRF on a
+    grammar-generated corpus (≈50k tokens at the default size — the
+    broad-coverage analog of the reference's Epic CRF wrappers, built
+    from volume instead of a model download; see synthetic_corpus.py).
+    ``task`` is 'pos' or 'ner'."""
+    key = ("crf", task, n_sentences, seed, max_iter)
+    tagger = _TRAINED_CACHE.get(key)
+    if tagger is None:
+        from .crf import LinearChainCRFTagger
+        from .synthetic_corpus import generate_ner_corpus, generate_pos_corpus
+
+        gen = {"pos": generate_pos_corpus, "ner": generate_ner_corpus}[task]
+        tagger = LinearChainCRFTagger(max_iter=max_iter).train(
+            gen(n_sentences, seed=seed))
+        _TRAINED_CACHE[key] = tagger
+    return tagger
+
 _DETERMINERS = {"the", "a", "an", "this", "that", "these", "those"}
 _PREPOSITIONS = {"in", "on", "at", "by", "for", "with", "to", "from", "of"}
 _PRONOUNS = {"i", "you", "he", "she", "it", "we", "they", "me", "him", "her"}
@@ -113,6 +133,12 @@ class POSTagger(Transformer):
         """Tagger backed by the trained structured-perceptron (Viterbi) model."""
         return cls(model=bundled_tagger("pos_corpus.txt"))
 
+    @classmethod
+    def trained_crf(cls) -> "POSTagger":
+        """Tagger backed by the jitted linear-chain CRF trained on the
+        50k-token generated corpus (crf.py; trains once per process)."""
+        return cls(model=crf_tagger("pos"))
+
     def apply(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
         return list(zip(tokens, self.model(tokens)))
 
@@ -127,6 +153,12 @@ class NER(Transformer):
     def trained(cls) -> "NER":
         """Tagger backed by the trained structured-perceptron (Viterbi) model."""
         return cls(model=bundled_tagger("ner_corpus.txt"))
+
+    @classmethod
+    def trained_crf(cls) -> "NER":
+        """Tagger backed by the jitted linear-chain CRF trained on the
+        generated BIO-tagged corpus (crf.py; trains once per process)."""
+        return cls(model=crf_tagger("ner"))
 
     def apply(self, tokens: Sequence[str]) -> List[Tuple[str, str]]:
         return list(zip(tokens, self.model(tokens)))

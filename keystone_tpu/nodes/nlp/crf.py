@@ -1,0 +1,249 @@
+"""TPU-native linear-chain CRF tagger.
+
+The reference's POS/NER nodes wrap Epic's pretrained linear-chain
+CRF/SemiCRF models (POSTagger.scala:24-36, NER.scala:20-32). This is the
+same model family implemented the TPU way instead of wrapping a JVM
+library: hashed emission features gathered from a (buckets × tags)
+weight table, log-space forward recursion under ``lax.scan`` for the
+exact negative log-likelihood, full-batch L-BFGS via ``optax.lbfgs``,
+and a jitted batched Viterbi decode — training and tagging are each ONE
+compiled XLA program over padded/masked arrays (no Python loops over
+tokens at decode time, unlike the host-side perceptron taggers).
+
+Accuracy is asserted ≥ the structured perceptron on the 50k-token
+synthetic corpora in tests/test_crf_tagger.py.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .perceptron_tagger import _emission_features
+
+_N_FEATS = 12  # _emission_features always yields exactly this many
+
+
+def _hash_features(tokens: Sequence[str], n_buckets: int) -> np.ndarray:
+    """(len(tokens), _N_FEATS) int32 hashed feature ids (stable crc32)."""
+    out = np.empty((len(tokens), _N_FEATS), np.int32)
+    for i in range(len(tokens)):
+        feats = _emission_features(tokens, i)
+        assert len(feats) == _N_FEATS, (
+            "emission feature template changed; update _N_FEATS")
+        for k, f in enumerate(feats):
+            out[i, k] = zlib.crc32(f.encode()) % n_buckets
+    return out
+
+
+def _pad_batch(fid_list: List[np.ndarray], pad_len: int):
+    """Stack ragged (Lᵢ, K) id arrays to (N, pad_len, K) + bool mask."""
+    n = len(fid_list)
+    fids = np.zeros((n, pad_len, _N_FEATS), np.int32)
+    mask = np.zeros((n, pad_len), bool)
+    for i, f in enumerate(fid_list):
+        ln = min(len(f), pad_len)
+        fids[i, :ln] = f[:ln]
+        mask[i, :ln] = True
+    return fids, mask
+
+
+class LinearChainCRFTagger:
+    """Callable tokens → tags, like the perceptron taggers, so it plugs
+    straight into ``POSTagger``/``NER`` via their ``model=`` hook."""
+
+    def __init__(self, n_buckets: int = 1 << 15, l2: float = 1e-4,
+                 max_iter: int = 120, seed: int = 0):
+        self.n_buckets = n_buckets
+        self.l2 = l2
+        self.max_iter = max_iter
+        self.seed = seed
+        self.tags: List[str] = []
+        self.emit: Optional[np.ndarray] = None   # (n_buckets, T)
+        self.trans: Optional[np.ndarray] = None  # (T, T) prev→next
+        self.start: Optional[np.ndarray] = None  # (T,)
+        self._decoders: Dict[int, Callable] = {}
+
+    # -------------------------------------------------------------- training
+
+    def train(self, sentences) -> "LinearChainCRFTagger":
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        sentences = [list(s) for s in sentences if len(s) > 0]
+        self.tags = sorted({t for s in sentences for _, t in s})
+        tag_id = {t: i for i, t in enumerate(self.tags)}
+        T = len(self.tags)
+        max_len = max(len(s) for s in sentences)
+
+        fid_list = [
+            _hash_features([w for w, _ in s], self.n_buckets)
+            for s in sentences
+        ]
+        fids, mask = _pad_batch(fid_list, max_len)
+        gold = np.zeros((len(sentences), max_len), np.int32)
+        for i, s in enumerate(sentences):
+            gold[i, : len(s)] = [tag_id[t] for _, t in s]
+        fids_d = jnp.asarray(fids)
+        mask_d = jnp.asarray(mask)
+        gold_d = jnp.asarray(gold)
+
+        def unpack(theta):
+            emit = theta[: self.n_buckets * T].reshape(self.n_buckets, T)
+            trans = theta[self.n_buckets * T : self.n_buckets * T + T * T
+                          ].reshape(T, T)
+            start = theta[self.n_buckets * T + T * T :]
+            return emit, trans, start
+
+        def nll(theta):
+            emit, trans, start = unpack(theta)
+            emis = emit[fids_d].sum(axis=2)  # (N, L, T)
+            # forward recursion (log-space); masked steps carry alpha
+            alpha0 = start[None, :] + emis[:, 0]
+
+            def step(alpha, xs):
+                e_i, m_i = xs
+                nxt = jax.nn.logsumexp(
+                    alpha[:, :, None] + trans[None], axis=1) + e_i
+                return jnp.where(m_i[:, None], nxt, alpha), None
+
+            alpha, _ = jax.lax.scan(
+                step, alpha0,
+                (emis[:, 1:].swapaxes(0, 1), mask_d[:, 1:].swapaxes(0, 1)),
+            )
+            log_z = jax.nn.logsumexp(alpha, axis=-1)  # (N,)
+
+            # gold path score
+            e_gold = jnp.take_along_axis(
+                emis, gold_d[:, :, None], axis=2)[:, :, 0]
+            e_score = (e_gold * mask_d).sum(axis=1)
+            t_score = (trans[gold_d[:, :-1], gold_d[:, 1:]]
+                       * mask_d[:, 1:]).sum(axis=1)
+            s_score = start[gold_d[:, 0]]
+            gold_score = e_score + t_score + s_score
+            reg = self.l2 * jnp.sum(theta * theta)
+            return jnp.mean(log_z - gold_score) + reg
+
+        theta = jnp.zeros(self.n_buckets * T + T * T + T, jnp.float32)
+        opt = optax.lbfgs()
+        state = opt.init(theta)
+        value_and_grad = optax.value_and_grad_from_state(nll)
+
+        @jax.jit
+        def update(theta, state):
+            value, grad = value_and_grad(theta, state=state)
+            updates, state = opt.update(
+                grad, state, theta, value=value, grad=grad, value_fn=nll)
+            return optax.apply_updates(theta, updates), state, value
+
+        last = np.inf
+        for it in range(self.max_iter):
+            theta, state, value = update(theta, state)
+            v = float(value)
+            if it > 10 and abs(last - v) < 1e-7 * max(1.0, abs(v)):
+                break
+            last = v
+
+        emit, trans, start = unpack(theta)
+        self.emit = np.asarray(emit)
+        self.trans = np.asarray(trans)
+        self.start = np.asarray(start)
+        self._decoders.clear()
+        return self
+
+    # ------------------------------------------------------------- inference
+
+    def _decoder(self, pad_len: int) -> Callable:
+        """Jitted batched Viterbi for one padded length (cached)."""
+        fn = self._decoders.get(pad_len)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        T = len(self.tags)
+        emit_d = jnp.asarray(self.emit)
+        trans_d = jnp.asarray(self.trans)
+        start_d = jnp.asarray(self.start)
+
+        def decode(fids, mask):  # (B, L, K), (B, L)
+            emis = emit_d[fids].sum(axis=2)  # (B, L, T)
+            alpha0 = start_d[None, :] + emis[:, 0]
+            ident = jnp.broadcast_to(jnp.arange(T), (fids.shape[0], T))
+
+            def step(alpha, xs):
+                e_i, m_i = xs
+                cand = alpha[:, :, None] + trans_d[None]  # (B, prev, next)
+                best_prev = jnp.argmax(cand, axis=1)      # (B, T)
+                nxt = jnp.max(cand, axis=1) + e_i
+                alpha = jnp.where(m_i[:, None], nxt, alpha)
+                bp = jnp.where(m_i[:, None], best_prev, ident)
+                return alpha, bp
+
+            alpha, bps = jax.lax.scan(
+                step, alpha0,
+                (emis[:, 1:].swapaxes(0, 1), mask[:, 1:].swapaxes(0, 1)),
+            )  # bps: (L-1, B, T)
+            last = jnp.argmax(alpha, axis=-1)  # (B,)
+
+            def back(tag, bp):
+                return bp[jnp.arange(bp.shape[0]), tag], tag
+
+            first, rest = jax.lax.scan(back, last, bps, reverse=True)
+            # rest is tags for positions 1..L-1 (time-major), first = pos 0
+            return jnp.concatenate(
+                [first[None], rest], axis=0).swapaxes(0, 1)  # (B, L)
+
+        fn = jax.jit(decode)
+        self._decoders[pad_len] = fn
+        return fn
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def predict_batch(self, token_lists: Sequence[Sequence[str]]
+                      ) -> List[List[str]]:
+        if self.emit is None:
+            raise RuntimeError("train() or load() first")
+        out: List[List[str]] = [[] for _ in token_lists]
+        todo = [(i, toks) for i, toks in enumerate(token_lists) if toks]
+        if not todo:
+            return out
+        pad_len = self._bucket(max(len(t) for _, t in todo))
+        fids, mask = _pad_batch(
+            [_hash_features(toks, self.n_buckets) for _, toks in todo],
+            pad_len)
+        ids = np.asarray(self._decoder(pad_len)(fids, mask))
+        for (i, toks), row in zip(todo, ids):
+            out[i] = [self.tags[j] for j in row[: len(toks)]]
+        return out
+
+    def predict(self, tokens: Sequence[str]) -> List[str]:
+        return self.predict_batch([tokens])[0]
+
+    __call__ = predict
+
+    # ----------------------------------------------------------- persistence
+
+    def save(self, path: str) -> None:
+        np.savez_compressed(
+            path, tags=np.asarray(self.tags), emit=self.emit,
+            trans=self.trans, start=self.start,
+            n_buckets=self.n_buckets)
+
+    @classmethod
+    def load(cls, path: str) -> "LinearChainCRFTagger":
+        blob = np.load(path, allow_pickle=False)
+        t = cls(n_buckets=int(blob["n_buckets"]))
+        t.tags = [str(x) for x in blob["tags"]]
+        t.emit = blob["emit"]
+        t.trans = blob["trans"]
+        t.start = blob["start"]
+        return t

@@ -1,0 +1,138 @@
+"""Linear-chain CRF tagger at corpus scale (VERDICT r4 item 5).
+
+The reference wraps Epic's pretrained broad-coverage CRF taggers
+(POSTagger.scala:24-36, NER.scala:20-32). Zero egress rules out model
+downloads, so scale comes from the deterministic grammar generator:
+these tests train the jitted CRF on a ≥50k-token corpus (≈100× the
+bundled mini-corpora the perceptron tests use), hold out a test split,
+and require the CRF to beat-or-match the structured perceptron trained
+on the same data.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.nlp import (
+    LinearChainCRFTagger,
+    generate_ner_corpus,
+    generate_pos_corpus,
+)
+from keystone_tpu.nodes.nlp.perceptron_tagger import StructuredPerceptronTagger
+
+
+def _accuracy(tagger_out, gold):
+    n = c = 0
+    for pred, g in zip(tagger_out, gold):
+        for p, t in zip(pred, g):
+            n += 1
+            c += p == t
+    return c / n
+
+
+@pytest.fixture(scope="module")
+def pos_splits():
+    corpus = generate_pos_corpus(4500, seed=0)
+    assert sum(len(s) for s in corpus) > 45_000  # ≥100× the 124-line bundle
+    return corpus[:4000], corpus[4000:]
+
+
+@pytest.fixture(scope="module")
+def pos_crf(pos_splits):
+    train, _ = pos_splits
+    return LinearChainCRFTagger(max_iter=50).train(train)
+
+
+def test_crf_pos_scale_accuracy(pos_splits, pos_crf):
+    _, test = pos_splits
+    toks = [[w for w, _ in s] for s in test]
+    gold = [[t for _, t in s] for s in test]
+    acc = _accuracy(pos_crf.predict_batch(toks), gold)
+    # the grammar task is learnable but ambiguous (noun/verb homographs,
+    # unseen CD numerals in test); near-ceiling accuracy means the model
+    # genuinely uses context + shape features
+    assert acc > 0.97, acc
+
+
+def test_crf_beats_or_matches_structured_perceptron(pos_splits, pos_crf):
+    """Same train data, same held-out split: exact CRF training must do
+    at least as well as the perceptron (the VERDICT r4 quality bar). The
+    perceptron gets a smaller slice (its pure-python Viterbi train loop
+    is ~100× slower than the CRF's one jitted program — which is the
+    point of the TPU-native design)."""
+    train, test = pos_splits
+    toks = [[w for w, _ in s] for s in test]
+    gold = [[t for _, t in s] for s in test]
+    crf_acc = _accuracy(pos_crf.predict_batch(toks), gold)
+
+    perc = StructuredPerceptronTagger().train(train[:600], n_iter=3)
+    perc_acc = _accuracy([perc(t) for t in toks], gold)
+    small_crf = LinearChainCRFTagger(max_iter=50).train(train[:600])
+    small_crf_acc = _accuracy(small_crf.predict_batch(toks), gold)
+    # like-for-like at 600 sentences, and full-data CRF beats both
+    assert small_crf_acc >= perc_acc - 0.005, (small_crf_acc, perc_acc)
+    assert crf_acc >= max(perc_acc, small_crf_acc), (
+        crf_acc, perc_acc, small_crf_acc)
+
+
+def test_crf_ner_bio(pos_splits):
+    corpus = generate_ner_corpus(2500, seed=1)
+    train, test = corpus[:2200], corpus[2200:]
+    crf = LinearChainCRFTagger(max_iter=50).train(train)
+    toks = [[w for w, _ in s] for s in test]
+    gold = [[t for _, t in s] for s in test]
+    preds = crf.predict_batch(toks)
+    assert _accuracy(preds, gold) > 0.97
+    # BIO structure: I-X never follows O or start in predictions —
+    # transition weights must encode the scheme without hand-coded
+    # constraints
+    for pred in preds:
+        prev = "O"
+        for t in pred:
+            if t.startswith("I-"):
+                assert prev in (t, "B-" + t[2:]), (prev, t, pred)
+            prev = t
+
+
+def test_crf_decode_throughput(pos_crf, pos_splits):
+    """Batched jitted Viterbi must beat the host perceptron's loop by a
+    wide margin (this is the TPU-native payoff; absolute numbers go in
+    PERF.md from the live bench)."""
+    import time
+
+    _, test = pos_splits
+    toks = [[w for w, _ in s] for s in test]
+    n = sum(len(t) for t in toks)
+    pos_crf.predict_batch(toks)  # warm/compile
+    t0 = time.perf_counter()
+    pos_crf.predict_batch(toks)
+    rate = n / (time.perf_counter() - t0)
+    assert rate > 20_000, f"{rate:.0f} tokens/sec"
+
+
+def test_crf_save_load_roundtrip(tmp_path, pos_crf):
+    path = str(tmp_path / "crf.npz")
+    pos_crf.save(path)
+    loaded = LinearChainCRFTagger.load(path)
+    sent = ["the", "company", "reported", "a", "strong", "profit", "."]
+    assert loaded(sent) == pos_crf(sent)
+    assert loaded.tags == pos_crf.tags
+
+
+def test_crf_empty_and_single(pos_crf):
+    assert pos_crf.predict([]) == []
+    out = pos_crf.predict(["the"])
+    assert len(out) == 1 and out[0] in pos_crf.tags
+
+
+def test_postagger_crf_hook():
+    """POSTagger/NER integrate the CRF via the same model= hook as the
+    perceptron (annotators.py crf_tagger trains once per process)."""
+    from keystone_tpu.nodes.nlp import POSTagger
+    from keystone_tpu.nodes.nlp.annotators import crf_tagger
+
+    tagger = POSTagger(model=crf_tagger("pos", n_sentences=300, max_iter=25))
+    pairs = tagger.apply(["the", "manager", "approved", "the", "plan", "."])
+    assert [w for w, _ in pairs] == ["the", "manager", "approved", "the",
+                                     "plan", "."]
+    tags = [t for _, t in pairs]
+    assert tags[0] == "DT" and tags[1] == "NN"
