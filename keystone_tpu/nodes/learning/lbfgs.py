@@ -150,16 +150,197 @@ def _lbfgs_gram_fit(G, C, lam, num_iters: int, memory_size: int):
         return W, values
 
 
+@partial(
+    jax.jit,
+    static_argnames=("d", "num_iters", "memory_size", "fit_intercept",
+                     "row_block", "col_block", "use_col"),
+)
+def _lbfgs_sparse_matvec_fit(
+    idx, val, Y, mask, lam, count, cidx, cval, d: int,
+    num_iters: int, memory_size: int, fit_intercept: bool, row_block: int,
+    col_block: int = 1, use_col: bool = False,
+):
+    """L-BFGS over width-padded sparse rows with per-iteration sparse
+    matvecs — the direct analog of the reference's iteration structure
+    (LBFGS.scala:14-103 + Gradient.scala `LeastSquaresSparseGradient`:
+    per-partition sparse gradient, treeReduce to master, Breeze L-BFGS
+    driver), with the whole optimization ONE scanned XLA program and the
+    data resident on device across iterations.
+
+    For k ≪ d this does O(num_iters · nnz · k) work where the Gram path
+    does O(n · d²) — the regime of the reference's Amazon workload
+    (k=2, sparsity .005, d up to 16384), where one-pass Gram formation
+    is a ~10⁴× FLOP blow-up over 20 iterations of matvecs.
+
+    The objective is quadratic, so the Wolfe line search the reference
+    delegates to Breeze collapses to its closed form: for direction D,
+    t* = −(⟨R, XcD⟩ + λ⟨W, D⟩) / (‖XcD‖² + λ‖D‖²) — one extra matvec
+    per iteration, no search loop. Centering (fit_intercept) is
+    algebraic: Xc@W = X@W − 1(x̄ᵀW); centered data is never materialized.
+
+    idx: (n, w) int32 column ids with sentinel `d` in padding slots.
+    val: (n, w) f32 (0.0 in padding slots). Y: (n, k) f32 (zero rows
+    where ~mask). mask: (n,) f32 marks true rows (n is block-padded).
+    count: true row count (scalar f32). cidx/cval: optional
+    column-oriented padding (see PaddedSparseDataset) — when use_col,
+    Xᵀv is a gather over cidx instead of a scatter-add into the (d, k)
+    gradient (whose massive index collisions serialize on TPU).
+    """
+    n, w = idx.shape
+    k = Y.shape[1]
+    assert n % row_block == 0
+    n_blocks = n // row_block
+    m = memory_size
+    dtype = val.dtype
+
+    def matvec(W):
+        """X @ W → (n, k); W is (d, k), padded to a zero sentinel row."""
+        table = jnp.concatenate([W, jnp.zeros((1, k), W.dtype)], axis=0)
+
+        def one_block(i):
+            ib = jax.lax.dynamic_slice_in_dim(idx, i * row_block, row_block)
+            vb = jax.lax.dynamic_slice_in_dim(val, i * row_block, row_block)
+            g = jnp.take(table, ib, axis=0)  # (b, w, k)
+            return jnp.einsum("bw,bwk->bk", vb, g,
+                              precision=jax.lax.Precision.HIGHEST)
+
+        return jax.lax.map(one_block, jnp.arange(n_blocks)).reshape(n, k)
+
+    if use_col:
+        dc = cidx.shape[0]  # d padded to a col_block multiple
+        assert dc % col_block == 0
+        nbc = dc // col_block
+
+        def tmatvec(R):
+            """Xᵀ @ R → (d, k) as a pure gather over the column form:
+            rows of R indexed by cidx; sentinel ids hit the appended
+            zero row."""
+            Rp = jnp.concatenate([R, jnp.zeros((1, k), R.dtype)], axis=0)
+
+            def one_block(i):
+                cb = jax.lax.dynamic_slice_in_dim(cidx, i * col_block, col_block)
+                vb = jax.lax.dynamic_slice_in_dim(cval, i * col_block, col_block)
+                g = jnp.take(Rp, cb, axis=0)  # (cblk, wc, k)
+                return jnp.einsum("cw,cwk->ck", vb, g,
+                                  precision=jax.lax.Precision.HIGHEST)
+
+            return jax.lax.map(one_block, jnp.arange(nbc)).reshape(dc, k)[:d]
+    else:
+
+        def tmatvec(R):
+            """Xᵀ @ R → (d, k); padding slots scatter into the dropped
+            sentinel row."""
+            def body(i, acc):
+                ib = jax.lax.dynamic_slice_in_dim(idx, i * row_block, row_block)
+                vb = jax.lax.dynamic_slice_in_dim(val, i * row_block, row_block)
+                Rb = jax.lax.dynamic_slice_in_dim(R, i * row_block, row_block)
+                contrib = vb[:, :, None] * Rb[:, None, :]  # (b, w, k)
+                return acc.at[ib.reshape(-1)].add(contrib.reshape(-1, k))
+
+            out = jax.lax.fori_loop(
+                0, n_blocks, body, jnp.zeros((d + 1, k), R.dtype))
+            return out[:d]
+
+    if fit_intercept:
+        if use_col:
+            colsum = jnp.sum(cval, axis=1)[:d]
+        else:
+            colsum = (
+                jnp.zeros((d + 1,), dtype)
+                .at[idx.reshape(-1)]
+                .add(val.reshape(-1))[:d]
+            )
+        xm = colsum / count          # (d,)
+        ym = jnp.sum(Y, axis=0) / count  # (k,)
+    else:
+        xm = jnp.zeros((d,), dtype)
+        ym = jnp.zeros((k,), dtype)
+
+    def centered_matvec(V):
+        """Xc @ V for true rows, 0 for padding: mask ∘ (XV − 1 x̄ᵀV)."""
+        return (matvec(V) - (xm @ V)[None, :]) * mask[:, None]
+
+    def centered_tmatvec(R):
+        """Xcᵀ R (R already masked): XᵀR − x̄ (1ᵀR)."""
+        return tmatvec(R) - jnp.outer(xm, jnp.sum(R, axis=0))
+
+    def grad_of(W, R):
+        return centered_tmatvec(R) + lam * W
+
+    W0 = jnp.zeros((d, k), dtype)
+    R0 = (-(Y - ym[None, :])) * mask[:, None]  # Xc@0 − Yc
+    g0 = grad_of(W0, R0)
+
+    S0 = jnp.zeros((m, d, k), dtype)
+    YH0 = jnp.zeros((m, d, k), dtype)
+    rho0 = jnp.zeros((m,), dtype)
+
+    def step(carry, _):
+        W, R, g, S, YH, rho, ptr = carry
+
+        # two-loop recursion over the ring buffer (static unroll, m≤16)
+        q = g
+        alphas = []
+        for j in range(m):
+            i = (ptr - 1 - j) % m
+            a = rho[i] * jnp.sum(S[i] * q)
+            q = q - a * YH[i]
+            alphas.append((i, a))
+        i_last = (ptr - 1) % m
+        yy = jnp.sum(YH[i_last] * YH[i_last])
+        sy = jnp.sum(S[i_last] * YH[i_last])
+        gamma = jnp.where(yy > 0, sy / jnp.maximum(yy, 1e-30), 1.0)
+        r = gamma * q
+        for i, a in reversed(alphas):
+            b = rho[i] * jnp.sum(YH[i] * r)
+            r = r + S[i] * (a - b)
+        D = -r
+
+        # exact line search on the quadratic
+        u = centered_matvec(D)
+        den = jnp.sum(u * u) + lam * jnp.sum(D * D)
+        num = -(jnp.sum(R * u) + lam * jnp.sum(W * D))
+        t = jnp.where(den > 0, num / jnp.maximum(den, 1e-30), 0.0)
+
+        W_new = W + t * D
+        R_new = R + t * u
+        g_new = grad_of(W_new, R_new)
+
+        s_vec = t * D
+        y_vec = g_new - g
+        sy_new = jnp.sum(s_vec * y_vec)
+        ok = sy_new > 1e-10
+        S = S.at[ptr].set(jnp.where(ok, s_vec, 0.0))
+        YH = YH.at[ptr].set(jnp.where(ok, y_vec, 0.0))
+        rho = rho.at[ptr].set(jnp.where(ok, 1.0 / jnp.where(ok, sy_new, 1.0), 0.0))
+        ptr = (ptr + 1) % m
+
+        value = 0.5 * jnp.sum(R_new * R_new) + 0.5 * lam * jnp.sum(W_new * W_new)
+        return (W_new, R_new, g_new, S, YH, rho, ptr), value
+
+    (W, _, _, _, _, _, _), values = jax.lax.scan(
+        step, (W0, R0, g0, S0, YH0, rho0, jnp.int32(0)), None,
+        length=num_iters)
+    b = ym - xm @ W if fit_intercept else jnp.zeros((k,), dtype)
+    return W, b, values
+
+
 class SparseLBFGSwithL2(LabelEstimator):
     """Sparse-input least squares (LBFGS.scala `SparseLBFGSwithL2`).
 
-    TPU-native treatment of sparsity: the host CSR matrix is reduced ONCE
+    TPU-native treatment of sparsity, two routes picked by estimated
+    device cost (`_route`): **gram** — the host CSR matrix is reduced ONCE
     to Gram statistics G = XᵀX (d×d) and C = XᵀY (d×k) — accumulated in
     row blocks so no dense (n, d) matrix ever materializes — and the
     L-BFGS iterations then run entirely on-device with n dropped out.
     This replaces the reference's per-iteration sparse gradient passes
     (Gradient.scala `LeastSquaresSparseGradient`) with a single sparse
-    pass + dense MXU iterations. Intercept is fit by Gram mean-correction
+    pass + dense MXU iterations — best when d is small or k is wide.
+    **iterative** — `_lbfgs_sparse_matvec_fit`: device-resident
+    width-padded rows, per-iteration gather matvecs, the reference's own
+    iteration structure; O(num_iters·nnz·k) total work, the clear winner
+    in the k ≪ d Amazon regime where Gram formation is a ~10⁴× FLOP
+    blow-up. Intercept is fit by mean-correction in both routes
     (the reference appends a ones column, LBFGS.scala:223-247).
     """
 
@@ -170,18 +351,95 @@ class SparseLBFGSwithL2(LabelEstimator):
         memory_size: int = 10,
         fit_intercept: bool = True,
         block_rows: int = 65536,
+        method: "str | None" = None,
     ):
         self.lam = lam
         self.num_iters = num_iters
         self.memory_size = memory_size
         self.fit_intercept = fit_intercept
         self.block_rows = block_rows
-        self.weight = 1  # one pass over the input
+        if method not in (None, "gram", "iterative"):
+            raise ValueError(f"method must be gram|iterative, got {method!r}")
+        self.method = method
+        # both routes consume the pipeline input ONCE (the iterative
+        # route keeps the padded rows device-resident across iterations),
+        # unlike the reference whose num_iters weight models Spark
+        # recomputing the input RDD every gradient pass
+        self.weight = 1
+
+    def _route(self, n: int, d: int, k: int, w: int) -> str:
+        """Pick Gram-form vs iterative-matvec by estimated device cost —
+        the same decision the reference delegates to its CostModel
+        (LBFGS.scala CostModel: per-iteration nnz flops), re-derived for
+        one chip. Gram: 2·n·d² MXU flops (the blockwise densify GEMM
+        ignores sparsity) at ~2e13 f32 flop/s. Iterative: per iteration
+        two sparse passes touching ~n·w·(8 + 8k) bytes of gather/scatter
+        traffic at ~1e11 B/s effective. Rough constants — overridable
+        via method=."""
+        if self.method is not None:
+            return self.method
+        gram_sec = 2.0 * n * d * d / 2.0e13
+        iter_sec = self.num_iters * 2.0 * n * w * (8.0 + 8.0 * k) / 1.0e11
+        return "iterative" if iter_sec < gram_sec else "gram"
+
+    def _fit_iterative(self, idx, val, d: int, Y, n_true: int, sparse_in: bool,
+                       cidx=None, cval=None):
+        """Run the matvec L-BFGS on width-padded rows already shaped for
+        the device; blocks the row (and column-form) dimension so
+        per-block gather transients stay ≤ ~256 MB of HBM."""
+        n, w = idx.shape
+        k = Y.shape[1]
+        row_block = max(256, min(n, int(256e6 / (8.0 * w * max(k, 1)))))
+        row_block = min(row_block, 1 << 20)
+        n_pad = -(-n // row_block) * row_block
+        idx = jnp.asarray(idx)
+        val = jnp.asarray(val)
+        Y = jnp.asarray(Y, jnp.float32)
+        if n_pad != n:
+            idx = jnp.pad(idx, ((0, n_pad - n), (0, 0)), constant_values=d)
+            val = jnp.pad(val, ((0, n_pad - n), (0, 0)))
+            Y = jnp.pad(Y, ((0, n_pad - n), (0, 0)))
+        mask = (jnp.arange(n_pad) < n_true).astype(val.dtype)
+        use_col = cidx is not None
+        if use_col:
+            cidx = jnp.asarray(cidx)
+            cval = jnp.asarray(cval)
+            wc = cidx.shape[1]
+            col_block = max(8, min(d, int(256e6 / (4.0 * wc * max(k, 1)))))
+            d_pad = -(-d // col_block) * col_block
+            if d_pad != cidx.shape[0]:
+                pad = d_pad - cidx.shape[0]
+                # sentinel row id n_pad+ anything ≥ R's row count is out
+                # of range for take; use the appended zero row (= n_pad)
+                cidx = jnp.pad(cidx, ((0, pad), (0, 0)),
+                               constant_values=n_pad)
+                cval = jnp.pad(cval, ((0, pad), (0, 0)))
+        else:
+            cidx = jnp.zeros((1, 1), jnp.int32)
+            cval = jnp.zeros((1, 1), jnp.float32)
+            col_block = 1
+        W, b, self.loss_history = _lbfgs_sparse_matvec_fit(
+            idx, val, Y, mask,
+            jnp.float32(self.lam), jnp.float32(n_true), cidx, cval, d,
+            self.num_iters, self.memory_size, self.fit_intercept, row_block,
+            col_block, use_col,
+        )
+        bias = b if self.fit_intercept else None
+        return SparseLinearMapper(W, bias) if sparse_in else LinearMapper(W, bias)
 
     def fit(self, data, labels) -> "LinearMapper | SparseLinearMapper":
         import numpy as np
 
-        from ...data.sparse import SparseDataset
+        from ...data.sparse import PaddedSparseDataset, SparseDataset
+
+        if isinstance(data, PaddedSparseDataset):
+            Y = labels.array if isinstance(labels, Dataset) else jnp.asarray(
+                np.asarray(labels), jnp.float32)
+            if Y.shape[0] != data.count:  # Dataset shard-pads rows
+                Y = Y[: data.count]
+            return self._fit_iterative(
+                data.idx, data.val, data.dim, Y, data.count, sparse_in=False,
+                cidx=data.cidx, cval=data.cval)
 
         sparse_in = isinstance(data, SparseDataset)
         if sparse_in:
@@ -191,6 +449,23 @@ class SparseLBFGSwithL2(LabelEstimator):
         Y = labels.numpy() if hasattr(labels, "numpy") else np.asarray(labels)
         n, d = X.shape
         k = Y.shape[1]
+        if sparse_in:
+            import scipy.sparse as sp
+
+            lens = np.diff(sp.csr_matrix(X).indptr)
+            w = max(1, int(lens.max()) if n else 1)
+            # width-padding is shared by both device paths; bail to the
+            # host-scipy Gram when an outlier-dense row blows it up
+            padded_ok = 8.0 * n * w <= 4e9 and not (
+                8.0 * n * w > 32e6 and 8.0 * n * w > 16.0 * 8.0 * max(X.nnz, 1)
+            )
+            if padded_ok and self._route(n, d, k, w) == "iterative":
+                from ...data.sparse import PaddedSparseDataset as _PSD
+
+                padded = _PSD.from_csr(X)
+                return self._fit_iterative(
+                    padded.idx, padded.val, d, np.asarray(Y, np.float32), n,
+                    sparse_in=True, cidx=padded.cidx, cval=padded.cval)
         device_gram = None
         if sparse_in:
             # G/C/col_sum stay device arrays: a (d, d) Gram at d=16384 is

@@ -94,19 +94,27 @@ def test_crf_ner_bio(pos_splits):
 
 
 def test_crf_decode_throughput(pos_crf, pos_splits):
-    """Batched jitted Viterbi must beat the host perceptron's loop by a
-    wide margin (this is the TPU-native payoff; absolute numbers go in
-    PERF.md from the live bench)."""
+    """Batched jitted Viterbi must beat the host perceptron's per-token
+    python Viterbi loop on the same machine (relative bound — absolute
+    numbers go in PERF.md from the live bench)."""
     import time
 
-    _, test = pos_splits
+    train, test = pos_splits
     toks = [[w for w, _ in s] for s in test]
     n = sum(len(t) for t in toks)
     pos_crf.predict_batch(toks)  # warm/compile
     t0 = time.perf_counter()
     pos_crf.predict_batch(toks)
-    rate = n / (time.perf_counter() - t0)
-    assert rate > 20_000, f"{rate:.0f} tokens/sec"
+    crf_rate = n / (time.perf_counter() - t0)
+
+    perc = StructuredPerceptronTagger().train(train[:100], n_iter=1)
+    sub = toks[:50]
+    n_sub = sum(len(t) for t in sub)
+    t0 = time.perf_counter()
+    for t in sub:
+        perc(t)
+    perc_rate = n_sub / (time.perf_counter() - t0)
+    assert crf_rate > 3 * perc_rate, (crf_rate, perc_rate)
 
 
 def test_crf_save_load_roundtrip(tmp_path, pos_crf):
