@@ -76,6 +76,18 @@ class SparseDataset:
         )
 
 
+def padded_form_ok(n: int, w: int, nnz: int) -> bool:
+    """Whether the width-padded (n, w) layout is a sane size for the
+    data: a single outlier-dense row (a ones/bias column, one long
+    document) turns O(nnz) into O(n·d) of padding. One predicate shared
+    by the Gram and iterative sparse routes so their routing can't
+    drift apart."""
+    padded_bytes = 8.0 * n * w
+    return padded_bytes <= 4e9 and not (
+        padded_bytes > 32e6 and padded_bytes > 16.0 * 8.0 * max(nnz, 1)
+    )
+
+
 def pad_csr(matrix: sp.spmatrix):
     """Host CSR → width-padded (n, w) index/value arrays.
 

@@ -24,6 +24,20 @@ from ...data.sparse import SparseDataset
 from ...workflow.pipeline import LabelEstimator, Transformer
 
 
+def _as_dense(x):
+    """Sparse input → dense ndarray. The single-datum serving path
+    receives the 1×V CSR rows `SparseFeatureVectorizer.apply` emits;
+    densifying (rather than gathering by the row's indices) keeps the
+    scoring shape-stable across documents, so warm serving never
+    recompiles — the single/batch duality of Operator.scala:77-100."""
+    import scipy.sparse as sp
+
+    if sp.issparse(x):
+        arr = np.asarray(x.todense())
+        return arr.ravel() if arr.shape[0] == 1 else arr
+    return x
+
+
 class NaiveBayesModel(Transformer):
     """x → log-posterior vector (NaiveBayesModel.scala:12-40)."""
 
@@ -32,7 +46,11 @@ class NaiveBayesModel(Transformer):
         self.log_cond = jnp.asarray(log_cond)  # (k, d)
 
     def apply(self, x):
-        return self.log_priors + jnp.asarray(x) @ self.log_cond.T
+        x = _as_dense(x)
+        out = _nb_scores(
+            jnp.atleast_2d(jnp.asarray(x, jnp.float32)),
+            self.log_priors, self.log_cond)
+        return out[0] if np.ndim(x) == 1 else out
 
     def apply_batch(self, data):
         if isinstance(data, SparseDataset):
@@ -112,7 +130,7 @@ class LogisticRegressionModel(Transformer):
         self.W = W
 
     def apply(self, x):
-        return jnp.argmax(jnp.asarray(x) @ self.W, axis=-1)
+        return jnp.argmax(jnp.asarray(_as_dense(x)) @ self.W, axis=-1)
 
     def apply_batch(self, data):
         if isinstance(data, SparseDataset):

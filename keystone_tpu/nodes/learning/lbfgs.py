@@ -150,15 +150,10 @@ def _lbfgs_gram_fit(G, C, lam, num_iters: int, memory_size: int):
         return W, values
 
 
-@partial(
-    jax.jit,
-    static_argnames=("d", "num_iters", "memory_size", "fit_intercept",
-                     "row_block", "col_block", "use_col"),
-)
-def _lbfgs_sparse_matvec_fit(
+def _sparse_matvec_fit_impl(
     idx, val, Y, mask, lam, count, cidx, cval, d: int,
     num_iters: int, memory_size: int, fit_intercept: bool, row_block: int,
-    col_block: int = 1, use_col: bool = False,
+    col_block: int = 1, use_col: bool = False, axis_name=None,
 ):
     """L-BFGS over width-padded sparse rows with per-iteration sparse
     matvecs — the direct analog of the reference's iteration structure
@@ -185,6 +180,13 @@ def _lbfgs_sparse_matvec_fit(
     column-oriented padding (see PaddedSparseDataset) — when use_col,
     Xᵀv is a gather over cidx instead of a scatter-add into the (d, k)
     gradient (whose massive index collisions serialize on TPU).
+
+    With `axis_name` set this body runs inside shard_map with the row
+    arrays dp-sharded: every row-space reduction (gradient, colsum,
+    line-search inner products, loss) all-reduces over the mesh — the
+    psum standing exactly where the reference treeReduces per-partition
+    gradients to the master (LBFGS.scala:97-103); W and the L-BFGS
+    history stay replicated like the reference's broadcast model.
     """
     n, w = idx.shape
     k = Y.shape[1]
@@ -192,6 +194,11 @@ def _lbfgs_sparse_matvec_fit(
     n_blocks = n // row_block
     m = memory_size
     dtype = val.dtype
+
+    def dsum(x):
+        """Sum a row-space reduction over the data axis (identity when
+        running unsharded)."""
+        return jax.lax.psum(x, axis_name) if axis_name else x
 
     def matvec(W):
         """X @ W → (n, k); W is (d, k), padded to a zero sentinel row."""
@@ -239,19 +246,19 @@ def _lbfgs_sparse_matvec_fit(
 
             out = jax.lax.fori_loop(
                 0, n_blocks, body, jnp.zeros((d + 1, k), R.dtype))
-            return out[:d]
+            return dsum(out[:d])
 
     if fit_intercept:
         if use_col:
             colsum = jnp.sum(cval, axis=1)[:d]
         else:
-            colsum = (
+            colsum = dsum(
                 jnp.zeros((d + 1,), dtype)
                 .at[idx.reshape(-1)]
                 .add(val.reshape(-1))[:d]
             )
         xm = colsum / count          # (d,)
-        ym = jnp.sum(Y, axis=0) / count  # (k,)
+        ym = dsum(jnp.sum(Y, axis=0)) / count  # (k,)
     else:
         xm = jnp.zeros((d,), dtype)
         ym = jnp.zeros((k,), dtype)
@@ -261,8 +268,9 @@ def _lbfgs_sparse_matvec_fit(
         return (matvec(V) - (xm @ V)[None, :]) * mask[:, None]
 
     def centered_tmatvec(R):
-        """Xcᵀ R (R already masked): XᵀR − x̄ (1ᵀR)."""
-        return tmatvec(R) - jnp.outer(xm, jnp.sum(R, axis=0))
+        """Xcᵀ R (R already masked): XᵀR − x̄ (1ᵀR); 1ᵀR is a row-space
+        reduction so it all-reduces like the matvec itself."""
+        return tmatvec(R) - jnp.outer(xm, dsum(jnp.sum(R, axis=0)))
 
     def grad_of(W, R):
         return centered_tmatvec(R) + lam * W
@@ -296,10 +304,11 @@ def _lbfgs_sparse_matvec_fit(
             r = r + S[i] * (a - b)
         D = -r
 
-        # exact line search on the quadratic
+        # exact line search on the quadratic; ⟨u,u⟩ and ⟨R,u⟩ live in
+        # row space (sharded), the λ terms in replicated model space
         u = centered_matvec(D)
-        den = jnp.sum(u * u) + lam * jnp.sum(D * D)
-        num = -(jnp.sum(R * u) + lam * jnp.sum(W * D))
+        den = dsum(jnp.sum(u * u)) + lam * jnp.sum(D * D)
+        num = -(dsum(jnp.sum(R * u)) + lam * jnp.sum(W * D))
         t = jnp.where(den > 0, num / jnp.maximum(den, 1e-30), 0.0)
 
         W_new = W + t * D
@@ -315,7 +324,8 @@ def _lbfgs_sparse_matvec_fit(
         rho = rho.at[ptr].set(jnp.where(ok, 1.0 / jnp.where(ok, sy_new, 1.0), 0.0))
         ptr = (ptr + 1) % m
 
-        value = 0.5 * jnp.sum(R_new * R_new) + 0.5 * lam * jnp.sum(W_new * W_new)
+        value = (0.5 * dsum(jnp.sum(R_new * R_new))
+                 + 0.5 * lam * jnp.sum(W_new * W_new))
         return (W_new, R_new, g_new, S, YH, rho, ptr), value
 
     (W, _, _, _, _, _, _), values = jax.lax.scan(
@@ -323,6 +333,63 @@ def _lbfgs_sparse_matvec_fit(
         length=num_iters)
     b = ym - xm @ W if fit_intercept else jnp.zeros((k,), dtype)
     return W, b, values
+
+
+@partial(
+    jax.jit,
+    static_argnames=("d", "num_iters", "memory_size", "fit_intercept",
+                     "row_block", "col_block", "use_col"),
+)
+def _lbfgs_sparse_matvec_fit(
+    idx, val, Y, mask, lam, count, cidx, cval, d: int,
+    num_iters: int, memory_size: int, fit_intercept: bool, row_block: int,
+    col_block: int = 1, use_col: bool = False,
+):
+    """Single-device entry for `_sparse_matvec_fit_impl`."""
+    return _sparse_matvec_fit_impl(
+        idx, val, Y, mask, lam, count, cidx, cval, d,
+        num_iters, memory_size, fit_intercept, row_block, col_block, use_col)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("d", "num_iters", "memory_size", "fit_intercept",
+                     "row_block", "mesh"),
+)
+def _lbfgs_sparse_matvec_fit_sharded(
+    idx, val, Y, mask, lam, count, d: int,
+    num_iters: int, memory_size: int, fit_intercept: bool, row_block: int,
+    mesh=None,
+):
+    """dp-sharded entry: rows split over the mesh 'data' axis under
+    shard_map; W and the L-BFGS history replicate, row-space reductions
+    psum (the reference's treeReduce-to-master, LBFGS.scala:97-103)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel import mesh as meshlib
+
+    try:
+        from jax import shard_map
+        kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+        kw = {"check_rep": False}
+
+    def body(idx_s, val_s, Y_s, mask_s, lam_s, count_s):
+        dummy = jnp.zeros((1, 1), jnp.float32)
+        return _sparse_matvec_fit_impl(
+            idx_s, val_s, Y_s, mask_s, lam_s, count_s,
+            dummy.astype(jnp.int32), dummy, d,
+            num_iters, memory_size, fit_intercept, row_block,
+            col_block=1, use_col=False, axis_name=meshlib.DATA_AXIS)
+
+    row = P(meshlib.DATA_AXIS)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(row, row, row, row, P(), P()),
+        out_specs=(P(), P(), P()),
+        **kw,
+    )(idx, val, Y, mask, lam, count)
 
 
 class SparseLBFGSwithL2(LabelEstimator):
@@ -387,11 +454,22 @@ class SparseLBFGSwithL2(LabelEstimator):
         """Run the matvec L-BFGS on width-padded rows already shaped for
         the device; blocks the row (and column-form) dimension so
         per-block gather transients stay ≤ ~256 MB of HBM."""
+        from ...parallel import mesh as meshlib
+
         n, w = idx.shape
         k = Y.shape[1]
-        row_block = max(256, min(n, int(256e6 / (8.0 * w * max(k, 1)))))
-        row_block = min(row_block, 1 << 20)
-        n_pad = -(-n // row_block) * row_block
+        mesh = meshlib.current_mesh()
+        data_shards = (int(mesh.shape.get(meshlib.DATA_AXIS, 1))
+                       if mesh is not None else 1)
+        # dp-sharded: TRUE rows must spread across shards (shard_map
+        # splits the leading axis into contiguous per-device chunks), so
+        # size the block within the PER-SHARD row count, then pad the
+        # global count to shards × (a block multiple of that local size)
+        n_per = -(-n // data_shards)
+        budget = max(256, int(256e6 / (8.0 * w * max(k, 1))))
+        row_block = min(n_per, budget, 1 << 20)
+        local = -(-n_per // row_block) * row_block
+        n_pad = local * data_shards
         idx = jnp.asarray(idx)
         val = jnp.asarray(val)
         Y = jnp.asarray(Y, jnp.float32)
@@ -400,6 +478,16 @@ class SparseLBFGSwithL2(LabelEstimator):
             val = jnp.pad(val, ((0, n_pad - n), (0, 0)))
             Y = jnp.pad(Y, ((0, n_pad - n), (0, 0)))
         mask = (jnp.arange(n_pad) < n_true).astype(val.dtype)
+        if data_shards > 1:
+            W, b, self.loss_history = _lbfgs_sparse_matvec_fit_sharded(
+                idx, val, Y, mask,
+                jnp.float32(self.lam), jnp.float32(n_true), d,
+                self.num_iters, self.memory_size, self.fit_intercept,
+                row_block, mesh=mesh,
+            )
+            bias = b if self.fit_intercept else None
+            return (SparseLinearMapper(W, bias) if sparse_in
+                    else LinearMapper(W, bias))
         use_col = cidx is not None
         if use_col:
             cidx = jnp.asarray(cidx)
@@ -456,13 +544,20 @@ class SparseLBFGSwithL2(LabelEstimator):
             w = max(1, int(lens.max()) if n else 1)
             # width-padding is shared by both device paths; bail to the
             # host-scipy Gram when an outlier-dense row blows it up
-            padded_ok = 8.0 * n * w <= 4e9 and not (
-                8.0 * n * w > 32e6 and 8.0 * n * w > 16.0 * 8.0 * max(X.nnz, 1)
-            )
-            if padded_ok and self._route(n, d, k, w) == "iterative":
-                from ...data.sparse import PaddedSparseDataset as _PSD
+            from ...data.sparse import padded_form_ok
 
-                padded = _PSD.from_csr(X)
+            if padded_form_ok(n, w, X.nnz) and (
+                    self._route(n, d, k, w) == "iterative"):
+                from ...data.sparse import PaddedSparseDataset as _PSD
+                from ...parallel import mesh as meshlib
+
+                m = meshlib.current_mesh()
+                sharded = (m is not None
+                           and int(m.shape.get(meshlib.DATA_AXIS, 1)) > 1)
+                # the dp-sharded route uses scatter tmatvec per shard —
+                # building/transferring the column form would be wasted
+                # host work and a second O(nnz) pair of device arrays
+                padded = _PSD.from_csr(X, column_form=not sharded)
                 return self._fit_iterative(
                     padded.idx, padded.val, d, np.asarray(Y, np.float32), n,
                     sparse_in=True, cidx=padded.cidx, cval=padded.cval)
@@ -556,30 +651,18 @@ def _sparse_gram_on_device(X, Y, block_rows: int):
     import numpy as np
     import scipy.sparse as sp
 
+    from ...data.sparse import pad_csr, padded_form_ok
+
     X = sp.csr_matrix(X)
     n, d = X.shape
     lens = np.diff(X.indptr)
     w = max(1, int(lens.max()) if n else 1)
-    # Width-padding costs O(n·w): a single outlier dense row (a bias/ones
-    # column, one long document) would turn an O(nnz) problem into tens
-    # of GB of padding. Bail to the caller's host-scipy path when the
-    # padded form is much bigger than the data or just plain large —
     # a row cannot be split across padded slots (the Gram needs each
-    # row's full outer product; splitting drops the cross terms).
-    padded_bytes = 8.0 * n * w
-    if padded_bytes > 4e9 or (
-        padded_bytes > 32e6 and padded_bytes > 16.0 * 8.0 * max(X.nnz, 1)
-    ):
+    # row's full outer product; splitting drops the cross terms), so
+    # bail to the caller's host-scipy path on pathological padding
+    if not padded_form_ok(n, w, X.nnz):
         return None
-    # flat scatter positions: row r occupies slots [r*w, r*w + lens[r])
-    row_ids = np.repeat(np.arange(n, dtype=np.int64), lens)
-    pos_in_row = np.arange(X.nnz, dtype=np.int64) - np.repeat(
-        X.indptr[:-1].astype(np.int64), lens
-    )
-    idx_pad = np.full((n, w), d, np.int32)  # sentinel column d
-    val_pad = np.zeros((n, w), np.float32)
-    idx_pad[row_ids, pos_in_row] = X.indices
-    val_pad[row_ids, pos_in_row] = X.data
+    idx_pad, val_pad = pad_csr(X)
     # bound the densified block at ~512 MB of HBM, honoring a smaller
     # caller-specified block_rows (tests use tiny blocks to exercise the
     # multi-block accumulation path)

@@ -1,0 +1,125 @@
+"""Single-datum serving latency (VERDICT r4 #7).
+
+Measures warm `FittedPipeline.apply(datum)` p50/p90/p99 for the
+RandomPatchCifar image pipeline and the Newsgroups text pipeline — the
+reference's single-item hot loop (Operator.scala:77-100 single dispatch,
+FittedPipeline.scala:38). Prints one JSON line; results land in PERF.md.
+
+Usage: python scripts/serving_latency.py [--reps 200] [--out -]
+       KEYSTONE_BACKEND=cpu python scripts/serving_latency.py --reps 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentiles(samples):
+    a = np.asarray(samples) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)), 3),
+        "p90_ms": round(float(np.percentile(a, 90)), 3),
+        "p99_ms": round(float(np.percentile(a, 99)), 3),
+        "mean_ms": round(float(a.mean()), 3),
+        "reps": len(samples),
+    }
+
+
+def bench_cifar(reps: int):
+    from keystone_tpu.loaders.cifar_loader import synthetic_cifar
+    from keystone_tpu.pipelines.random_patch_cifar import (
+        RandomPatchCifarConfig,
+        build_pipeline,
+    )
+    from keystone_tpu.workflow import PipelineEnv
+
+    PipelineEnv.reset()
+    config = RandomPatchCifarConfig(num_filters=256)
+    train, _ = synthetic_cifar(2048, 64, config.num_classes, config.seed)
+    fitted = build_pipeline(train, config).fit()
+    images = np.asarray(train.data.numpy())[:reps + 8]
+
+    int(fitted.apply(images[0]))  # warm the batch=1 programs
+    int(fitted.apply(images[1]))
+    samples = []
+    for i in range(reps):
+        x = images[2 + (i % (len(images) - 2))]
+        t0 = time.perf_counter()
+        out = int(fitted.apply(x))  # int() = host sync
+        samples.append(time.perf_counter() - t0)
+        assert 0 <= out < config.num_classes
+    return _percentiles(samples)
+
+
+def bench_newsgroups(reps: int):
+    from keystone_tpu.nodes.learning import NaiveBayesEstimator
+    from keystone_tpu.nodes.nlp import (
+        LowerCase,
+        NGramsFeaturizer,
+        TermFrequency,
+        Tokenizer,
+        Trim,
+    )
+    from keystone_tpu.nodes.util import CommonSparseFeatures, MaxClassifier
+    from keystone_tpu.pipelines.text_pipelines import synthetic_corpus
+    from keystone_tpu.workflow import PipelineEnv
+
+    PipelineEnv.reset()
+    labels, docs = synthetic_corpus(800, 4, seed=0)
+    featurizer = (
+        Trim().to_pipeline()
+        >> LowerCase()
+        >> Tokenizer()
+        >> NGramsFeaturizer((1, 2))
+        >> TermFrequency()
+    ).and_then(CommonSparseFeatures(100_000), docs)
+    predictor = featurizer.and_then(
+        NaiveBayesEstimator(4), docs, labels) >> MaxClassifier()
+    fitted = predictor.fit()
+    items = list(docs.items)
+
+    int(fitted.apply(items[0]))  # warm
+    int(fitted.apply(items[1]))
+    samples = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        out = int(fitted.apply(items[2 + (i % (len(items) - 2))]))
+        samples.append(time.perf_counter() - t0)
+        assert 0 <= out < 4
+    return _percentiles(samples)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--reps", type=int, default=200)
+    p.add_argument("--out", default="-")
+    args = p.parse_args()
+    if os.environ.get("KEYSTONE_BACKEND") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    record = {
+        "workload": "single-datum serving latency (warm, batch=1 jitted)",
+        "platform": jax.devices()[0].platform,
+        "random_patch_cifar": bench_cifar(args.reps),
+        "newsgroups": bench_newsgroups(args.reps),
+    }
+    line = json.dumps(record)
+    print(line)
+    if args.out != "-":
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Single-datum serving path (VERDICT r4 #7).
+
+The reference's dual batch/single dispatch (Operator.scala:77-100,
+`batchTransform` vs `singleTransform` chosen by expression type) is a
+core preserved property: a fitted pipeline serves one datum through the
+same fitted state as a batch, with NO recompilation per request. These
+tests pin both halves: single/batch parity, and warm applies triggering
+zero XLA compilations (detected via jax's monitoring events, not
+timing).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow import PipelineEnv
+
+
+@pytest.fixture(autouse=True)
+def _reset_env():
+    PipelineEnv.reset()
+    yield
+    PipelineEnv.reset()
+
+
+def _compile_events(fn):
+    """Run fn, return the number of XLA compile requests it triggered."""
+    from jax._src import monitoring
+
+    events = []
+
+    def listener(name, **kw):
+        if name == "/jax/compilation_cache/compile_requests_use_cache":
+            events.append(name)
+
+    monitoring.register_event_listener(listener)
+    try:
+        out = fn()
+    finally:
+        try:
+            monitoring._event_listeners.remove(listener)
+        except ValueError:  # pragma: no cover - listener wrapper changed
+            monitoring.clear_event_listeners()
+    return len(events), out
+
+
+def _fitted_cifar():
+    from keystone_tpu.loaders.cifar_loader import synthetic_cifar
+    from keystone_tpu.pipelines.random_patch_cifar import (
+        RandomPatchCifarConfig,
+        build_pipeline,
+    )
+
+    config = RandomPatchCifarConfig(
+        num_filters=16, block_size=64, microbatch=32,
+        synth_train=0, synth_test=0)
+    train, _ = synthetic_cifar(64, 8, config.num_classes, config.seed)
+    predictor = build_pipeline(train, config)
+    return predictor.fit(), train
+
+
+def test_cifar_single_datum_parity_and_no_recompile():
+    fitted, train = _fitted_cifar()
+    images = np.asarray(train.data.numpy())
+    batch_preds = np.asarray(fitted.apply(train.data).numpy())
+
+    # warm the single-datum (batch=1) programs
+    first = fitted.apply(images[0])
+    assert int(first) == int(batch_preds[0])
+
+    # warm serving must not compile anything new, and must match the
+    # batch path datum-for-datum (single/batch duality)
+    def serve():
+        return [int(fitted.apply(images[i])) for i in range(1, 4)]
+
+    n_compiles, preds = _compile_events(serve)
+    assert n_compiles == 0, (
+        f"single-datum serving recompiled {n_compiles} programs on warm "
+        "applies — the batch=1 path must stay jit-cached")
+    assert preds == [int(p) for p in batch_preds[1:4]]
+
+
+def test_newsgroups_single_doc_parity_and_no_recompile():
+    from keystone_tpu.nodes.learning import NaiveBayesEstimator
+    from keystone_tpu.nodes.nlp import (
+        LowerCase,
+        NGramsFeaturizer,
+        TermFrequency,
+        Tokenizer,
+        Trim,
+    )
+    from keystone_tpu.nodes.util import CommonSparseFeatures, MaxClassifier
+    from keystone_tpu.pipelines.text_pipelines import synthetic_corpus
+
+    labels, docs = synthetic_corpus(80, 3, seed=0)
+    featurizer = (
+        Trim().to_pipeline()
+        >> LowerCase()
+        >> Tokenizer()
+        >> NGramsFeaturizer((1, 2))
+        >> TermFrequency()
+    ).and_then(CommonSparseFeatures(500), docs)
+    predictor = featurizer.and_then(
+        NaiveBayesEstimator(3), docs, labels) >> MaxClassifier()
+    fitted = predictor.fit()
+
+    doc_items = list(docs.items)
+    batch_preds = [int(p) for p in fitted.apply(docs).numpy()]
+    first = fitted.apply(doc_items[0])  # warm batch=1 programs
+    assert int(first) == batch_preds[0]
+
+    def serve():
+        return [int(fitted.apply(doc_items[i])) for i in range(1, 4)]
+
+    n_compiles, preds = _compile_events(serve)
+    assert n_compiles == 0, (
+        f"single-doc serving recompiled {n_compiles} programs")
+    assert preds == batch_preds[1:4]

@@ -437,12 +437,16 @@ def test_sparse_lbfgs_route_cost_model():
 def test_padded_sparse_column_form_paths_agree():
     """Scatter tmatvec (row form) vs gather tmatvec (column form) vs the
     device-built column form (with_column_form argsort path): all three
-    produce the same fit."""
+    produce the same fit. Pinned to a 1-device mesh — under a multi-
+    device mesh the solver takes the dp-sharded route instead (covered
+    by test_sparse_lbfgs_iterative_dp_sharded_agrees)."""
+    import jax
     import jax.numpy as jnp
     import scipy.sparse as sp
 
     from keystone_tpu.data.sparse import PaddedSparseDataset
     from keystone_tpu.nodes.learning import SparseLBFGSwithL2
+    from keystone_tpu.parallel.mesh import make_mesh, use_mesh
 
     rng = np.random.default_rng(23)
     n, d, k = 500, 64, 2
@@ -462,10 +466,45 @@ def test_padded_sparse_column_form_paths_agree():
         np.asarray(jnp.sort(with_col.cval, axis=1)),
         np.asarray(jnp.sort(dev_col.cval, axis=1)), atol=0)
 
-    fits = [
-        SparseLBFGSwithL2(lam=1.0, num_iters=50).fit(ds, Dataset(Y))
-        for ds in (with_col, no_col, dev_col)
-    ]
+    with use_mesh(make_mesh(jax.devices()[:1])):
+        fits = [
+            SparseLBFGSwithL2(lam=1.0, num_iters=50).fit(ds, Dataset(Y))
+            for ds in (with_col, no_col, dev_col)
+        ]
     for m in fits[1:]:
         np.testing.assert_allclose(
             np.asarray(fits[0].W), np.asarray(m.W), atol=1e-4, rtol=1e-4)
+
+
+def test_sparse_lbfgs_iterative_dp_sharded_agrees():
+    """Under a multi-device mesh the iterative route dp-shards rows via
+    shard_map (psum where the reference treeReduces gradients,
+    LBFGS.scala:97-103); the fit must agree with the 1-device fit and
+    with the ridge closed form."""
+    import jax
+    import scipy.sparse as sp
+
+    from keystone_tpu.data.sparse import SparseDataset
+    from keystone_tpu.nodes.learning import SparseLBFGSwithL2
+    from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs multi-device mesh")
+    rng = np.random.default_rng(29)
+    n, d, k = 603, 48, 2  # not divisible by the 8-device data axis
+    dense = (rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.1)).astype(
+        np.float32)
+    X = sp.csr_matrix(dense)
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    est = lambda: SparseLBFGSwithL2(lam=1.0, num_iters=60, method="iterative")
+    with use_mesh(make_mesh(jax.devices())):
+        m_mesh = est().fit(SparseDataset(X), Dataset(Y))
+    with use_mesh(make_mesh(jax.devices()[:1])):
+        m_one = est().fit(SparseDataset(X), Dataset(Y))
+    np.testing.assert_allclose(
+        np.asarray(m_mesh.W), np.asarray(m_one.W), atol=1e-3, rtol=1e-3)
+    Wref, bref = ridge_closed_form(dense, Y, 1.0)
+    np.testing.assert_allclose(np.asarray(m_mesh.W), Wref, atol=5e-2, rtol=5e-2)
+    np.testing.assert_allclose(np.asarray(m_mesh.b), bref, atol=5e-2)
