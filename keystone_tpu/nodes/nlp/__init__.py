@@ -11,7 +11,12 @@ from .text import (
     WordFrequencyEncoder,
 )
 from .indexers import BackoffIndexer, NaiveBitPackIndexer, NGramIndexer
-from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel
+from .stupid_backoff import (
+    PackedStupidBackoffEstimator,
+    PackedStupidBackoffModel,
+    StupidBackoffEstimator,
+    StupidBackoffModel,
+)
 from .annotators import NER, CoreNLPFeatureExtractor, POSTagger
 from .crf import LinearChainCRFTagger
 from .synthetic_corpus import generate_ner_corpus, generate_pos_corpus

@@ -62,6 +62,26 @@ def synthetic_corpus(n_docs: int, num_classes: int, vocab_size: int = 400,
     return HostDataset(labels), HostDataset(docs)
 
 
+def build_newsgroups_predictor(train_docs, train_labels, num_classes: int,
+                               ngram_orders: tuple = (1, 2),
+                               common_features: int = 100_000):
+    """The canonical Newsgroups pipeline (NewsgroupsPipeline.scala:
+    Trim → LowerCase → Tokenizer → NGrams → √TF → CommonSparseFeatures →
+    NaiveBayes → MaxClassifier). One builder shared by the app, the
+    serving-latency benchmark, and the serving tests, so they cannot
+    drift onto different pipelines."""
+    featurizer = (
+        Trim().to_pipeline()
+        >> LowerCase()
+        >> Tokenizer()
+        >> NGramsFeaturizer(ngram_orders)
+        >> TermFrequency(math.sqrt)
+    ).and_then(CommonSparseFeatures(common_features), train_docs)
+    return featurizer.and_then(
+        NaiveBayesEstimator(num_classes), train_docs, train_labels
+    ) >> MaxClassifier()
+
+
 @dataclass
 class NewsgroupsConfig:
     train_path: Optional[str] = None
@@ -89,16 +109,10 @@ def run_newsgroups(config: NewsgroupsConfig):
             config.n_synth // 4, num_classes, seed=config.seed + 1
         )
 
-    featurizer = (
-        Trim().to_pipeline()
-        >> LowerCase()
-        >> Tokenizer()
-        >> NGramsFeaturizer(config.ngram_orders)
-        >> TermFrequency(math.sqrt)
-    ).and_then(CommonSparseFeatures(config.common_features), train_docs)
-    predictor = featurizer.and_then(
-        NaiveBayesEstimator(num_classes), train_docs, train_labels
-    ) >> MaxClassifier()
+    predictor = build_newsgroups_predictor(
+        train_docs, train_labels, num_classes,
+        ngram_orders=config.ngram_orders,
+        common_features=config.common_features)
 
     t0 = time.perf_counter()
     evaluator = MulticlassClassifierEvaluator(num_classes)

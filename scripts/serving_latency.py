@@ -60,30 +60,15 @@ def bench_cifar(reps: int):
 
 
 def bench_newsgroups(reps: int):
-    from keystone_tpu.nodes.learning import NaiveBayesEstimator
-    from keystone_tpu.nodes.nlp import (
-        LowerCase,
-        NGramsFeaturizer,
-        TermFrequency,
-        Tokenizer,
-        Trim,
+    from keystone_tpu.pipelines.text_pipelines import (
+        build_newsgroups_predictor,
+        synthetic_corpus,
     )
-    from keystone_tpu.nodes.util import CommonSparseFeatures, MaxClassifier
-    from keystone_tpu.pipelines.text_pipelines import synthetic_corpus
     from keystone_tpu.workflow import PipelineEnv
 
     PipelineEnv.reset()
     labels, docs = synthetic_corpus(800, 4, seed=0)
-    featurizer = (
-        Trim().to_pipeline()
-        >> LowerCase()
-        >> Tokenizer()
-        >> NGramsFeaturizer((1, 2))
-        >> TermFrequency()
-    ).and_then(CommonSparseFeatures(100_000), docs)
-    predictor = featurizer.and_then(
-        NaiveBayesEstimator(4), docs, labels) >> MaxClassifier()
-    fitted = predictor.fit()
+    fitted = build_newsgroups_predictor(docs, labels, 4).fit()
     items = list(docs.items)
 
     int(fitted.apply(items[0]))  # warm

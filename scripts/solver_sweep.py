@@ -173,8 +173,9 @@ def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9,
     # iteration structure (per-partition sparse gradients, LBFGS.scala)
     # rather than one-pass Gram formation, which at k=2 is a ~10⁴× FLOP
     # blow-up. The problem is GENERATED on device (jitted PRNG): at
-    # d≤2048 the FULL reference n=65e6 fits one chip's HBM, so those
-    # rows need no n-scaling at all.
+    # d=1024 (w=5) the FULL reference n=65e6 fits the padded-layout
+    # budget — no n-scaling at all; wider d runs at the largest n the
+    # budget allows (d=2048 → 32.5M rows, d=16384 → ~4M).
     amz_n_full = 20_000 if quick else AMAZON_N
     for d in (dims if "amazon" in experiments else ()):
         from keystone_tpu.data.sparse import PaddedSparseDataset

@@ -554,3 +554,62 @@ def test_stupid_backoff_reference_corpus_exact_scores():
     assert abs(
         lm.score(("is-unseen", "coming")) - lm.alpha * 3.0 / num_tokens
     ) < 1e-12
+
+
+def test_packed_stupid_backoff_matches_recursive_model():
+    """PackedStupidBackoffModel (sorted bit-packed arrays, iterative
+    vectorized scoring, InitialBigramPartitioner-style first-two-words
+    grouping) reproduces the recursive dict model's scores on every
+    query class: seen trigram, backed-off bigram, double-backoff,
+    OOV members, and bare unigrams. Also pins the reference suite's
+    exact values and the 12-bytes/ngram memory bound."""
+    from collections import Counter
+
+    from keystone_tpu.nodes.nlp import (
+        PackedStupidBackoffEstimator,
+        StupidBackoffEstimator,
+    )
+
+    rng = np.random.default_rng(0)
+    vocab = [f"t{i}" for i in range(300)]
+    docs = [
+        [vocab[j] for j in rng.zipf(1.4, size=40) % 300]
+        for _ in range(200)
+    ]
+    packed = PackedStupidBackoffEstimator().fit(HostDataset(docs))
+
+    ngrams = Counter()
+    unigrams = Counter()
+    for toks in docs:
+        for o in (2, 3):
+            for i in range(len(toks) - o + 1):
+                ngrams[tuple(toks[i:i + o])] += 1
+        for w in toks:
+            unigrams[w] += 1
+    ref = StupidBackoffEstimator(unigram_counts=dict(unigrams)).fit(
+        HostDataset([ngrams]))
+
+    queries = []
+    for toks in docs[:40]:
+        for i in range(len(toks) - 2):
+            queries.append(tuple(toks[i:i + 3]))
+    queries += [
+        ("t1", "t2"), ("t5",), ("oov-x", "t2", "t3"),
+        ("t1", "oov-x", "t3"), ("t1", "t2", "oov-x"), ("oov-x",),
+    ]
+    got = packed.score_batch(queries)
+    want = np.array([ref.score(q) for q in queries])
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+
+    # memory bound: 12 bytes per distinct 2/3-gram + unigram vector
+    n_types = len(packed.keys)
+    assert packed.nbytes <= 12 * n_types + 8 * len(packed.unigram) + 64
+
+    # reference suite exact values through the packed path
+    data = ["Winter is coming", "Finals are coming",
+            "Summer is coming really soon"]
+    pk = PackedStupidBackoffEstimator().fit(
+        HostDataset([s.split() for s in data]))
+    assert abs(pk.score(("is", "coming")) - 1.0) < 1e-12
+    assert pk.score(("is", "unseen-coming")) == 0.0
+    assert abs(pk.score(("is-unseen", "coming")) - 0.4 * 3.0 / 11) < 1e-12

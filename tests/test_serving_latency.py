@@ -80,28 +80,14 @@ def test_cifar_single_datum_parity_and_no_recompile():
 
 
 def test_newsgroups_single_doc_parity_and_no_recompile():
-    from keystone_tpu.nodes.learning import NaiveBayesEstimator
-    from keystone_tpu.nodes.nlp import (
-        LowerCase,
-        NGramsFeaturizer,
-        TermFrequency,
-        Tokenizer,
-        Trim,
+    from keystone_tpu.pipelines.text_pipelines import (
+        build_newsgroups_predictor,
+        synthetic_corpus,
     )
-    from keystone_tpu.nodes.util import CommonSparseFeatures, MaxClassifier
-    from keystone_tpu.pipelines.text_pipelines import synthetic_corpus
 
     labels, docs = synthetic_corpus(80, 3, seed=0)
-    featurizer = (
-        Trim().to_pipeline()
-        >> LowerCase()
-        >> Tokenizer()
-        >> NGramsFeaturizer((1, 2))
-        >> TermFrequency()
-    ).and_then(CommonSparseFeatures(500), docs)
-    predictor = featurizer.and_then(
-        NaiveBayesEstimator(3), docs, labels) >> MaxClassifier()
-    fitted = predictor.fit()
+    fitted = build_newsgroups_predictor(
+        docs, labels, 3, common_features=500).fit()
 
     doc_items = list(docs.items)
     batch_preds = [int(p) for p in fitted.apply(docs).numpy()]
