@@ -470,15 +470,31 @@ class SparseLBFGSwithL2(LabelEstimator):
         row_block = min(n_per, budget, 1 << 20)
         local = -(-n_per // row_block) * row_block
         n_pad = local * data_shards
-        idx = jnp.asarray(idx)
-        val = jnp.asarray(val)
-        Y = jnp.asarray(Y, jnp.float32)
+        sharded = data_shards > 1
+        if sharded:
+            # the sharded inputs must be HOST-fetchable: jit places each
+            # process's addressable shards itself, which also works for
+            # a multi-host mesh (a jnp.pad/arange here would pin a
+            # process-local single-device array and break placement)
+            for name, arr in (("idx", idx), ("val", val), ("labels", Y)):
+                if not getattr(arr, "is_fully_addressable", True):
+                    raise ValueError(
+                        f"sparse fit on a multi-host mesh needs "
+                        f"host-side inputs, but {name} is a cross-host "
+                        "global array; pass host numpy/CSR data (each "
+                        "process supplies the full problem)")
+        import numpy as _np
+
+        xp = _np if sharded else jnp
+        idx = xp.asarray(idx)
+        val = xp.asarray(val)
+        Y = xp.asarray(Y, _np.float32 if sharded else jnp.float32)
         if n_pad != n:
-            idx = jnp.pad(idx, ((0, n_pad - n), (0, 0)), constant_values=d)
-            val = jnp.pad(val, ((0, n_pad - n), (0, 0)))
-            Y = jnp.pad(Y, ((0, n_pad - n), (0, 0)))
-        mask = (jnp.arange(n_pad) < n_true).astype(val.dtype)
-        if data_shards > 1:
+            idx = xp.pad(idx, ((0, n_pad - n), (0, 0)), constant_values=d)
+            val = xp.pad(val, ((0, n_pad - n), (0, 0)))
+            Y = xp.pad(Y, ((0, n_pad - n), (0, 0)))
+        mask = (xp.arange(n_pad) < n_true).astype(xp.float32)
+        if sharded:
             W, b, self.loss_history = _lbfgs_sparse_matvec_fit_sharded(
                 idx, val, Y, mask,
                 jnp.float32(self.lam), jnp.float32(n_true), d,
@@ -548,16 +564,24 @@ class SparseLBFGSwithL2(LabelEstimator):
 
             if padded_form_ok(n, w, X.nnz) and (
                     self._route(n, d, k, w) == "iterative"):
-                from ...data.sparse import PaddedSparseDataset as _PSD
                 from ...parallel import mesh as meshlib
 
                 m = meshlib.current_mesh()
                 sharded = (m is not None
                            and int(m.shape.get(meshlib.DATA_AXIS, 1)) > 1)
-                # the dp-sharded route uses scatter tmatvec per shard —
-                # building/transferring the column form would be wasted
-                # host work and a second O(nnz) pair of device arrays
-                padded = _PSD.from_csr(X, column_form=not sharded)
+                if sharded:
+                    # host padding straight into the sharded fit: no
+                    # column form (the sharded route scatters per shard)
+                    # and no intermediate device round-trip
+                    from ...data.sparse import pad_csr
+
+                    idx_pad, val_pad = pad_csr(X)
+                    return self._fit_iterative(
+                        idx_pad, val_pad, d, np.asarray(Y, np.float32), n,
+                        sparse_in=True)
+                from ...data.sparse import PaddedSparseDataset as _PSD
+
+                padded = _PSD.from_csr(X)
                 return self._fit_iterative(
                     padded.idx, padded.val, d, np.asarray(Y, np.float32), n,
                     sparse_in=True, cidx=padded.cidx, cval=padded.cval)
