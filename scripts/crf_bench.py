@@ -1,0 +1,78 @@
+"""Linear-chain CRF tagger throughput (VERDICT r4 #5 perf axis).
+
+Trains the jitted CRF on the 50k-token synthetic grammar corpus and
+measures batched Viterbi decode throughput (tokens/sec, warm) plus
+training wall time — the TPU-native counterpart of the reference's
+Epic CRF wrappers (POSTagger.scala:24-36). Prints one JSON line.
+
+Usage: python scripts/crf_bench.py [--sentences 4500]
+       KEYSTONE_BACKEND=cpu python scripts/crf_bench.py --sentences 600
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sentences", type=int, default=4500)
+    p.add_argument("--max-iter", type=int, default=50)
+    p.add_argument("--out", default="-")
+    args = p.parse_args()
+    if os.environ.get("KEYSTONE_BACKEND") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from keystone_tpu.nodes.nlp import LinearChainCRFTagger, generate_pos_corpus
+
+    corpus = generate_pos_corpus(args.sentences, seed=0)
+    n_train = int(len(corpus) * 8 / 9)
+    train, test = corpus[:n_train], corpus[n_train:]
+    n_train_tok = sum(len(s) for s in train)
+
+    t0 = time.perf_counter()
+    crf = LinearChainCRFTagger(max_iter=args.max_iter).train(train)
+    train_s = time.perf_counter() - t0
+
+    toks = [[w for w, _ in s] for s in test]
+    gold = [[t for _, t in s] for s in test]
+    n_tok = sum(len(t) for t in toks)
+    preds = crf.predict_batch(toks)  # warm/compile
+    t0 = time.perf_counter()
+    preds = crf.predict_batch(toks)
+    decode_s = time.perf_counter() - t0
+
+    correct = sum(p == g for pr, gl in zip(preds, gold)
+                  for p, g in zip(pr, gl))
+    record = {
+        "workload": "linear-chain CRF tagger (hashed features, jitted "
+                    "L-BFGS train + batched Viterbi decode)",
+        "platform": jax.devices()[0].platform,
+        "train_sentences": len(train),
+        "train_tokens": n_train_tok,
+        "train_seconds": round(train_s, 2),
+        "decode_tokens": n_tok,
+        "decode_seconds": round(decode_s, 4),
+        "decode_tokens_per_sec": round(n_tok / decode_s, 0),
+        "test_accuracy": round(correct / n_tok, 4),
+    }
+    line = json.dumps(record)
+    print(line)
+    if args.out != "-":
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
