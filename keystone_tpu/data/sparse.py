@@ -76,26 +76,38 @@ class SparseDataset:
         )
 
 
+def sublane_pad8(x: int) -> int:
+    """Round a narrow leading-axis extent up to the TPU's 8 sublanes —
+    the HBM cost of that axis in the (8, 128)-tiled slot-major layout.
+    Shared by the routing predicate and the solvers' block budgets so
+    the tile accounting cannot drift apart."""
+    return -(-x // 8) * 8
+
+
 def padded_form_ok(n: int, w: int, nnz: int) -> bool:
-    """Whether the width-padded (n, w) layout is a sane size for the
-    data: a single outlier-dense row (a ones/bias column, one long
-    document) turns O(nnz) into O(n·d) of padding. One predicate shared
-    by the Gram and iterative sparse routes so their routing can't
-    drift apart."""
-    padded_bytes = 8.0 * n * w
-    return padded_bytes <= 4e9 and not (
+    """Whether the width-padded layout is a sane size for the data: a
+    single outlier-dense row (a ones/bias column, one long document)
+    turns O(nnz) into O(n·d) of padding. One predicate shared by the
+    Gram and iterative sparse routes so their routing can't drift
+    apart. Device cost counts the slot-major (w, n) layout (idx+val =
+    8 B per sublane-padded slot); the 5e9 cap leaves room on a 16 GB
+    chip for the similarly-sized column form plus solver transients."""
+    padded_bytes = 8.0 * n * sublane_pad8(w)
+    return padded_bytes <= 5e9 and not (
         padded_bytes > 32e6 and padded_bytes > 16.0 * 8.0 * max(nnz, 1)
     )
 
 
 def pad_csr(matrix: sp.spmatrix):
-    """Host CSR → width-padded (n, w) index/value arrays.
+    """Host CSR → slot-major width-padded (w, n) index/value arrays.
 
-    Row r's nonzeros occupy slots [0, len_r); unused slots carry the
-    sentinel column `dim` (so a (dim+1)-row gather table with a zero
-    sentinel row makes padded slots contribute nothing) and value 0.
+    Row r's nonzeros occupy slots [0, len_r) at [:, r]; unused slots
+    carry the sentinel column `dim` (so a gather table with a zero
+    sentinel entry makes padded slots contribute nothing) and value 0.
     This is the device-side sparse layout used by both the one-pass Gram
-    reduction and the iterative matvec L-BFGS path.
+    reduction and the iterative matvec L-BFGS path; slot-major keeps
+    the long n axis in the TPU's 128-lane minor tile dimension (see
+    PaddedSparseDataset).
     """
     X = sp.csr_matrix(matrix)
     n, d = X.shape
@@ -105,18 +117,24 @@ def pad_csr(matrix: sp.spmatrix):
     pos_in_row = np.arange(X.nnz, dtype=np.int64) - np.repeat(
         X.indptr[:-1].astype(np.int64), lens
     )
-    idx_pad = np.full((n, w), d, np.int32)
-    val_pad = np.zeros((n, w), np.float32)
-    idx_pad[row_ids, pos_in_row] = X.indices
-    val_pad[row_ids, pos_in_row] = X.data
+    idx_pad = np.full((w, n), d, np.int32)
+    val_pad = np.zeros((w, n), np.float32)
+    idx_pad[pos_in_row, row_ids] = X.indices
+    val_pad[pos_in_row, row_ids] = X.data
     return idx_pad, val_pad
 
 
 class PaddedSparseDataset:
-    """Device-resident width-padded sparse rows.
+    """Device-resident width-padded sparse rows, SLOT-MAJOR.
 
-    The TPU-native sparse layout: `idx` (n, w) int32 column ids with
-    sentinel `dim` marking padding, `val` (n, w) float32. Unlike
+    The TPU-native sparse layout: `idx` (w, n) int32 column ids with
+    sentinel `dim` marking padding, `val` (w, n) float32 — slot j of
+    row r lives at [j, r]. The orientation is load-bearing on TPU: the
+    default (8, 128) tiled layout pads the MINOR dimension to 128
+    lanes, so a row-major (n, w) array with the natural small w (the
+    reference's Amazon workload has w≈5 at d=1024) would occupy
+    128/w ≈ 25× its logical bytes of HBM — slot-major instead puts the
+    long n axis in lanes and pads w only up to 8 sublanes. Unlike
     `SparseDataset` (host scipy CSR), the arrays live on device, so
     solvers iterate over them with gathers/scatters and no host
     round-trips — the analog of the reference keeping partitioned
@@ -135,12 +153,13 @@ class PaddedSparseDataset:
         self.mesh = mesh
         # true nonzero count when known (sentinel slots excluded)
         self.nnz = int(nnz) if nnz is not None else int(idx.shape[0] * idx.shape[1])
-        # optional column-oriented padding: cidx/cval (dim, wc) hold, per
-        # feature column, the ROW ids containing it (sentinel = count).
-        # With both orientations resident, Xᵀv is a gather over cidx just
-        # like Xv is a gather over idx — no scatter ever runs in a solver
-        # iteration loop (TPU scatter-adds into a small (d, k) table
-        # serialize on index collisions; gathers don't collide).
+        # optional column-oriented padding, also slot-major: cidx/cval
+        # (wc, dim) hold, per feature column, the ROW ids containing it
+        # (sentinel = count). With both orientations resident, Xᵀv is a
+        # gather over cidx just like Xv is a gather over idx — no
+        # scatter ever runs in a solver iteration loop (TPU
+        # scatter-adds into a small gradient table serialize on index
+        # collisions; gathers don't collide).
         self.cidx = cidx
         self.cval = cval
 
@@ -159,7 +178,7 @@ class PaddedSparseDataset:
             # column padding O(dim · n); skip it when padded size far
             # exceeds the data — the solver falls back to scatter
             if X.shape[1] * wc <= max(max_col_pad_ratio * max(X.nnz, 1), 1e6):
-                # the column form IS the row padding of Xᵀ: (d, wc) row
+                # the column form IS the slot padding of Xᵀ: (wc, d) row
                 # ids per feature column, sentinel = Xᵀ's dim = n
                 ci, cv = pad_csr(sp.csr_matrix(X.T))
                 cidx, cval = jnp.asarray(ci), jnp.asarray(cv)
@@ -172,28 +191,37 @@ class PaddedSparseDataset:
         argsort of the flat column ids + unique-target scatters (the
         only scatters in the sparse stack, and they never collide);
         out-of-bounds positions from sentinel padding slots drop, which
-        is JAX scatter semantics doing the masking for free."""
+        is JAX scatter semantics doing the masking for free. Column
+        counts come from searchsorted over the sorted ids (a bincount
+        here would be an nnz-sized colliding scatter-add)."""
         if self.cidx is not None:
             return self
         import jax.numpy as jnp
 
-        n, w = self.idx.shape
+        w, n = self.idx.shape
         d = self.dim
+        # slot-major flat index f = j*n + r → row id = f mod n
         flat = self.idx.reshape(-1)
         order = jnp.argsort(flat, stable=True)
         sorted_cols = flat[order]
-        rows_sorted = (order // w).astype(jnp.int32)
-        counts = jnp.bincount(flat, length=d + 1)
+        rows_sorted = (order % n).astype(jnp.int32)
+        # exclusive prefix of per-column counts without a colliding
+        # scatter: starts[c] = first position of column c in the sort
+        starts_all = jnp.searchsorted(sorted_cols,
+                                      jnp.arange(d + 1), side="left")
+        counts = jnp.diff(jnp.concatenate(
+            [starts_all, jnp.array([flat.shape[0]])]))
         wc = max(1, int(jnp.max(counts[:d]))) if d else 1
-        starts = jnp.cumsum(counts) - counts  # exclusive prefix
-        pos = jnp.arange(flat.shape[0]) - starts[sorted_cols]
+        pos = jnp.arange(flat.shape[0]) - starts_all[sorted_cols]
+        # (wc, d+1) buffer: sentinel-column entries either overflow wc
+        # (dropped by scatter semantics) or land in column d (sliced)
         cidx = (
-            jnp.full((d + 1, wc), n, jnp.int32)
-            .at[sorted_cols, pos].set(rows_sorted)[:d]
+            jnp.full((wc, d + 1), n, jnp.int32)
+            .at[pos, sorted_cols].set(rows_sorted)[:, :d]
         )
         cval = (
-            jnp.zeros((d + 1, wc), jnp.float32)
-            .at[sorted_cols, pos].set(self.val.reshape(-1)[order])[:d]
+            jnp.zeros((wc, d + 1), jnp.float32)
+            .at[pos, sorted_cols].set(self.val.reshape(-1)[order])[:, :d]
         )
         return PaddedSparseDataset(
             self.idx, self.val, d, mesh=self.mesh, nnz=self.nnz,
@@ -201,11 +229,11 @@ class PaddedSparseDataset:
 
     @property
     def count(self) -> int:
-        return self.idx.shape[0]
+        return self.idx.shape[1]
 
     @property
     def width(self) -> int:
-        return self.idx.shape[1]
+        return self.idx.shape[0]
 
     @property
     def sparsity(self) -> float:

@@ -173,23 +173,30 @@ def _sparse_matvec_fit_impl(
     per iteration, no search loop. Centering (fit_intercept) is
     algebraic: Xc@W = X@W − 1(x̄ᵀW); centered data is never materialized.
 
-    idx: (n, w) int32 column ids with sentinel `d` in padding slots.
-    val: (n, w) f32 (0.0 in padding slots). Y: (n, k) f32 (zero rows
+    ALL row-space arrays are SLOT-MAJOR (long axis minor) so the TPU's
+    (8, 128) tiled layout pads the narrow axis to 8 sublanes instead of
+    padding it to 128 lanes (a 25× HBM blow-up at Amazon's w=5, k=2 —
+    at the reference's n=65e6 the row-major layout cannot even be
+    allocated). The model space is likewise (k, d) so d sits in lanes.
+
+    idx: (w, n) int32 column ids with sentinel `d` in padding slots.
+    val: (w, n) f32 (0.0 in padding slots). Y: (k, n) f32 (zero columns
     where ~mask). mask: (n,) f32 marks true rows (n is block-padded).
-    count: true row count (scalar f32). cidx/cval: optional
+    count: true row count (scalar f32). cidx/cval: optional (wc, d)
     column-oriented padding (see PaddedSparseDataset) — when use_col,
-    Xᵀv is a gather over cidx instead of a scatter-add into the (d, k)
+    Xᵀv is a gather over cidx instead of a scatter-add into the (k, d)
     gradient (whose massive index collisions serialize on TPU).
 
     With `axis_name` set this body runs inside shard_map with the row
-    arrays dp-sharded: every row-space reduction (gradient, colsum,
-    line-search inner products, loss) all-reduces over the mesh — the
-    psum standing exactly where the reference treeReduces per-partition
-    gradients to the master (LBFGS.scala:97-103); W and the L-BFGS
-    history stay replicated like the reference's broadcast model.
+    arrays dp-sharded along their n axis: every row-space reduction
+    (gradient, colsum, line-search inner products, loss) all-reduces
+    over the mesh — the psum standing exactly where the reference
+    treeReduces per-partition gradients to the master
+    (LBFGS.scala:97-103); W and the L-BFGS history stay replicated like
+    the reference's broadcast model.
     """
-    n, w = idx.shape
-    k = Y.shape[1]
+    w, n = idx.shape
+    k = Y.shape[0]
     assert n % row_block == 0
     n_blocks = n // row_block
     m = memory_size
@@ -201,56 +208,67 @@ def _sparse_matvec_fit_impl(
         return jax.lax.psum(x, axis_name) if axis_name else x
 
     def matvec(W):
-        """X @ W → (n, k); W is (d, k), padded to a zero sentinel row."""
-        table = jnp.concatenate([W, jnp.zeros((1, k), W.dtype)], axis=0)
+        """X @ W → (k, n); W is (k, d), padded to a zero sentinel col."""
+        table = jnp.concatenate([W, jnp.zeros((k, 1), W.dtype)], axis=1)
 
-        def one_block(i):
-            ib = jax.lax.dynamic_slice_in_dim(idx, i * row_block, row_block)
-            vb = jax.lax.dynamic_slice_in_dim(val, i * row_block, row_block)
-            g = jnp.take(table, ib, axis=0)  # (b, w, k)
-            return jnp.einsum("bw,bwk->bk", vb, g,
-                              precision=jax.lax.Precision.HIGHEST)
+        def body(i, R):
+            ib = jax.lax.dynamic_slice_in_dim(idx, i * row_block, row_block, 1)
+            vb = jax.lax.dynamic_slice_in_dim(val, i * row_block, row_block, 1)
+            g = jnp.take(table, ib, axis=1)  # (k, w, b)
+            rb = jnp.einsum("wb,kwb->kb", vb, g,
+                            precision=jax.lax.Precision.HIGHEST)
+            return jax.lax.dynamic_update_slice(R, rb, (0, i * row_block))
 
-        return jax.lax.map(one_block, jnp.arange(n_blocks)).reshape(n, k)
+        return jax.lax.fori_loop(
+            0, n_blocks, body, jnp.zeros((k, n), W.dtype))
 
     if use_col:
-        dc = cidx.shape[0]  # d padded to a col_block multiple
+        dc = cidx.shape[1]  # d padded to a col_block multiple
         assert dc % col_block == 0
         nbc = dc // col_block
 
         def tmatvec(R):
-            """Xᵀ @ R → (d, k) as a pure gather over the column form:
-            rows of R indexed by cidx; sentinel ids hit the appended
-            zero row."""
-            Rp = jnp.concatenate([R, jnp.zeros((1, k), R.dtype)], axis=0)
+            """Xᵀ @ R → (k, d) as a pure gather over the column form:
+            columns of R indexed by cidx; sentinel ids hit the appended
+            zero column."""
+            Rp = jnp.concatenate([R, jnp.zeros((k, 1), R.dtype)], axis=1)
 
-            def one_block(i):
-                cb = jax.lax.dynamic_slice_in_dim(cidx, i * col_block, col_block)
-                vb = jax.lax.dynamic_slice_in_dim(cval, i * col_block, col_block)
-                g = jnp.take(Rp, cb, axis=0)  # (cblk, wc, k)
-                return jnp.einsum("cw,cwk->ck", vb, g,
-                                  precision=jax.lax.Precision.HIGHEST)
+            def body(i, G):
+                cb = jax.lax.dynamic_slice_in_dim(cidx, i * col_block,
+                                                  col_block, 1)
+                vb = jax.lax.dynamic_slice_in_dim(cval, i * col_block,
+                                                  col_block, 1)
+                g = jnp.take(Rp, cb, axis=1)  # (k, wc, cblk)
+                gb = jnp.einsum("wc,kwc->kc", vb, g,
+                                precision=jax.lax.Precision.HIGHEST)
+                return jax.lax.dynamic_update_slice(G, gb, (0, i * col_block))
 
-            return jax.lax.map(one_block, jnp.arange(nbc)).reshape(dc, k)[:d]
+            out = jax.lax.fori_loop(
+                0, nbc, body, jnp.zeros((k, dc), R.dtype))
+            return out[:, :d]
     else:
 
         def tmatvec(R):
-            """Xᵀ @ R → (d, k); padding slots scatter into the dropped
-            sentinel row."""
+            """Xᵀ @ R → (k, d); padding slots scatter into the dropped
+            sentinel column."""
             def body(i, acc):
-                ib = jax.lax.dynamic_slice_in_dim(idx, i * row_block, row_block)
-                vb = jax.lax.dynamic_slice_in_dim(val, i * row_block, row_block)
-                Rb = jax.lax.dynamic_slice_in_dim(R, i * row_block, row_block)
-                contrib = vb[:, :, None] * Rb[:, None, :]  # (b, w, k)
-                return acc.at[ib.reshape(-1)].add(contrib.reshape(-1, k))
+                ib = jax.lax.dynamic_slice_in_dim(idx, i * row_block,
+                                                  row_block, 1)
+                vb = jax.lax.dynamic_slice_in_dim(val, i * row_block,
+                                                  row_block, 1)
+                Rb = jax.lax.dynamic_slice_in_dim(R, i * row_block,
+                                                  row_block, 1)
+                contrib = vb[None, :, :] * Rb[:, None, :]  # (k, w, b)
+                return acc.at[:, ib.reshape(-1)].add(
+                    contrib.reshape(k, -1))
 
             out = jax.lax.fori_loop(
-                0, n_blocks, body, jnp.zeros((d + 1, k), R.dtype))
-            return dsum(out[:d])
+                0, n_blocks, body, jnp.zeros((k, d + 1), R.dtype))
+            return dsum(out[:, :d])
 
     if fit_intercept:
         if use_col:
-            colsum = jnp.sum(cval, axis=1)[:d]
+            colsum = jnp.sum(cval, axis=0)[:d]
         else:
             colsum = dsum(
                 jnp.zeros((d + 1,), dtype)
@@ -258,29 +276,29 @@ def _sparse_matvec_fit_impl(
                 .add(val.reshape(-1))[:d]
             )
         xm = colsum / count          # (d,)
-        ym = dsum(jnp.sum(Y, axis=0)) / count  # (k,)
+        ym = dsum(jnp.sum(Y, axis=1)) / count  # (k,)
     else:
         xm = jnp.zeros((d,), dtype)
         ym = jnp.zeros((k,), dtype)
 
     def centered_matvec(V):
         """Xc @ V for true rows, 0 for padding: mask ∘ (XV − 1 x̄ᵀV)."""
-        return (matvec(V) - (xm @ V)[None, :]) * mask[:, None]
+        return (matvec(V) - (V @ xm)[:, None]) * mask[None, :]
 
     def centered_tmatvec(R):
-        """Xcᵀ R (R already masked): XᵀR − x̄ (1ᵀR); 1ᵀR is a row-space
+        """Xcᵀ R (R already masked): XᵀR − (1ᵀR) x̄; 1ᵀR is a row-space
         reduction so it all-reduces like the matvec itself."""
-        return tmatvec(R) - jnp.outer(xm, dsum(jnp.sum(R, axis=0)))
+        return tmatvec(R) - jnp.outer(dsum(jnp.sum(R, axis=1)), xm)
 
     def grad_of(W, R):
         return centered_tmatvec(R) + lam * W
 
-    W0 = jnp.zeros((d, k), dtype)
-    R0 = (-(Y - ym[None, :])) * mask[:, None]  # Xc@0 − Yc
+    W0 = jnp.zeros((k, d), dtype)
+    R0 = (-(Y - ym[:, None])) * mask[None, :]  # Xc@0 − Yc
     g0 = grad_of(W0, R0)
 
-    S0 = jnp.zeros((m, d, k), dtype)
-    YH0 = jnp.zeros((m, d, k), dtype)
+    S0 = jnp.zeros((m, k, d), dtype)
+    YH0 = jnp.zeros((m, k, d), dtype)
     rho0 = jnp.zeros((m,), dtype)
 
     def step(carry, _):
@@ -331,8 +349,10 @@ def _sparse_matvec_fit_impl(
     (W, _, _, _, _, _, _), values = jax.lax.scan(
         step, (W0, R0, g0, S0, YH0, rho0, jnp.int32(0)), None,
         length=num_iters)
-    b = ym - xm @ W if fit_intercept else jnp.zeros((k,), dtype)
-    return W, b, values
+    b = ym - W @ xm if fit_intercept else jnp.zeros((k,), dtype)
+    # external contract stays (d, k) — only the iteration space is
+    # transposed; the final transpose is a tiny (k, d) copy
+    return W.T, b, values
 
 
 @partial(
@@ -383,10 +403,11 @@ def _lbfgs_sparse_matvec_fit_sharded(
             num_iters, memory_size, fit_intercept, row_block,
             col_block=1, use_col=False, axis_name=meshlib.DATA_AXIS)
 
-    row = P(meshlib.DATA_AXIS)
+    # slot-major arrays shard along their MINOR n axis; mask is 1-D
+    row = P(None, meshlib.DATA_AXIS)
     return shard_map(
         body, mesh=mesh,
-        in_specs=(row, row, row, row, P(), P()),
+        in_specs=(row, row, row, P(meshlib.DATA_AXIS), P(), P()),
         out_specs=(P(), P(), P()),
         **kw,
     )(idx, val, Y, mask, lam, count)
@@ -451,23 +472,28 @@ class SparseLBFGSwithL2(LabelEstimator):
 
     def _fit_iterative(self, idx, val, d: int, Y, n_true: int, sparse_in: bool,
                        cidx=None, cval=None):
-        """Run the matvec L-BFGS on width-padded rows already shaped for
-        the device; blocks the row (and column-form) dimension so
-        per-block gather transients stay ≤ ~256 MB of HBM."""
+        """Run the matvec L-BFGS on slot-major width-padded rows
+        (idx/val (w, n), labels Y (k, n)) already shaped for the
+        device; blocks the row (and column-form) dimension so per-block
+        gather transients stay ≤ ~256 MB of HBM."""
+        from ...data.sparse import sublane_pad8
         from ...parallel import mesh as meshlib
 
-        n, w = idx.shape
-        k = Y.shape[1]
+        w, n = idx.shape
+        k = Y.shape[0]
+        w8 = sublane_pad8(w)  # HBM slot count of a (w, n) tile
         mesh = meshlib.current_mesh()
         data_shards = (int(mesh.shape.get(meshlib.DATA_AXIS, 1))
                        if mesh is not None else 1)
         # dp-sharded: TRUE rows must spread across shards (shard_map
-        # splits the leading axis into contiguous per-device chunks), so
-        # size the block within the PER-SHARD row count, then pad the
-        # global count to shards × (a block multiple of that local size)
+        # splits the n axis into contiguous per-device chunks), so size
+        # the block within the PER-SHARD row count, then pad the global
+        # count to shards × (a block multiple of that local size)
         n_per = -(-n // data_shards)
-        budget = max(256, int(256e6 / (8.0 * w * max(k, 1))))
+        budget = max(256, int(256e6 / (4.0 * w8 * max(k, 1))))
         row_block = min(n_per, budget, 1 << 20)
+        if row_block >= 512:  # keep dynamic slices lane-aligned
+            row_block = row_block // 512 * 512
         local = -(-n_per // row_block) * row_block
         n_pad = local * data_shards
         sharded = data_shards > 1
@@ -490,9 +516,9 @@ class SparseLBFGSwithL2(LabelEstimator):
         val = xp.asarray(val)
         Y = xp.asarray(Y, _np.float32 if sharded else jnp.float32)
         if n_pad != n:
-            idx = xp.pad(idx, ((0, n_pad - n), (0, 0)), constant_values=d)
-            val = xp.pad(val, ((0, n_pad - n), (0, 0)))
-            Y = xp.pad(Y, ((0, n_pad - n), (0, 0)))
+            idx = xp.pad(idx, ((0, 0), (0, n_pad - n)), constant_values=d)
+            val = xp.pad(val, ((0, 0), (0, n_pad - n)))
+            Y = xp.pad(Y, ((0, 0), (0, n_pad - n)))
         mask = (xp.arange(n_pad) < n_true).astype(xp.float32)
         if sharded:
             W, b, self.loss_history = _lbfgs_sparse_matvec_fit_sharded(
@@ -508,16 +534,17 @@ class SparseLBFGSwithL2(LabelEstimator):
         if use_col:
             cidx = jnp.asarray(cidx)
             cval = jnp.asarray(cval)
-            wc = cidx.shape[1]
-            col_block = max(8, min(d, int(256e6 / (4.0 * wc * max(k, 1)))))
+            wc = cidx.shape[0]
+            wc8 = sublane_pad8(wc)
+            col_block = max(8, min(d, int(256e6 / (4.0 * wc8 * max(k, 1)))))
             d_pad = -(-d // col_block) * col_block
-            if d_pad != cidx.shape[0]:
-                pad = d_pad - cidx.shape[0]
-                # sentinel row id n_pad+ anything ≥ R's row count is out
-                # of range for take; use the appended zero row (= n_pad)
-                cidx = jnp.pad(cidx, ((0, pad), (0, 0)),
+            if d_pad != cidx.shape[1]:
+                pad = d_pad - cidx.shape[1]
+                # sentinel row id: anything ≥ R's column count would be
+                # out of range for take; use the appended zero col (= n_pad)
+                cidx = jnp.pad(cidx, ((0, 0), (0, pad)),
                                constant_values=n_pad)
-                cval = jnp.pad(cval, ((0, pad), (0, 0)))
+                cval = jnp.pad(cval, ((0, 0), (0, pad)))
         else:
             cidx = jnp.zeros((1, 1), jnp.int32)
             cval = jnp.zeros((1, 1), jnp.float32)
@@ -537,10 +564,19 @@ class SparseLBFGSwithL2(LabelEstimator):
         from ...data.sparse import PaddedSparseDataset, SparseDataset
 
         if isinstance(data, PaddedSparseDataset):
-            Y = labels.array if isinstance(labels, Dataset) else jnp.asarray(
-                np.asarray(labels), jnp.float32)
-            if Y.shape[0] != data.count:  # Dataset shard-pads rows
-                Y = Y[: data.count]
+            is_ds = isinstance(labels, Dataset)
+            Y = labels.array if is_ds else jnp.asarray(labels, jnp.float32)
+            # Dataset labels are always row-major (n, k). A raw array
+            # may instead be label-major (k, n) — huge-n callers pass
+            # label-major so the (n, k) layout (narrow minor dim →
+            # 128-lane tile padding) never materializes on device;
+            # row-major wins the k == n ambiguity for API continuity
+            label_major = (not is_ds and Y.shape[0] != data.count
+                           and Y.shape[1] == data.count)
+            if not label_major:
+                if Y.shape[0] != data.count:  # Dataset shard-pads rows
+                    Y = Y[: data.count]
+                Y = Y.T
             return self._fit_iterative(
                 data.idx, data.val, data.dim, Y, data.count, sparse_in=False,
                 cidx=data.cidx, cval=data.cval)
@@ -577,13 +613,15 @@ class SparseLBFGSwithL2(LabelEstimator):
 
                     idx_pad, val_pad = pad_csr(X)
                     return self._fit_iterative(
-                        idx_pad, val_pad, d, np.asarray(Y, np.float32), n,
+                        idx_pad, val_pad, d,
+                        np.ascontiguousarray(np.asarray(Y, np.float32).T), n,
                         sparse_in=True)
                 from ...data.sparse import PaddedSparseDataset as _PSD
 
                 padded = _PSD.from_csr(X)
                 return self._fit_iterative(
-                    padded.idx, padded.val, d, np.asarray(Y, np.float32), n,
+                    padded.idx, padded.val, d,
+                    np.ascontiguousarray(np.asarray(Y, np.float32).T), n,
                     sparse_in=True, cidx=padded.cidx, cval=padded.cval)
         device_gram = None
         if sparse_in:
@@ -625,31 +663,34 @@ class SparseLBFGSwithL2(LabelEstimator):
 
 @partial(jax.jit, static_argnames=("row_block", "d"))
 def _sparse_gram_accumulate(idx_pad, val_pad, Y, row_block: int, d: int):
-    """Accumulate G = XᵀX, C = XᵀY, colsum(X) from width-padded CSR rows
-    entirely on device: each row block is densified by scatter-add into
-    a (row_block, d+1) buffer (column d is the padding sentinel) and the
+    """Accumulate G = XᵀX, C = XᵀY, colsum(X) from slot-major
+    width-padded CSR rows (idx/val (w, n), Y (k, n)) entirely on
+    device: each row block is densified by scatter-add into a
+    (row_block, d+1) buffer (column d is the padding sentinel) and the
     Gram update runs on the MXU. One jitted fori_loop — no per-block
     host round trips, no (n, d) dense array in HBM."""
-    n_pad = idx_pad.shape[0]
+    w, n_pad = idx_pad.shape
     n_blocks = n_pad // row_block
-    k = Y.shape[1]
-    rows = jnp.arange(row_block)
+    k = Y.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(row_block)[None, :], (w, row_block))
 
     with jax.default_matmul_precision("highest"):
 
         def body(i, carry):
             G, C, s = carry
-            ib = jax.lax.dynamic_slice_in_dim(idx_pad, i * row_block, row_block)
-            vb = jax.lax.dynamic_slice_in_dim(val_pad, i * row_block, row_block)
-            Yb = jax.lax.dynamic_slice_in_dim(Y, i * row_block, row_block)
+            ib = jax.lax.dynamic_slice_in_dim(
+                idx_pad, i * row_block, row_block, 1)
+            vb = jax.lax.dynamic_slice_in_dim(
+                val_pad, i * row_block, row_block, 1)
+            Ybt = jax.lax.dynamic_slice_in_dim(Y, i * row_block, row_block, 1)
             dense = (
                 jnp.zeros((row_block, d + 1), jnp.float32)
-                .at[rows[:, None], ib]
+                .at[rows, ib]
                 .add(vb)[:, :d]
             )
             return (
                 G + dense.T @ dense,
-                C + dense.T @ Yb,
+                C + dense.T @ Ybt.T,
                 # f32 carry is safe here: the sequential adds happen once
                 # per BLOCK (tens of iterations; within-block sums are
                 # XLA tree reductions), not once per row — relative error
@@ -686,7 +727,8 @@ def _sparse_gram_on_device(X, Y, block_rows: int):
     # bail to the caller's host-scipy path on pathological padding
     if not padded_form_ok(n, w, X.nnz):
         return None
-    idx_pad, val_pad = pad_csr(X)
+    idx_pad, val_pad = pad_csr(X)  # slot-major (w, n)
+    Yt = np.ascontiguousarray(np.asarray(Y, np.float32).T)
     # bound the densified block at ~512 MB of HBM, honoring a smaller
     # caller-specified block_rows (tests use tiny blocks to exercise the
     # multi-block accumulation path)
@@ -694,11 +736,11 @@ def _sparse_gram_on_device(X, Y, block_rows: int):
     row_block = max(8, min(block_rows, hbm_cap))
     n_pad = -(-n // row_block) * row_block
     if n_pad != n:
-        idx_pad = np.pad(idx_pad, ((0, n_pad - n), (0, 0)),
+        idx_pad = np.pad(idx_pad, ((0, 0), (0, n_pad - n)),
                          constant_values=d)
-        val_pad = np.pad(val_pad, ((0, n_pad - n), (0, 0)))
-        Y = np.pad(np.asarray(Y, np.float32), ((0, n_pad - n), (0, 0)))
+        val_pad = np.pad(val_pad, ((0, 0), (0, n_pad - n)))
+        Yt = np.pad(Yt, ((0, 0), (0, n_pad - n)))
     return _sparse_gram_accumulate(
         jnp.asarray(idx_pad), jnp.asarray(val_pad),
-        jnp.asarray(Y, jnp.float32), row_block, d,
+        jnp.asarray(Yt), row_block, d,
     )

@@ -461,10 +461,11 @@ def test_padded_sparse_column_form_paths_agree():
     dev_col = no_col.with_column_form()
     assert dev_col.cidx is not None
     # host-built and device-built column forms value-sum identically per
-    # column (slot order within a column may differ)
+    # column (slot order within a column may differ; slots are axis 0
+    # of the slot-major (wc, d) layout)
     np.testing.assert_allclose(
-        np.asarray(jnp.sort(with_col.cval, axis=1)),
-        np.asarray(jnp.sort(dev_col.cval, axis=1)), atol=0)
+        np.asarray(jnp.sort(with_col.cval, axis=0)),
+        np.asarray(jnp.sort(dev_col.cval, axis=0)), atol=0)
 
     with use_mesh(make_mesh(jax.devices()[:1])):
         fits = [
