@@ -163,9 +163,13 @@ def _sparse_matvec_fit_impl(
     data resident on device across iterations.
 
     For k ≪ d this does O(num_iters · nnz · k) work where the Gram path
-    does O(n · d²) — the regime of the reference's Amazon workload
-    (k=2, sparsity .005, d up to 16384), where one-pass Gram formation
-    is a ~10⁴× FLOP blow-up over 20 iterations of matvecs.
+    does O(n · d²). In raw FLOPs that is a ~10⁴× saving on the
+    reference's Amazon shapes (k=2, sparsity .005) — but each of those
+    nnz·k "flops" is a table GATHER, which the TPU issues at scalar
+    rate (~5 ns each, no gather hardware; scripts/sparse_microbench.py),
+    so `_route` only picks this path when d is too large to densify
+    (hashing-trick feature spaces). It is also the dp-sharded
+    multi-host path, where per-shard gather streams divide by the mesh.
 
     The objective is quadratic, so the Wolfe line search the reference
     delegates to Breeze collapses to its closed form: for direction D,
@@ -423,13 +427,18 @@ class SparseLBFGSwithL2(LabelEstimator):
     L-BFGS iterations then run entirely on-device with n dropped out.
     This replaces the reference's per-iteration sparse gradient passes
     (Gradient.scala `LeastSquaresSparseGradient`) with a single sparse
-    pass + dense MXU iterations — best when d is small or k is wide.
-    **iterative** — `_lbfgs_sparse_matvec_fit`: device-resident
-    width-padded rows, per-iteration gather matvecs, the reference's own
-    iteration structure; O(num_iters·nnz·k) total work, the clear winner
-    in the k ≪ d Amazon regime where Gram formation is a ~10⁴× FLOP
-    blow-up. Intercept is fit by mean-correction in both routes
-    (the reference appends a ones column, LBFGS.scala:223-247).
+    pass + dense MXU iterations. **iterative** —
+    `_lbfgs_sparse_matvec_fit`: device-resident width-padded rows,
+    per-iteration gather matvecs, the reference's own iteration
+    structure; O(num_iters·nnz·k) total work. Counter-intuitively the
+    measured chip rates (scripts/sparse_microbench.py) send even the
+    k ≪ d Amazon shapes to gram: the TPU has no gather hardware, so
+    the iterative route's per-nonzero cost is ~5 ns of scalar-issue
+    gathers, while the Gram's d²-FLOP "blow-up" runs on the MXU at
+    ~10⁵ flops per gather-equivalent — iterative wins only when d is
+    hashing-trick huge (d ≳ 1e5). Intercept is fit by mean-correction
+    in both routes (the reference appends a ones column,
+    LBFGS.scala:223-247).
     """
 
     def __init__(
@@ -459,16 +468,56 @@ class SparseLBFGSwithL2(LabelEstimator):
         """Pick Gram-form vs iterative-matvec by estimated device cost —
         the same decision the reference delegates to its CostModel
         (LBFGS.scala CostModel: per-iteration nnz flops), re-derived for
-        one chip. Gram: 2·n·d² MXU flops (the blockwise densify GEMM
-        ignores sparsity) at ~2e13 f32 flop/s. Iterative: per iteration
-        two sparse passes touching ~n·w·(8 + 8k) bytes of gather/scatter
-        traffic at ~1e11 B/s effective. Rough constants — overridable
-        via method=."""
+        one chip from MEASURED rates (scripts/sparse_microbench.py, live
+        v5e): Gram = one-hot densify (a fused compare pass, ~nnz·d ops
+        at ~2e12/s) + 2·n·d² MXU flops at ~2.5e13 f32-HIGHEST flop/s,
+        paid ONCE. Iterative = per iteration ~3 sparse passes whose
+        table gathers cost ~5 ns/element — the TPU has no gather
+        hardware, so per-nonzero cost is flat in d but never below the
+        scalar-issue rate. The MXU's densified brute force wins whenever
+        d ≲ num_iters · (gather_ns · mxu_rate) / 2 ≈ 1e4·num_iters/2 —
+        i.e. essentially always for k ≪ d workloads. Overridable via
+        method=."""
         if self.method is not None:
             return self.method
-        gram_sec = 2.0 * n * d * d / 2.0e13
-        iter_sec = self.num_iters * 2.0 * n * w * (8.0 + 8.0 * k) / 1.0e11
+        nnz = n * w
+        gram_sec = nnz * d / 2.0e12 + 2.0 * n * d * d / 2.5e13
+        iter_sec = self.num_iters * 3.0 * nnz * (3.0 + 1.5 * k) * 1e-9
         return "iterative" if iter_sec < gram_sec else "gram"
+
+    def _fit_gram_device(self, idx, val, d: int, Y, n_true: int,
+                         sparse_in: bool):
+        """Reduce slot-major device-resident padded rows (idx/val
+        (w, n), labels Y (k, n)) to Gram statistics with the one-hot
+        densify + MXU accumulator, then run the L-BFGS iterations with
+        n dropped out. The TPU answer to the reference's per-iteration
+        sparse gradient passes for k ≪ d: one densified streaming pass
+        at MXU rate beats num_iters × gather passes at the ~5 ns/element
+        scalar-gather rate (no gather hardware on TPU; measured in
+        scripts/sparse_microbench.py)."""
+        w, n = idx.shape
+        k = Y.shape[0]
+        # dense block ≤ ~512 MB of HBM, multiple of 8 sublanes
+        row_block = max(8, min(n, int(512e6 / (4 * (d + 1)))) // 8 * 8)
+        n_pad = -(-n // row_block) * row_block
+        if n_pad != n:
+            idx = jnp.pad(idx, ((0, 0), (0, n_pad - n)), constant_values=d)
+            val = jnp.pad(val, ((0, 0), (0, n_pad - n)))
+            Y = jnp.pad(Y, ((0, 0), (0, n_pad - n)))
+        G, C, col_sum = _sparse_gram_accumulate(
+            jnp.asarray(idx), jnp.asarray(val),
+            jnp.asarray(Y, jnp.float32), row_block, d)
+        if self.fit_intercept:
+            xm = col_sum / n_true
+            ym = jnp.sum(Y, axis=1) / n_true
+            G = G - n_true * jnp.outer(xm, xm)
+            C = C - n_true * jnp.outer(xm, ym)
+        W, self.loss_history = _lbfgs_gram_fit(
+            G, C, jnp.float32(self.lam), self.num_iters, self.memory_size)
+        if self.fit_intercept:
+            b = ym - xm @ W
+            return SparseLinearMapper(W, b) if sparse_in else LinearMapper(W, b)
+        return SparseLinearMapper(W) if sparse_in else LinearMapper(W)
 
     def _fit_iterative(self, idx, val, d: int, Y, n_true: int, sparse_in: bool,
                        cidx=None, cval=None):
@@ -577,6 +626,18 @@ class SparseLBFGSwithL2(LabelEstimator):
                 if Y.shape[0] != data.count:  # Dataset shard-pads rows
                     Y = Y[: data.count]
                 Y = Y.T
+            from ...parallel import mesh as meshlib
+
+            m = meshlib.current_mesh()
+            sharded = (m is not None
+                       and int(m.shape.get(meshlib.DATA_AXIS, 1)) > 1)
+            # under a dp mesh keep the sharded iterative route: the
+            # device-gram reduction is a single-device program
+            if not sharded and self._route(
+                    data.count, data.dim, Y.shape[0], data.width) == "gram":
+                return self._fit_gram_device(
+                    data.idx, data.val, data.dim, Y, data.count,
+                    sparse_in=False)
             return self._fit_iterative(
                 data.idx, data.val, data.dim, Y, data.count, sparse_in=False,
                 cidx=data.cidx, cval=data.cval)
@@ -672,7 +733,7 @@ def _sparse_gram_accumulate(idx_pad, val_pad, Y, row_block: int, d: int):
     w, n_pad = idx_pad.shape
     n_blocks = n_pad // row_block
     k = Y.shape[0]
-    rows = jnp.broadcast_to(jnp.arange(row_block)[None, :], (w, row_block))
+    iota = jnp.arange(d + 1, dtype=idx_pad.dtype)
 
     with jax.default_matmul_precision("highest"):
 
@@ -683,11 +744,18 @@ def _sparse_gram_accumulate(idx_pad, val_pad, Y, row_block: int, d: int):
             vb = jax.lax.dynamic_slice_in_dim(
                 val_pad, i * row_block, row_block, 1)
             Ybt = jax.lax.dynamic_slice_in_dim(Y, i * row_block, row_block, 1)
-            dense = (
-                jnp.zeros((row_block, d + 1), jnp.float32)
-                .at[rows, ib]
-                .add(vb)[:, :d]
-            )
+            # one-hot densify: a static sum of w compare-selects that
+            # XLA fuses into ONE elementwise pass writing the dense
+            # block. Measured 9x faster than scatter-add densify on TPU
+            # (scripts/sparse_microbench.py: TPU scatter serializes,
+            # ~10 ns/element; the fused compare pass streams at VPU
+            # rate). Duplicate ids within a row accumulate, matching
+            # scatter-add semantics.
+            dense = sum(
+                jnp.where(ib[j][:, None] == iota[None, :],
+                          vb[j][:, None], 0.0)
+                for j in range(w)
+            )[:, :d]
             return (
                 G + dense.T @ dense,
                 C + dense.T @ Ybt.T,

@@ -23,10 +23,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+OOM_RC = 17  # child exit code: HBM exhausted at this n — parent shrinks
 
 # allow `python scripts/solver_sweep.py` without an installed package
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -101,6 +104,79 @@ def _fit_once(est, data, labels):
     return (time.perf_counter() - t0) * 1e3
 
 
+def _amazon_route(d: int):
+    from keystone_tpu.nodes.learning import SparseLBFGSwithL2
+
+    w = max(1, int(d * AMAZON_SPARSITY))
+    est = SparseLBFGSwithL2(lam=1e-2, num_iters=20)
+    return est._route(AMAZON_N, d, AMAZON_K, w), w
+
+
+def _amazon_n_budget(d: int) -> int:
+    """Largest row count the 16 GB chip can hold for an Amazon-shaped
+    problem in the slot-major layout, by solver route. Gram route:
+    idx+val at 8 sublane-padded slots (8·w8) + labels (4·k8) + the
+    streamed dense block / G / C (amortized constant). Iterative route
+    adds the column form (~8.4·w), residual + two transients (12·k8),
+    mask, and the with_column_form sort transient (~16·w), whichever
+    phase peaks."""
+    from keystone_tpu.data.sparse import sublane_pad8
+
+    route, w = _amazon_route(d)
+    w8, k8 = sublane_pad8(w), sublane_pad8(AMAZON_K)
+    if route == "gram":
+        # 12·w8: idx+val plus the fresh-value perturbed copy of val
+        # that _fit_once keeps live during the timed fit
+        per_row = 12.0 * w8 + 4.0 * k8
+        return int(12.0e9 / per_row)
+    solve_peak = 8.0 * w8 + 8.4 * w + 16.0 * k8 + 4.0
+    build_peak = 8.0 * w8 + 8.4 * w + 16.0 * w + 4.0 * k8
+    return int(13.0e9 / max(solve_peak, build_peak))
+
+
+def measure_amazon_row(d: int, n: int, n_full: int) -> dict:
+    """Generate an Amazon-shaped problem slot-major ON DEVICE at row
+    count n and time the cost-routed sparse L-BFGS fit (warm, fresh
+    values). Runs in its own process under the sweep driver so an OOM
+    cannot poison later attempts."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.data.sparse import PaddedSparseDataset
+    from keystone_tpu.nodes.learning import SparseLBFGSwithL2
+
+    w = max(1, int(d * AMAZON_SPARSITY))
+
+    @jax.jit
+    def make_sparse(key):
+        ki, kv, ky = jax.random.split(key, 3)
+        idxT = jax.random.randint(ki, (w, n), 0, d, jnp.int32)
+        valT = jax.random.normal(kv, (w, n), jnp.float32)
+        Yt = jax.random.normal(ky, (AMAZON_K, n), jnp.float32)
+        return idxT, valT, Yt
+
+    route, _ = _amazon_route(d)
+    idxT, valT, Yt = make_sparse(jax.random.PRNGKey(d))
+    sd = PaddedSparseDataset(idxT, valT, d, nnz=n * w)
+    if route == "iterative":  # gram never touches the column form
+        sd = sd.with_column_form()
+    est = SparseLBFGSwithL2(lam=1e-2, num_iters=20)
+    _fit_once(est, sd, Yt)
+    ms = _fit_once(est, sd, Yt)
+    n_scale = n / n_full
+    ref = REFERENCE_MS.get(("amazon", "lbfgs", d))
+    scaled = ms / max(n_scale, 1e-9)
+    return {
+        "experiment": "amazon-shaped", "solver": f"sparse-lbfgs-{route}",
+        "d": d, "n": n, "n_scale": round(n_scale, 6),
+        "sparsity": AMAZON_SPARSITY,
+        "time_ms": round(ms, 1),
+        "scaled_time_ms": round(scaled, 1),
+        "reference_ms_16xr3.4xlarge": ref,
+        "speedup_vs_reference": round(ref / scaled, 2) if ref else None,
+    }
+
+
 def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9,
               experiments: tuple = ("timit", "amazon")):
     import jax
@@ -168,74 +244,47 @@ def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9,
             print(json.dumps(rows[-1]), flush=True)
         del data, labels
 
-    # Amazon-shaped sparse: device-resident width-padded rows (both
-    # orientations) + iterative matvec L-BFGS — the reference's actual
-    # iteration structure (per-partition sparse gradients, LBFGS.scala)
-    # rather than one-pass Gram formation, which at k=2 is a ~10⁴× FLOP
-    # blow-up. The problem is GENERATED on device (jitted PRNG): at
-    # d=1024 (w=5) the FULL reference n=65e6 fits the padded-layout
-    # budget — no n-scaling at all; wider d runs at the largest n the
-    # budget allows (d=2048 → 32.5M rows, d=16384 → ~4M).
+    # Amazon-shaped sparse: slot-major device-resident width-padded
+    # rows, solver route picked by the measured cost model (gram =
+    # one-hot densify + MXU for these d's; iterative gather matvecs
+    # only for hashing-scale d — see SparseLBFGSwithL2._route and
+    # scripts/sparse_microbench.py). The problem is GENERATED on device
+    # (jitted PRNG); each row runs in a fresh subprocess at the largest
+    # n the per-route HBM budget allows (full n=65e6 at d≤2048).
     amz_n_full = 20_000 if quick else AMAZON_N
     for d in (dims if "amazon" in experiments else ()):
-        from keystone_tpu.data.sparse import PaddedSparseDataset
-
-        from keystone_tpu.data.sparse import sublane_pad8
-
-        w = max(1, int(d * AMAZON_SPARSITY))
-        # slot-major device budget per row (bytes): idx+val at 8
-        # sublane-padded slots, the column form at ~nnz, and Yt/R plus
-        # two transients at 8 sublane-padded label rows, mask
-        w8, k8 = sublane_pad8(w), sublane_pad8(AMAZON_K)
-        per_row = 8.0 * w8 + 8.4 * w + 16.0 * k8 + 4.0
-        n_cap = 20_000 if quick else int(13.5e9 / per_row)
-        n = min(amz_n_full, n_cap)
-
-        ms = None
-        while True:
-            n_scale = n / amz_n_full
-
-            @jax.jit
-            def make_sparse(key):
-                ki, kv, ky = jax.random.split(key, 3)
-                idxT = jax.random.randint(ki, (w, n), 0, d, jnp.int32)
-                valT = jax.random.normal(kv, (w, n), jnp.float32)
-                Yt = jax.random.normal(ky, (AMAZON_K, n), jnp.float32)
-                return idxT, valT, Yt
-
-            try:
-                idxT, valT, Yt = make_sparse(jax.random.PRNGKey(d))
-                sd = PaddedSparseDataset(
-                    idxT, valT, d, nnz=n * w).with_column_form()
-                est = SparseLBFGSwithL2(lam=1e-2, num_iters=20)
-                _fit_once(est, sd, Yt)
-                ms = _fit_once(est, sd, Yt)
-                break
-            except RuntimeError as e:  # HBM exhausted: shrink and retry
-                if not any(s in str(e) for s in
-                           ("exceed memory", "RESOURCE_EXHAUSTED",
-                            "Allocation")):
-                    raise
-                idxT = valT = Yt = sd = None  # release device buffers
-                n = int(n * 0.85)
-                print(json.dumps({"experiment": "amazon-shaped", "d": d,
-                                  "oom_retry_n": n}), flush=True)
-                if n < 1_000_000:
-                    raise
-
-        ref = REFERENCE_MS.get(("amazon", "lbfgs", d))
-        scaled = ms / max(n_scale, 1e-9)
-        rows.append({
-            "experiment": "amazon-shaped", "solver": "sparse-lbfgs", "d": d,
-            "n": n, "n_scale": round(n_scale, 6),
-            "sparsity": AMAZON_SPARSITY,
-            "time_ms": round(ms, 1),
-            "scaled_time_ms": round(scaled, 1),
-            "reference_ms_16xr3.4xlarge": ref,
-            "speedup_vs_reference": round(ref / scaled, 2) if ref else None,
-        })
-        print(json.dumps(rows[-1]), flush=True)
-        del idxT, valT, Yt, sd
+        n = min(amz_n_full, 20_000 if quick else _amazon_n_budget(d))
+        if quick:
+            row = measure_amazon_row(d, n, amz_n_full)
+        else:
+            # one SUBPROCESS per attempt: an HBM OOM under the tunnel
+            # poisons the arena for the rest of the process (observed:
+            # after one ResourceExhausted every later allocation fails
+            # down to n=1M), so shrink-and-retry must start from a
+            # fresh device session each time
+            row = None
+            while row is None:
+                r = subprocess.run(
+                    [sys.executable, "-u", os.path.abspath(__file__),
+                     "--one-amazon", str(d), "--n", str(n)],
+                    capture_output=True, text=True,
+                    cwd=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))))
+                if r.returncode == 0:
+                    row = json.loads(r.stdout.strip().splitlines()[-1])
+                elif r.returncode == OOM_RC:
+                    n = int(n * 0.8)
+                    print(json.dumps({"experiment": "amazon-shaped",
+                                      "d": d, "oom_retry_n": n}), flush=True)
+                    if n < 1_000_000:
+                        raise RuntimeError(
+                            f"amazon d={d}: OOM even at n<1e6")
+                else:
+                    raise RuntimeError(
+                        f"amazon d={d} child failed rc={r.returncode}:\n"
+                        f"{r.stderr[-2000:]}")
+        rows.append(row)
+        print(json.dumps(row), flush=True)
 
     return {
         "workload": "solver sweep (BASELINE.md / solver-comparisons-final.csv)",
@@ -277,13 +326,29 @@ def main():
     p.add_argument("--experiments", nargs="+", default=["timit", "amazon"],
                    choices=["timit", "amazon"],
                    help="subset to run (e.g. re-measure amazon alone)")
+    p.add_argument("--one-amazon", type=int, default=None, metavar="D",
+                   help="(internal) measure one amazon row at --n rows "
+                        "in this process; prints the row JSON")
+    p.add_argument("--n", type=int, default=None)
     args = p.parse_args()
     if os.environ.get("KEYSTONE_BACKEND") == "cpu":
         # programmatic forcing works where env-var platform selection
-        # can hang under plugin site hooks (see keystone_tpu/__main__.py)
+        # can hang under plugin site hooks (see keystone_tpu/__main__.py);
+        # must run before the --one-amazon child branch too
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.one_amazon is not None:
+        try:
+            row = measure_amazon_row(args.one_amazon, args.n, AMAZON_N)
+        except RuntimeError as e:
+            if any(s in str(e) for s in ("exceed memory",
+                                         "RESOURCE_EXHAUSTED", "Allocation")):
+                print(str(e)[-500:], file=sys.stderr)
+                sys.exit(OOM_RC)
+            raise
+        print(json.dumps(row), flush=True)
+        return
     result = run_sweep(quick=args.quick,
                        experiments=tuple(args.experiments))
     if set(args.experiments) != {"timit", "amazon"} and os.path.exists(args.out):

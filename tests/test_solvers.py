@@ -419,19 +419,25 @@ def test_padded_sparse_dataset_device_resident_fit():
 
 
 def test_sparse_lbfgs_route_cost_model():
-    """Routing mirrors the reference CostModel economics: amazon-shaped
-    (k=2, d large, shallow rows) → iterative; small-d / wide-k Gram-
-    friendly shapes → gram."""
+    """Routing mirrors the reference CostModel economics re-derived
+    from measured chip rates (scripts/sparse_microbench.py): the TPU
+    has no gather hardware (~5 ns/element scalar gathers), so for
+    k ≪ d the one-pass densified MXU Gram beats num_iters of gather
+    matvecs even at amazon's d=16384 — while hashing-trick shapes
+    (d ~ 2^20, shallow rows) still route iterative, where the d² MXU
+    term is hopeless."""
     from keystone_tpu.nodes.learning import SparseLBFGSwithL2
 
     est = SparseLBFGSwithL2(num_iters=20)
-    # amazon-shaped: n=65e6, d=16384, k=2, w≈82
-    assert est._route(65_000_000, 16384, 2, 82) == "iterative"
-    # small-d dense-ish: Gram's one pass wins
+    # amazon-shaped: n=65e6, d=16384, k=2, w≈82 → densified MXU Gram
+    assert est._route(65_000_000, 16384, 2, 82) == "gram"
+    # small-d dense-ish: Gram's one pass wins outright
     assert est._route(400, 50, 2, 6) == "gram"
+    # hashing-trick text features: d=2^20, w=50 → iterative
+    assert est._route(1_000_000, 1 << 20, 2, 50) == "iterative"
     # explicit override is respected
-    assert SparseLBFGSwithL2(method="gram")._route(
-        65_000_000, 16384, 2, 82) == "gram"
+    assert SparseLBFGSwithL2(method="iterative")._route(
+        65_000_000, 16384, 2, 82) == "iterative"
 
 
 def test_padded_sparse_column_form_paths_agree():
