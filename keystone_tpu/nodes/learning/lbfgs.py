@@ -449,6 +449,7 @@ class SparseLBFGSwithL2(LabelEstimator):
         fit_intercept: bool = True,
         block_rows: int = 65536,
         method: "str | None" = None,
+        gram_precision: str = "highest",
     ):
         self.lam = lam
         self.num_iters = num_iters
@@ -458,6 +459,16 @@ class SparseLBFGSwithL2(LabelEstimator):
         if method not in (None, "gram", "iterative"):
             raise ValueError(f"method must be gram|iterative, got {method!r}")
         self.method = method
+        if gram_precision not in ("default", "high", "highest"):
+            raise ValueError(
+                f"gram_precision must be default|high|highest, "
+                f"got {gram_precision!r}")
+        # MXU passes for the Gram GEMMs: "highest" = 6-pass bf16x6
+        # (f32-grade), "high" = 3-pass bf16x3 (measured ~1e-5 max
+        # relative W delta vs highest at amazon shapes — PERF.md),
+        # "default" = single bf16 pass. The L-BFGS iterations on G
+        # stay at highest regardless.
+        self.gram_precision = gram_precision
         # both routes consume the pipeline input ONCE (the iterative
         # route keeps the padded rows device-resident across iterations),
         # unlike the reference whose num_iters weight models Spark
@@ -506,7 +517,8 @@ class SparseLBFGSwithL2(LabelEstimator):
             Y = jnp.pad(Y, ((0, 0), (0, n_pad - n)))
         G, C, col_sum = _sparse_gram_accumulate(
             jnp.asarray(idx), jnp.asarray(val),
-            jnp.asarray(Y, jnp.float32), row_block, d)
+            jnp.asarray(Y, jnp.float32), row_block, d,
+            precision=self.gram_precision)
         if self.fit_intercept:
             xm = col_sum / n_true
             ym = jnp.sum(Y, axis=1) / n_true
@@ -691,7 +703,8 @@ class SparseLBFGSwithL2(LabelEstimator):
             # pushing it back would reintroduce the O(d²) host traffic
             # this path exists to avoid. Returns None when width-padding
             # would blow up (outlier dense row) — host path below.
-            device_gram = _sparse_gram_on_device(X, Y, self.block_rows)
+            device_gram = _sparse_gram_on_device(
+                X, Y, self.block_rows, precision=self.gram_precision)
         if device_gram is not None:
             G, C, col_sum = device_gram
         else:
@@ -722,23 +735,30 @@ class SparseLBFGSwithL2(LabelEstimator):
         return SparseLinearMapper(W) if sparse_in else LinearMapper(W)
 
 
-@partial(jax.jit, static_argnames=("row_block", "d"))
-def _sparse_gram_accumulate(idx_pad, val_pad, Y, row_block: int, d: int):
-    """Accumulate G = XᵀX, C = XᵀY, colsum(X) from slot-major
-    width-padded CSR rows (idx/val (w, n), Y (k, n)) entirely on
-    device: each row block is densified by scatter-add into a
-    (row_block, d+1) buffer (column d is the padding sentinel) and the
-    Gram update runs on the MXU. One jitted fori_loop — no per-block
-    host round trips, no (n, d) dense array in HBM."""
+@partial(jax.jit,
+         static_argnames=("row_block", "d", "precision"))
+def _sparse_gram_accumulate_chunk(idx_pad, val_pad, Y, row_block: int,
+                                  d: int, n_blocks, start, carry,
+                                  precision: str = "highest"):
+    """Accumulate G = XᵀX, C = XᵀY, colsum(X) over `n_blocks` row
+    blocks beginning at block `start`, continuing a device-resident
+    carry. Each row block is densified by a fused one-hot pass
+    (column d is the padding sentinel) and the Gram update runs on the
+    MXU — no per-block host round trips, no (n, d) dense array in HBM.
+    Chunked because one monolithic accumulation over ~10⁹ rows is a
+    multi-minute single XLA execution, which the tunnel's TPU worker
+    can kill mid-run (observed at d=8192); the carry stays on device so
+    chunking costs only dispatch latency. `n_blocks` and `start` are
+    traced (fori_loop takes a dynamic trip count), so the trailing
+    partial chunk reuses the same compiled program."""
     w, n_pad = idx_pad.shape
-    n_blocks = n_pad // row_block
-    k = Y.shape[0]
     iota = jnp.arange(d + 1, dtype=idx_pad.dtype)
 
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision(precision):
 
         def body(i, carry):
             G, C, s = carry
+            i = start + i
             ib = jax.lax.dynamic_slice_in_dim(
                 idx_pad, i * row_block, row_block, 1)
             vb = jax.lax.dynamic_slice_in_dim(
@@ -766,15 +786,41 @@ def _sparse_gram_accumulate(idx_pad, val_pad, Y, row_block: int, d: int):
                 s + dense.sum(axis=0),
             )
 
-        init = (
-            jnp.zeros((d, d), jnp.float32),
-            jnp.zeros((d, k), jnp.float32),
-            jnp.zeros((d,), jnp.float32),
-        )
-        return jax.lax.fori_loop(0, n_blocks, body, init)
+        return jax.lax.fori_loop(0, n_blocks, body, carry)
 
 
-def _sparse_gram_on_device(X, Y, block_rows: int):
+def _sparse_gram_accumulate(idx_pad, val_pad, Y, row_block: int, d: int,
+                            precision: str = "highest"):
+    """Drive `_sparse_gram_accumulate_chunk` over all row blocks in
+    executions bounded to a few seconds of device time each (the carry
+    never leaves the device)."""
+    w, n_pad = idx_pad.shape
+    k = Y.shape[0]
+    total_blocks = n_pad // row_block
+    # per-block cost ~ 2·b·d² MXU passes + b·d·w one-hot ops; bound a
+    # chunk at ~2e13 of the former + ~2e12-rate of the latter ≈ a few s
+    mxu_passes = {"default": 1.0, "high": 3.0, "highest": 6.0}.get(
+        str(precision), 6.0)
+    per_block = mxu_passes * 2.0 * row_block * d * d / 2.0e13 \
+        + row_block * (d + 1) * w / 2.0e12
+    blocks_per_chunk = max(1, int(4.0 / max(per_block, 1e-9)))
+    carry = (
+        jnp.zeros((d, d), jnp.float32),
+        jnp.zeros((d, k), jnp.float32),
+        jnp.zeros((d,), jnp.float32),
+    )
+    start = 0
+    while start < total_blocks:
+        nb = min(blocks_per_chunk, total_blocks - start)
+        carry = _sparse_gram_accumulate_chunk(
+            idx_pad, val_pad, Y, row_block, d, jnp.int32(nb),
+            jnp.int32(start), carry, precision)
+        start += nb
+    return carry
+
+
+def _sparse_gram_on_device(X, Y, block_rows: int,
+                           precision: str = "highest"):
     """Host CSR → width-padded (n, w) index/value arrays (one transfer)
     → on-device blockwise densify + MXU Gram. This is the TPU-native
     sparse reduction: the previous host-scipy Gram was d²-bound on CPU
@@ -810,5 +856,5 @@ def _sparse_gram_on_device(X, Y, block_rows: int):
         Yt = np.pad(Yt, ((0, 0), (0, n_pad - n)))
     return _sparse_gram_accumulate(
         jnp.asarray(idx_pad), jnp.asarray(val_pad),
-        jnp.asarray(Yt), row_block, d,
+        jnp.asarray(Yt), row_block, d, precision=precision,
     )

@@ -134,7 +134,8 @@ def _amazon_n_budget(d: int) -> int:
     return int(13.0e9 / max(solve_peak, build_peak))
 
 
-def measure_amazon_row(d: int, n: int, n_full: int) -> dict:
+def measure_amazon_row(d: int, n: int, n_full: int,
+                       precision: str = "highest") -> dict:
     """Generate an Amazon-shaped problem slot-major ON DEVICE at row
     count n and time the cost-routed sparse L-BFGS fit (warm, fresh
     values). Runs in its own process under the sweep driver so an OOM
@@ -160,13 +161,14 @@ def measure_amazon_row(d: int, n: int, n_full: int) -> dict:
     sd = PaddedSparseDataset(idxT, valT, d, nnz=n * w)
     if route == "iterative":  # gram never touches the column form
         sd = sd.with_column_form()
-    est = SparseLBFGSwithL2(lam=1e-2, num_iters=20)
+    est = SparseLBFGSwithL2(lam=1e-2, num_iters=20,
+                            gram_precision=precision)
     _fit_once(est, sd, Yt)
     ms = _fit_once(est, sd, Yt)
     n_scale = n / n_full
     ref = REFERENCE_MS.get(("amazon", "lbfgs", d))
     scaled = ms / max(n_scale, 1e-9)
-    return {
+    row = {
         "experiment": "amazon-shaped", "solver": f"sparse-lbfgs-{route}",
         "d": d, "n": n, "n_scale": round(n_scale, 6),
         "sparsity": AMAZON_SPARSITY,
@@ -175,6 +177,9 @@ def measure_amazon_row(d: int, n: int, n_full: int) -> dict:
         "reference_ms_16xr3.4xlarge": ref,
         "speedup_vs_reference": round(ref / scaled, 2) if ref else None,
     }
+    if precision != "highest":
+        row["gram_precision"] = precision
+    return row
 
 
 def run_sweep(quick: bool = False, hbm_budget_bytes: float = 12e9,
@@ -330,6 +335,9 @@ def main():
                    help="(internal) measure one amazon row at --n rows "
                         "in this process; prints the row JSON")
     p.add_argument("--n", type=int, default=None)
+    p.add_argument("--precision", default="highest",
+                   choices=["default", "high", "highest"],
+                   help="(with --one-amazon) Gram GEMM precision")
     args = p.parse_args()
     if os.environ.get("KEYSTONE_BACKEND") == "cpu":
         # programmatic forcing works where env-var platform selection
@@ -340,7 +348,8 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     if args.one_amazon is not None:
         try:
-            row = measure_amazon_row(args.one_amazon, args.n, AMAZON_N)
+            row = measure_amazon_row(args.one_amazon, args.n, AMAZON_N,
+                                     precision=args.precision)
         except RuntimeError as e:
             if any(s in str(e) for s in ("exceed memory",
                                          "RESOURCE_EXHAUSTED", "Allocation")):
