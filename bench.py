@@ -599,6 +599,7 @@ def child_main(args):
     from keystone_tpu.pipelines.random_patch_cifar import (
         RandomPatchCifarConfig,
         build_pipeline,
+        run_fused,
         run_staged,
     )
     from keystone_tpu.loaders.cifar_loader import cifar_loader, synthetic_cifar
@@ -726,6 +727,45 @@ def child_main(args):
     }
     # Checkpoint: a wedge during the staged/flagship phases still leaves
     # a live headline measurement in the parent's hands.
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
+    # Fused tier: the SAME training run as one XLA program (the
+    # `--fused` CLI path, run_fused) — filter learning, featurize,
+    # scaler, the pipeline's own BCD solve, and train/test confusion in
+    # a single device execution, so per-dispatch latency is paid once.
+    # Solver-identical to the pipeline path (it jits the same
+    # _bcd_fit_impl), hence reported as a tier of the same record.
+    phase("fused_tier")
+    try:
+        run_fused(train, test, config)  # compile + warm
+        # fresh-valued timed run (PERF.md methodology: the transport
+        # memoizes byte-identical executions); perturbation dispatched
+        # and fenced BEFORE the timed window
+        import random as _random
+
+        from keystone_tpu.loaders.csv_loader import LabeledData
+
+        eps = _random.random() * 1e-6
+        train_f = LabeledData(
+            labels=train.labels,
+            data=train.data.map_batches(lambda x: x * (1.0 + eps)).sync())
+        t0 = time.perf_counter()
+        fused_res = run_fused(train_f, test, config)
+        fused_s = time.perf_counter() - t0
+        fused_detail = {
+            "train_seconds": round(fused_s, 3),
+            "images_per_sec": round(train.data.count / fused_s, 2),
+            "test_accuracy": round(fused_res["test_accuracy"], 4),
+            "note": "one-execution training run (run_fused, the --fused "
+                    "CLI path); includes train+test featurize and both "
+                    "confusion matrices",
+        }
+    except Exception as e:  # the tier must not cost the rest of the
+        # record (e.g. an OOM at these shapes on a future geometry)
+        fused_detail = {"error": f"{type(e).__name__}: {e}"}
+    detail.update({"progress": "fused_tier", "fused": fused_detail})
+    phase("fused_done",
+          seconds=fused_detail.get("train_seconds", "error"))
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     # Stage breakdown: same components, scalar-pull sync after each

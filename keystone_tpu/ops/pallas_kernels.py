@@ -347,90 +347,106 @@ def conv_rectify_pool(
     )
 
 
-def _pool_matrix(b: int, pos_h: int, pos_w: int, posp: int,
+def _pool_matrix(pos_h: int, pos_w: int, posp: int,
                  pool: int, stride: int) -> "np.ndarray":
-    """(b·cells, b·posp) block-diagonal 0/1 sum-pool weights over the
-    flattened (i·pos_w + j) position index of each image."""
+    """(cells, posp) 0/1 sum-pool weights over the flattened
+    (i·pos_w + j) position index of ONE image. The kernel applies it
+    per image — a block-diagonal (b·cells, b·posp) form would make the
+    pool GEMM's FLOPs scale with b² (measured: at the CIFAR geometry it
+    out-FLOPed the conv GEMM ~3× at f32-HIGHEST). The kernel pads each
+    image's output group to cells_p = round_up(cells, 8) rows so the
+    dynamic stores stay tile-aligned; the ~2× pooled-write + strip-slice
+    cost that implies is accepted — pooled traffic is ~20× smaller than
+    the patch feed."""
     import numpy as np
 
     gy = (pos_h - pool) // stride + 1
     gx = (pos_w - pool) // stride + 1
     cells = gy * gx
-    M = np.zeros((b * cells, b * posp), np.float32)
-    for im in range(b):
-        for iy in range(gy):
-            for ix in range(gx):
-                r = im * cells + iy * gx + ix
-                for i in range(iy * stride, iy * stride + pool):
-                    for j in range(ix * stride, ix * stride + pool):
-                        M[r, im * posp + i * pos_w + j] = 1.0
+    M = np.zeros((cells, posp), np.float32)
+    for iy in range(gy):
+        for ix in range(gx):
+            r = iy * gx + ix
+            for i in range(iy * stride, iy * stride + pool):
+                for j in range(ix * stride, ix * stride + pool):
+                    M[r, i * pos_w + j] = 1.0
     return M
 
 
 def _conv_rect_pool_kernel(
     pat_ref, g_ref, pmat_ref, colsum_ref, bias_ref, o_ref,
-    *, alpha, max_val, d_real, k, normalize,
+    *, alpha, max_val, d_real, k, normalize, b, posp, cells_p,
 ):
-    pat = pat_ref[:]                                   # (b·posp, dp) bf16
-    # precision pinned DEFAULT: bf16 operands under an ambient
-    # default_matmul_precision("highest") context would ask Mosaic for an
-    # fp32-contract bf16 matmul, which it rejects ("Bad lhs type")
-    z = jnp.dot(pat, g_ref[:], preferred_element_type=jnp.float32,
-                precision=lax.Precision.DEFAULT)
-    if normalize:
-        means = jnp.sum(pat.astype(jnp.float32), axis=1, keepdims=True) * (
-            1.0 / d_real
+    g = g_ref[:]                                       # (dp, k) bf16
+    pm = pmat_ref[:]                                   # (cells_p, posp) 0/1
+    cs = colsum_ref[:]
+    bs = bias_ref[:]
+
+    def body(im, carry):
+        pat = pat_ref[pl.ds(im * posp, posp), :]       # (posp, dp) bf16
+        # precision pinned DEFAULT: bf16 operands under an ambient
+        # default_matmul_precision("highest") context would ask Mosaic
+        # for an fp32-contract bf16 matmul, which it rejects ("Bad lhs
+        # type")
+        z = jnp.dot(pat, g, preferred_element_type=jnp.float32,
+                    precision=lax.Precision.DEFAULT)
+        if normalize:
+            means = jnp.sum(pat.astype(jnp.float32), axis=1,
+                            keepdims=True) * (1.0 / d_real)
+            z = z - means * cs
+        out = z + bs
+        # HIGHEST: the rectified activations would otherwise be
+        # truncated to bf16 by the pool GEMM, a second rounding on top
+        # of the documented bf16 patch feed; the 0/1 pm operand is
+        # exact either way. Both stores are tile-aligned: posp % 8 == 0
+        # and the per-image output group is padded to cells_p rows.
+        act = jnp.concatenate(
+            [jnp.maximum(max_val, out - alpha),
+             jnp.maximum(max_val, -out - alpha)],
+            axis=1,
         )
-        z = z - means * colsum_ref[:]
-    out = z + bias_ref[:]
-    pm = pmat_ref[:]
-    # HIGHEST: the rectified activations would otherwise be truncated to
-    # bf16 by the pool GEMM, a second rounding on top of the documented
-    # bf16 patch feed; the 0/1 pm operand is exact either way. One full-
-    # block store (no partial lane slice: k need not be a 128-multiple).
-    pos = jnp.maximum(max_val, out - alpha)
-    neg = jnp.maximum(max_val, -out - alpha)
-    o_ref[:] = jnp.concatenate(
-        [
-            jnp.dot(pm, pos, preferred_element_type=jnp.float32,
-                    precision=lax.Precision.HIGHEST),
-            jnp.dot(pm, neg, preferred_element_type=jnp.float32,
-                    precision=lax.Precision.HIGHEST),
-        ],
-        axis=1,
-    )
+        o_ref[pl.ds(im * cells_p, cells_p), :] = jnp.dot(
+            pm, act, preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)
+        return carry
+
+    # a SEQUENTIAL loop on purpose: per-image z/act transients are the
+    # VMEM hogs, and fori_loop guarantees only one iteration's worth is
+    # live — the block chooser's budget is structural, not a scheduling
+    # guess (a Python-unrolled loop would let Mosaic keep several
+    # images' transients in flight)
+    lax.fori_loop(0, b, body, 0)
 
 
 def _fused_conv_block_images(posp: int, dp: int, k: int, cells: int) -> int:
     """Largest block of images whose kernel working set fits ~10 MB of
-    VMEM and whose output row count (b·cells) is a multiple of 8."""
-    import math
-
-    b = 8 // math.gcd(8, cells)  # smallest b with b·cells % 8 == 0
-    # Mosaic pads the lane (minor) dimension to 128: every (rows, k)
-    # f32 buffer really occupies (rows, round_up(k, 128)) of VMEM. For
-    # small k this is the dominant term — ignoring it produced a real
-    # scoped-vmem OOM at k=16 (21.5 MB actual vs 8.9 MB estimated).
+    VMEM; the output row count (b·cells_p) is always a multiple of 8
+    because cells_p is."""
     kp = -(-k // 128) * 128
     k2p = -(-(2 * k) // 128) * 128
+    cells_p = -(-cells // 8) * 8
     best = 0
-    cand = b
-    while cand <= 64:
-        # peak liveness: z stays live throughout, but pos is dead before
-        # neg materializes (each is consumed by its pool dot), so two
-        # (b·posp, kp) f32 buffers, not three; the 10 MB cap of the
-        # 16 MB VMEM absorbs scheduling slop
+    cand = 2
+    while cand <= 32:
+        # Mosaic pads the lane (minor) dimension to 128: every (rows, k)
+        # f32 buffer really occupies (rows, round_up(k, 128)) of VMEM —
+        # ignoring it produced a real scoped-vmem OOM at k=16 (21.5 MB
+        # actual vs 8.9 MB estimated). The conv/rectify intermediates
+        # (z, act) are ONE image's worth by construction (sequential
+        # fori_loop in the kernel), so they don't scale with the block;
+        # the 10 MB cap of the 16 MB VMEM absorbs scheduling slop.
         bytes_needed = (
-            2 * cand * posp * dp * 2          # patches, double-buffered bf16
-            + 2 * cand * posp * kp * 4        # z + one rectified sign (f32)
-            + 2 * cand * cells * k2p * 4      # pooled out, double-buffered
-            + cand * cells * cand * posp * 4  # pool matrix
+            2 * cand * posp * dp * 2        # patches, double-buffered bf16
+            + posp * kp * 4                 # z (one image, f32)
+            + posp * k2p * 4                # act = both rectified signs
+            + 2 * cand * cells_p * k2p * 4  # pooled out, double-buffered
+            + cells_p * posp * 4            # one-image pool matrix
             + dp * kp * 2
         )
         if bytes_needed > 10 * (1 << 20):
             break
         best = cand
-        cand += b
+        cand += 2
     return best
 
 
@@ -449,7 +465,9 @@ def conv_rectify_pool_pallas(
     k = G_cmajor.shape[1]
     pos_h, pos_w = h - patch + 1, w - patch + 1
     npos = pos_h * pos_w
-    posp = _round_up(npos, 8)
+    # 16, not 8: the kernel takes per-image DYNAMIC row slices of the
+    # bf16 patches ref at offsets im*posp, and the bf16 tile is (16,128)
+    posp = _round_up(npos, 16)
     dp = _round_up(d, 128)
     gy = (pos_h - pool) // stride + 1
     gx = (pos_w - pool) // stride + 1
@@ -467,8 +485,12 @@ def conv_rectify_pool_pallas(
     pat = jnp.pad(pat, ((0, n_pad - n), (0, posp - npos), (0, dp - d)))
     pat = pat.reshape(n_pad * posp, dp).astype(jnp.bfloat16)
 
+    cells_p = _round_up(cells, 8)
     Gp = jnp.pad(G_cmajor, ((0, dp - d), (0, 0))).astype(jnp.bfloat16)
-    pmat = jnp.asarray(_pool_matrix(b, pos_h, pos_w, posp, pool, stride))
+    pm = _pool_matrix(pos_h, pos_w, posp, pool, stride)
+    import numpy as np
+
+    pmat = jnp.asarray(np.pad(pm, ((0, cells_p - cells), (0, 0))))
     cs = jnp.asarray(colsum, jnp.float32).reshape(1, k)
     bs = jnp.asarray(bias, jnp.float32).reshape(1, k)
 
@@ -477,21 +499,24 @@ def conv_rectify_pool_pallas(
         partial(
             _conv_rect_pool_kernel,
             alpha=float(alpha), max_val=float(max_val),
-            d_real=d, k=k, normalize=normalize,
+            d_real=d, k=k, normalize=normalize, b=b, posp=posp,
+            cells_p=cells_p,
         ),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((b * posp, dp), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((dp, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((b * cells, b * posp), lambda i: (0, 0),
+            pl.BlockSpec((cells_p, posp), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((b * cells, 2 * k), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((b * cells_p, 2 * k), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((grid * b * cells, 2 * k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((grid * b * cells_p, 2 * k),
+                                       jnp.float32),
         interpret=interpret,
     )(pat, Gp, pmat, cs, bs)
-    return out.reshape(n_pad, gy, gx, 2 * k)[:n]
+    return (out.reshape(n_pad, cells_p, 2 * k)[:n, :cells]
+            .reshape(n, gy, gx, 2 * k))
