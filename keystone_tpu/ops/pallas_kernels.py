@@ -314,15 +314,66 @@ def hwio_to_cmajor(kernel_hwio):
     return kernel_hwio.transpose(2, 0, 1, 3).reshape(-1, kernel_hwio.shape[3])
 
 
+_fused_conv_canary: dict = {}
+
+
+def _fused_conv_canary_ok(h: int, w: int, c: int, k: int, pool: int,
+                          stride: int, normalize: bool, patch: int) -> bool:
+    """Compile-and-run the fused kernel ONCE per geometry on tiny data,
+    eagerly. The dispatcher's trace-time try/except cannot see
+    COMPILE-time failures (a scoped-vmem OOM, a Mosaic lowering reject)
+    when the call sits inside an outer jit — they would surface when the
+    enclosing program compiles and hard-fail the pipeline. The canary
+    compiles the same kernel geometry (one n=1 call pads to one full
+    image block) outside any enclosing trace, so a bad geometry demotes
+    to the XLA path instead of crashing the run."""
+    key = (h, w, c, k, pool, stride, bool(normalize), patch)
+    # cached states: True (passed, permanent), False (failed,
+    # permanent), 1 (one failed attempt — retried once on the next
+    # call, so a transient device blip at first-trace time doesn't
+    # demote a working geometry for the whole process)
+    state = _fused_conv_canary.get(key)
+    if state is True or state is False:
+        return state
+    try:
+        import numpy as np
+
+        got = conv_rectify_pool_pallas(
+            jnp.zeros((1, h, w, c), jnp.float32),
+            jnp.zeros((c * patch * patch, k), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+            0.1, 0.0, pool, stride, normalize, patch,
+        )
+        ok = bool(np.isfinite(np.asarray(got)).all())
+    except FusedConvIneligibleError:
+        ok = False  # designed, silent fallback: the block geometry
+        # cannot fit VMEM (deterministic in the geometry)
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "fused conv canary failed at geometry %s (%s: %s); "
+            "using the XLA path for it", key, type(e).__name__, e)
+        ok = False if state == 1 else 1
+    _fused_conv_canary[key] = ok
+    return ok is True
+
+
 def conv_rectify_pool(
     images, kernel_hwio, colsum, bias, alpha, max_val,
     pool: int, stride: int, normalize: bool,
 ):
     """Dispatcher: fused Pallas kernel on TPU (default on), XLA
-    elsewhere or when the block geometry cannot fit VMEM. The single
-    entry point for Convolver>>Rectifier>>Pooler semantics — the fusion
-    peephole and the driver graft entry both route through it."""
-    if use_fused_conv():
+    elsewhere or when the block geometry cannot fit VMEM or fails its
+    canary compile. The single entry point for
+    Convolver>>Rectifier>>Pooler semantics — the fusion peephole and
+    the driver graft entry both route through it."""
+    if use_fused_conv() and _fused_conv_canary_ok(
+        images.shape[1], images.shape[2], images.shape[3],
+        kernel_hwio.shape[3], pool, stride, normalize,
+        kernel_hwio.shape[0],
+    ):
         try:
             return conv_rectify_pool_pallas(
                 images, hwio_to_cmajor(kernel_hwio), colsum, bias,
@@ -331,11 +382,9 @@ def conv_rectify_pool(
             )
         except FusedConvIneligibleError:
             pass
-        except Exception as e:  # Mosaic lowering/trace failure on an
-            # unanticipated geometry: degrade to the XLA path rather
-            # than hard-fail the pipeline (compile-time failures inside
-            # an outer jit are out of reach of this trace-time guard,
-            # so the kernel also avoids partial lane-dim stores).
+        except Exception as e:  # trace failure on an unanticipated
+            # geometry: degrade to the XLA path rather than hard-fail
+            # the pipeline (compile-time failures are the canary's job)
             import logging
 
             logging.getLogger(__name__).warning(

@@ -1,0 +1,116 @@
+"""Live (on-chip) validation + timing of the fused conv+rectify+pool
+Pallas kernel after a geometry/structure change.
+
+Three gates, in order (each is a prerequisite for trusting the next):
+
+1. COMPILE: the kernel at the CIFAR flagship geometry (k=256, the
+   largest block the VMEM chooser picks) must compile — a scoped-vmem
+   OOM here is the failure class interpret-mode tests cannot see.
+2. NUMERICS: on-chip agreement vs the XLA reference path at the same
+   geometry (tolerance: the documented bf16-patch-feed class, ~5e-4
+   relative, pooled over 196-element windows).
+3. TIMING: chained fresh-valued reps inside one program, R vs R/2
+   differenced so tunnel RTT/dispatch cancels (PERF.md methodology) —
+   prints per-rep seconds and kernel-only images/sec for the Pallas
+   path and the XLA reference path at the bench tier's batch.
+
+Run from the repo root on the live chip: python scripts/kernel_live_check.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from keystone_tpu.ops import (
+        conv_rectify_pool_pallas,
+        conv_rectify_pool_reference,
+        hwio_to_cmajor,
+    )
+    from keystone_tpu.ops.pallas_kernels import _fused_conv_block_images
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", flush=True)
+
+    k, patch, c, h, w = 256, 6, 3, 32, 32
+    pool, stride, alpha = 14, 13, 0.25
+    # derive the chooser inputs from the geometry above (must match the
+    # kernel's own internal computation in conv_rectify_pool_pallas)
+    pos_h, pos_w = h - patch + 1, w - patch + 1
+    posp = -(-(pos_h * pos_w) // 16) * 16
+    dp = -(-(c * patch * patch) // 128) * 128
+    cells = ((pos_h - pool) // stride + 1) * ((pos_w - pool) // stride + 1)
+    b = _fused_conv_block_images(posp, dp, k, cells)
+    print(f"block chooser at posp={posp} dp={dp} cells={cells} k={k}: "
+          f"b={b}", flush=True)
+
+    rng = np.random.default_rng(0)
+    kern = jnp.asarray(rng.normal(size=(patch, patch, c, k)).astype(np.float32))
+    g = hwio_to_cmajor(kern)
+    colsum = jnp.asarray(rng.normal(size=(k,)).astype(np.float32))
+    bias = jnp.asarray(rng.normal(size=(k,)).astype(np.float32))
+
+    # --- gate 1+2: compile at the chosen block and check numerics ------
+    n_small = 2 * b + 3  # forces a padded tail block too
+    x = jnp.asarray(rng.random((n_small, h, w, c)).astype(np.float32))
+    got = np.asarray(conv_rectify_pool_pallas(
+        x, g, colsum, bias, alpha, 0.0, pool, stride, True, patch))
+    want = np.asarray(conv_rectify_pool_reference(
+        x, kern, colsum, bias, alpha, 0.0, pool, stride, True))
+    scale = np.abs(want).max()
+    err = np.abs(got - want).max() / scale
+    assert err < 2e-3, f"gate 2 FAILED: max rel err {err:.2e}"
+    print(f"gate 1+2 ok: compiled at b={b}, n={n_small}; "
+          f"max rel err vs XLA on-chip = {err:.2e}", flush=True)
+
+    # --- gate 3: differenced chained-rep timing ------------------------
+    batch, reps = 16384, 120
+
+    def chained(fn_one, r):
+        @jax.jit
+        def run(xb, seed):
+            def body(i, acc):
+                key = jax.random.fold_in(seed, i)
+                xp = xb * (1.0 + 1e-6 * jax.random.uniform(key))
+                y = fn_one(xp)
+                return acc + y.reshape(xb.shape[0], -1)[:, :8].sum()
+
+            return lax.fori_loop(0, r, body, jnp.float32(0.0))
+
+        return run
+
+    xb = jnp.asarray(rng.random((batch, h, w, c)).astype(np.float32))
+
+    def pallas_one(xp):
+        return conv_rectify_pool_pallas(
+            xp, g, colsum, bias, alpha, 0.0, pool, stride, True, patch)
+
+    def ref_one(xp):
+        return conv_rectify_pool_reference(
+            xp, kern, colsum, bias, alpha, 0.0, pool, stride, True)
+
+    for name, fn_one in (("pallas", pallas_one), ("xla", ref_one)):
+        seconds = {}
+        for r in (reps // 2, reps):
+            run = chained(fn_one, r)
+            float(run(xb, jax.random.PRNGKey(0)))  # compile+warm
+            t0 = time.perf_counter()
+            s = float(run(xb, jax.random.PRNGKey(1)))
+            seconds[r] = time.perf_counter() - t0
+            assert np.isfinite(s)
+        per_rep = (seconds[reps] - seconds[reps // 2]) / (reps - reps // 2)
+        print(f"{name}: full={seconds[reps]:.3f}s half={seconds[reps//2]:.3f}s "
+              f"per_rep={per_rep*1e3:.2f}ms "
+              f"kernel_only={batch/per_rep:,.0f} img/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

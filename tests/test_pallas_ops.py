@@ -120,6 +120,8 @@ def test_krr_still_learns_with_static_gamma():
         (5, 32, 32, 3, 6, 32, 14, 13, True),   # CIFAR north-star geometry
         (3, 16, 16, 1, 5, 16, 6, 6, False),    # gray, no normalization
         (2, 20, 14, 2, 3, 8, 5, 4, True),      # rectangular
+        (3, 16, 16, 1, 2, 8, 5, 5, False),     # npos=225: 16-alignment
+        # padding of the patch rows; cells=9 > 8: padded output groups
     ],
 )
 def test_conv_rectify_pool_pallas_matches_reference(
@@ -227,3 +229,60 @@ def test_conv_fused_stage_ineligible_fallback_reconstructs_hwio(monkeypatch):
         )
     )
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_conv_canary_demotes_compile_failures(monkeypatch):
+    """A kernel geometry whose COMPILE fails (the class a trace-time
+    try/except inside an outer jit cannot see) must be demoted to the
+    XLA path by the eager per-geometry canary — retried once (transient
+    device blips must not demote a geometry forever), then cached as a
+    permanent verdict."""
+    import keystone_tpu.ops.pallas_kernels as pk
+
+    rng = np.random.default_rng(5)
+    imgs = jnp.asarray(rng.random(size=(3, 16, 16, 3)).astype(np.float32))
+    kern = jnp.asarray(rng.normal(size=(5, 5, 3, 8)).astype(np.float32))
+    colsum = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+    bias = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("Mosaic scoped-vmem OOM (simulated)")
+
+    monkeypatch.setattr(pk, "use_fused_conv", lambda: True)
+    monkeypatch.setattr(pk, "conv_rectify_pool_pallas", boom)
+    monkeypatch.setattr(pk, "_fused_conv_canary", {})
+
+    want = np.asarray(pk.conv_rectify_pool_reference(
+        imgs, kern, colsum, bias, 0.1, 0.0, 5, 4, True))
+    # call 1: attempt; call 2: retry-once; call 3: cached permanent False
+    for _ in range(3):
+        got = np.asarray(pk.conv_rectify_pool(
+            imgs, kern, colsum, bias, 0.1, 0.0, 5, 4, True))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert calls["n"] == 2, calls["n"]
+
+    # a transient failure then a recovery: second attempt enables the path
+    pk._fused_conv_canary.clear()
+    calls["n"] = 0
+    real_pallas = [boom]
+
+    def flaky(*a, **kw):
+        fn, real_pallas[0] = real_pallas[0], ok_pallas
+        return fn(*a, **kw)
+
+    def ok_pallas(*a, **kw):
+        calls["n"] += 1
+        return jnp.asarray(want)
+
+    monkeypatch.setattr(pk, "conv_rectify_pool_pallas", flaky)
+    got = np.asarray(pk.conv_rectify_pool(
+        imgs, kern, colsum, bias, 0.1, 0.0, 5, 4, True))  # canary fails
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    got = np.asarray(pk.conv_rectify_pool(
+        imgs, kern, colsum, bias, 0.1, 0.0, 5, 4, True))  # retry passes
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert pk._fused_conv_canary and list(
+        pk._fused_conv_canary.values()) == [True]
