@@ -397,42 +397,46 @@ def conv_rectify_pool(
 
 
 def _pool_matrix(pos_h: int, pos_w: int, posp: int,
-                 pool: int, stride: int) -> "np.ndarray":
-    """(cells, posp) 0/1 sum-pool weights over the flattened
-    (i·pos_w + j) position index of ONE image. The kernel applies it
-    per image — a block-diagonal (b·cells, b·posp) form would make the
-    pool GEMM's FLOPs scale with b² (measured: at the CIFAR geometry it
-    out-FLOPed the conv GEMM ~3× at f32-HIGHEST). The kernel pads each
-    image's output group to cells_p = round_up(cells, 8) rows so the
-    dynamic stores stay tile-aligned; the ~2× pooled-write + strip-slice
-    cost that implies is accepted — pooled traffic is ~20× smaller than
-    the patch feed."""
+                 pool: int, stride: int, g: int) -> "np.ndarray":
+    """(R, g·posp) 0/1 sum-pool weights for ONE kernel loop iteration
+    (g images, R = round_up(g·cells, 8)): block-diagonal over the g
+    images, each block the (cells, posp) weights over that image's
+    flattened (i·pos_w + j) position index. Applying it per small group
+    instead of per full image-block keeps the pool GEMM's FLOPs linear
+    in the block size — the whole-block block-diagonal form scaled them
+    with b² (at the CIFAR geometry it out-FLOPed the conv GEMM ~3× at
+    f32-HIGHEST) — while 8-row grouping keeps the dot and the store
+    full-tile (a previous per-image variant with 4-row dots measured
+    SLOWER than the b² form; module docstring history)."""
     import numpy as np
 
     gy = (pos_h - pool) // stride + 1
     gx = (pos_w - pool) // stride + 1
     cells = gy * gx
-    M = np.zeros((cells, posp), np.float32)
-    for iy in range(gy):
-        for ix in range(gx):
-            r = iy * gx + ix
-            for i in range(iy * stride, iy * stride + pool):
-                for j in range(ix * stride, ix * stride + pool):
-                    M[r, i * pos_w + j] = 1.0
+    M = np.zeros((_round_up(g * cells, 8), g * posp), np.float32)
+    for im in range(g):
+        for iy in range(gy):
+            for ix in range(gx):
+                r = im * cells + iy * gx + ix
+                for i in range(iy * stride, iy * stride + pool):
+                    for j in range(ix * stride, ix * stride + pool):
+                        M[r, im * posp + i * pos_w + j] = 1.0
     return M
 
 
 def _conv_rect_pool_kernel(
     pat_ref, g_ref, pmat_ref, colsum_ref, bias_ref, o_ref,
-    *, alpha, max_val, d_real, k, normalize, b, posp, cells_p,
+    *, alpha, max_val, d_real, k, normalize, b, posp, grp, rows,
 ):
     g = g_ref[:]                                       # (dp, k) bf16
-    pm = pmat_ref[:]                                   # (cells_p, posp) 0/1
+    pm = pmat_ref[:]                                   # (rows, grp·posp)
     cs = colsum_ref[:]
     bs = bias_ref[:]
 
-    def body(im, carry):
-        pat = pat_ref[pl.ds(im * posp, posp), :]       # (posp, dp) bf16
+    def body(i, carry):
+        # one iteration = one group of `grp` images (one 8-row output
+        # tile when cells divides 8 — see _fused_conv_geometry)
+        pat = pat_ref[pl.ds(i * grp * posp, grp * posp), :]  # bf16
         # precision pinned DEFAULT: bf16 operands under an ambient
         # default_matmul_precision("highest") context would ask Mosaic
         # for an fp32-contract bf16 matmul, which it rejects ("Bad lhs
@@ -447,56 +451,83 @@ def _conv_rect_pool_kernel(
         # HIGHEST: the rectified activations would otherwise be
         # truncated to bf16 by the pool GEMM, a second rounding on top
         # of the documented bf16 patch feed; the 0/1 pm operand is
-        # exact either way. Both stores are tile-aligned: posp % 8 == 0
-        # and the per-image output group is padded to cells_p rows.
+        # exact either way. Both the load and the store are
+        # tile-aligned: posp % 16 == 0 and rows % 8 == 0.
         act = jnp.concatenate(
             [jnp.maximum(max_val, out - alpha),
              jnp.maximum(max_val, -out - alpha)],
             axis=1,
         )
-        o_ref[pl.ds(im * cells_p, cells_p), :] = jnp.dot(
+        o_ref[pl.ds(i * rows, rows), :] = jnp.dot(
             pm, act, preferred_element_type=jnp.float32,
             precision=lax.Precision.HIGHEST)
         return carry
 
-    # a SEQUENTIAL loop on purpose: per-image z/act transients are the
+    # a SEQUENTIAL loop on purpose: per-group z/act transients are the
     # VMEM hogs, and fori_loop guarantees only one iteration's worth is
     # live — the block chooser's budget is structural, not a scheduling
     # guess (a Python-unrolled loop would let Mosaic keep several
-    # images' transients in flight)
-    lax.fori_loop(0, b, body, 0)
+    # groups' transients in flight)
+    lax.fori_loop(0, b // grp, body, 0)
+
+
+def _fused_conv_geometry(posp: int, dp: int, k: int,
+                         cells: int) -> "tuple[int, int, int]":
+    """(b, g, R): image block, images per kernel loop iteration, and
+    output rows per iteration, chosen so the working set fits ~10 MB of
+    VMEM. Groups are tried largest-first — g images per iteration share
+    one pool dot/store whose 8-row tiles are fully used when g·cells is
+    a multiple of 8 — and halved when a group's z/act transients (which
+    scale with g) blow the budget, down to one image per iteration.
+    b is always a multiple of g so the kernel's loop covers the block
+    exactly; R is a multiple of 8 so stores stay tile-aligned."""
+    if cells <= 0:  # pool window larger than the conv-position grid:
+        # no pooled output exists; plainly ineligible, not a crash
+        return 0, 1, 8
+    kp = -(-k // 128) * 128
+    k2p = -(-(2 * k) // 128) * 128
+    g = 8 // cells if 8 % cells == 0 else 1
+    while g >= 1:
+        if g > 1 and (g * cells) % 8 != 0:
+            # only TIGHT multi-image groups (or g=1): a padded group of
+            # several images would interleave zero rows between groups,
+            # breaking the per-image output reshape below
+            g //= 2
+            continue
+        R = _round_up(g * cells, 8)
+        best = 0
+        cand = g
+        while cand <= 32:
+            # Mosaic pads the lane (minor) dimension to 128: every
+            # (rows, k) f32 buffer really occupies
+            # (rows, round_up(k, 128)) of VMEM — ignoring it produced a
+            # real scoped-vmem OOM at k=16 (21.5 MB actual vs 8.9 MB
+            # estimated). The conv/rectify intermediates (z, act) are
+            # ONE group's worth by construction (sequential fori_loop
+            # in the kernel), so they don't scale with the block; the
+            # 10 MB cap of the 16 MB VMEM absorbs scheduling slop.
+            bytes_needed = (
+                2 * cand * posp * dp * 2         # patches, dbl-buf bf16
+                + g * posp * kp * 4              # z (one group, f32)
+                + g * posp * k2p * 4             # act = both signs
+                + 2 * (cand // g) * R * k2p * 4  # pooled out, dbl-buf
+                + R * g * posp * 4               # group pool matrix
+                + dp * kp * 2
+            )
+            if bytes_needed > 10 * (1 << 20):
+                break
+            best = cand
+            cand += g
+        if best > 0:
+            return best, g, R
+        g //= 2
+    return 0, 1, _round_up(cells, 8)
 
 
 def _fused_conv_block_images(posp: int, dp: int, k: int, cells: int) -> int:
-    """Largest block of images whose kernel working set fits ~10 MB of
-    VMEM; the output row count (b·cells_p) is always a multiple of 8
-    because cells_p is."""
-    kp = -(-k // 128) * 128
-    k2p = -(-(2 * k) // 128) * 128
-    cells_p = -(-cells // 8) * 8
-    best = 0
-    cand = 2
-    while cand <= 32:
-        # Mosaic pads the lane (minor) dimension to 128: every (rows, k)
-        # f32 buffer really occupies (rows, round_up(k, 128)) of VMEM —
-        # ignoring it produced a real scoped-vmem OOM at k=16 (21.5 MB
-        # actual vs 8.9 MB estimated). The conv/rectify intermediates
-        # (z, act) are ONE image's worth by construction (sequential
-        # fori_loop in the kernel), so they don't scale with the block;
-        # the 10 MB cap of the 16 MB VMEM absorbs scheduling slop.
-        bytes_needed = (
-            2 * cand * posp * dp * 2        # patches, double-buffered bf16
-            + posp * kp * 4                 # z (one image, f32)
-            + posp * k2p * 4                # act = both rectified signs
-            + 2 * cand * cells_p * k2p * 4  # pooled out, double-buffered
-            + cells_p * posp * 4            # one-image pool matrix
-            + dp * kp * 2
-        )
-        if bytes_needed > 10 * (1 << 20):
-            break
-        best = cand
-        cand += 2
-    return best
+    """Largest eligible image block (0 = the geometry cannot fit VMEM);
+    see `_fused_conv_geometry`."""
+    return _fused_conv_geometry(posp, dp, k, cells)[0]
 
 
 def conv_rectify_pool_pallas(
@@ -514,15 +545,15 @@ def conv_rectify_pool_pallas(
     k = G_cmajor.shape[1]
     pos_h, pos_w = h - patch + 1, w - patch + 1
     npos = pos_h * pos_w
-    # 16, not 8: the kernel takes per-image DYNAMIC row slices of the
-    # bf16 patches ref at offsets im*posp, and the bf16 tile is (16,128)
+    # 16, not 8: the kernel takes per-group DYNAMIC row slices of the
+    # bf16 patches ref at offsets i·g·posp, and the bf16 tile is (16,128)
     posp = _round_up(npos, 16)
     dp = _round_up(d, 128)
     gy = (pos_h - pool) // stride + 1
     gx = (pos_w - pool) // stride + 1
     cells = gy * gx
 
-    b = _fused_conv_block_images(posp, dp, k, cells)
+    b, g_img, rows = _fused_conv_geometry(posp, dp, k, cells)
     if b == 0:
         raise FusedConvIneligibleError("fused conv block does not fit VMEM")
     n_pad = _round_up(n, b)
@@ -534,12 +565,10 @@ def conv_rectify_pool_pallas(
     pat = jnp.pad(pat, ((0, n_pad - n), (0, posp - npos), (0, dp - d)))
     pat = pat.reshape(n_pad * posp, dp).astype(jnp.bfloat16)
 
-    cells_p = _round_up(cells, 8)
+    r_img = rows // g_img  # output rows per image (== cells when tight;
+    # padded groups are g=1 only, so this stays exact)
     Gp = jnp.pad(G_cmajor, ((0, dp - d), (0, 0))).astype(jnp.bfloat16)
-    pm = _pool_matrix(pos_h, pos_w, posp, pool, stride)
-    import numpy as np
-
-    pmat = jnp.asarray(np.pad(pm, ((0, cells_p - cells), (0, 0))))
+    pmat = jnp.asarray(_pool_matrix(pos_h, pos_w, posp, pool, stride, g_img))
     cs = jnp.asarray(colsum, jnp.float32).reshape(1, k)
     bs = jnp.asarray(bias, jnp.float32).reshape(1, k)
 
@@ -549,23 +578,24 @@ def conv_rectify_pool_pallas(
             _conv_rect_pool_kernel,
             alpha=float(alpha), max_val=float(max_val),
             d_real=d, k=k, normalize=normalize, b=b, posp=posp,
-            cells_p=cells_p,
+            grp=g_img, rows=rows,
         ),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((b * posp, dp), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((dp, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((cells_p, posp), lambda i: (0, 0),
+            pl.BlockSpec((rows, g_img * posp), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((b * cells_p, 2 * k), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((b * r_img, 2 * k), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((grid * b * cells_p, 2 * k),
+        out_shape=jax.ShapeDtypeStruct((grid * b * r_img, 2 * k),
                                        jnp.float32),
         interpret=interpret,
     )(pat, Gp, pmat, cs, bs)
-    return (out.reshape(n_pad, cells_p, 2 * k)[:n, :cells]
+    # tight grouping: r_img == cells and the slice below is a no-op
+    return (out.reshape(n_pad, r_img, 2 * k)[:n, :cells]
             .reshape(n, gy, gx, 2 * k))

@@ -122,6 +122,8 @@ def test_krr_still_learns_with_static_gamma():
         (2, 20, 14, 2, 3, 8, 5, 4, True),      # rectangular
         (3, 16, 16, 1, 2, 8, 5, 5, False),     # npos=225: 16-alignment
         # padding of the patch rows; cells=9 > 8: padded output groups
+        (5, 12, 12, 1, 3, 8, 10, 10, True),    # cells=1: g=8 grouping
+        (3, 12, 10, 2, 3, 8, 8, 2, False),     # cells=2 (1x2): g=4
     ],
 )
 def test_conv_rectify_pool_pallas_matches_reference(
@@ -214,8 +216,8 @@ def test_conv_fused_stage_ineligible_fallback_reconstructs_hwio(monkeypatch):
         "keystone_tpu.ops.pallas_kernels.use_fused_conv", lambda: True
     )
     monkeypatch.setattr(
-        "keystone_tpu.ops.pallas_kernels._fused_conv_block_images",
-        lambda *a, **k: 0,
+        "keystone_tpu.ops.pallas_kernels._fused_conv_geometry",
+        lambda *a, **k: (0, 1, 8),
     )
     key, params, fn = stage.fuse()
     assert key[-1] is True  # fused flag baked into the program key
