@@ -1,20 +1,37 @@
-"""Persistence that converts device arrays to host numpy.
+"""Persistence for fitted pipelines, two backends.
 
 Used by `FittedPipeline.save/load` (reference FittedPipeline.scala:18-48
 uses Java serialization; here cloudpickle handles closures and
 locally-defined transformer classes — the common pattern of estimators
-returning transformers built inside ``fit`` — and device-resident
-`jax.Array` leaves are rewritten to numpy so artifacts are portable across
-hosts/topologies; `jnp` ops accept numpy inputs transparently on load).
+returning transformers built inside ``fit``).
+
+1. **Pickle** (default, single file): device-resident `jax.Array`
+   leaves are rewritten to host numpy so artifacts are portable across
+   hosts/topologies; `jnp` ops accept numpy inputs transparently on
+   load. The conversion GATHERS every array through the saving host —
+   fine single-host, wrong for pod-sharded models.
+2. **Orbax** (directory): the object's Python skeleton is cloudpickled
+   with each `jax.Array` swapped for an index placeholder, and the
+   arrays themselves are checkpointed with `orbax.checkpoint` — each
+   host writes only its addressable shards (the TPU-native multi-host
+   path: no all-gather through one host), and sharding metadata rides
+   along in the checkpoint. In a multi-process job every process must
+   call save/load collectively (orbax coordinates the barrier);
+   process 0 writes the skeleton.
 """
 
 from __future__ import annotations
 
+import contextvars
+import os
 from typing import Any
 
 import cloudpickle
 import jax
 import numpy as np
+
+_SKELETON = "skeleton.pkl"
+_ARRAYS = "arrays"
 
 
 class _DeviceAwarePickler(cloudpickle.CloudPickler):
@@ -34,3 +51,143 @@ def load_pytree_pickle(path: str) -> Any:
 
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+# ------------------------------------------------------------------ orbax
+
+_restore_arrays: contextvars.ContextVar = contextvars.ContextVar(
+    "keystone_orbax_restore_arrays")
+
+_FORMAT = "keystone-orbax-v1"
+_ID_FILE = "arrays_id.txt"
+
+
+def _resolve_array(idx: int):
+    try:
+        arrays = _restore_arrays.get()
+    except LookupError:
+        raise RuntimeError(
+            "this pickle contains orbax array placeholders; load it via "
+            "load_pytree_orbax(directory), not pickle.load") from None
+    if idx >= len(arrays):
+        raise RuntimeError(
+            f"corrupt orbax artifact: skeleton references array {idx} but "
+            f"only {len(arrays)} were restored from the checkpoint")
+    return arrays[idx]
+
+
+class _ArrayExtractingPickler(cloudpickle.CloudPickler):
+    """Swaps every jax.Array for an index placeholder, collecting the
+    arrays (in first-seen order) into ``self.arrays`` for orbax."""
+
+    def __init__(self, file, arrays: list):
+        super().__init__(file, protocol=5)
+        self.arrays = arrays
+
+    def reducer_override(self, obj):
+        if isinstance(obj, jax.Array):
+            self.arrays.append(obj)
+            return (_resolve_array, (len(self.arrays) - 1,))
+        return super().reducer_override(obj)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def save_pytree_orbax(obj: Any, path: str) -> None:
+    """Save ``obj`` under directory ``path``: cloudpickled skeleton +
+    orbax array checkpoint (per-host shard writes; see module doc).
+
+    Torn-write safety: the skeleton carries a fresh artifact id and the
+    array count; the id is mirrored to a sidecar file written LAST
+    (atomically). A crash anywhere in between leaves either the previous
+    consistent artifact (atomic skeleton replace) or a skeleton whose id
+    the sidecar doesn't match — which `load_pytree_orbax` rejects loudly
+    instead of silently binding a stale model's weights."""
+    import io
+    import pickle
+    import uuid
+
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    buf = io.BytesIO()
+    arrays: list = []
+    _ArrayExtractingPickler(buf, arrays).dump(obj)
+    artifact_id = uuid.uuid4().hex
+    if jax.process_index() == 0:
+        # skeleton first: orbax's collective save below is the barrier
+        # that keeps non-zero processes from returning (and loading)
+        # before the skeleton is durably in place
+        _atomic_write(os.path.join(path, _SKELETON), pickle.dumps({
+            "format": _FORMAT,
+            "artifact_id": artifact_id,
+            "n_arrays": len(arrays),
+            "payload": buf.getvalue(),
+        }, protocol=5))
+    if arrays:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(path, _ARRAYS), arrays, force=True)
+        if jax.process_index() == 0:
+            _atomic_write(os.path.join(path, _ID_FILE),
+                          artifact_id.encode())
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("keystone_orbax_save_done")
+
+
+def load_pytree_orbax(path: str) -> Any:
+    """Load an object saved by `save_pytree_orbax`. Arrays are restored
+    by orbax (sharding metadata from the checkpoint; restoring onto a
+    different topology falls back to default placement)."""
+    import pickle
+
+    path = os.path.abspath(path)
+    with open(os.path.join(path, _SKELETON), "rb") as f:
+        wrapper = pickle.load(f)
+    if not (isinstance(wrapper, dict) and wrapper.get("format") == _FORMAT):
+        raise RuntimeError(
+            f"{path} is not a {_FORMAT} artifact (corrupt or foreign "
+            "skeleton.pkl)")
+    arrays: list = []
+    if wrapper["n_arrays"]:
+        arrays_dir = os.path.join(path, _ARRAYS)
+        if not os.path.isdir(arrays_dir):
+            raise RuntimeError(
+                f"corrupt orbax artifact {path}: the skeleton references "
+                f"{wrapper['n_arrays']} arrays but the '{_ARRAYS}/' "
+                "checkpoint directory is missing (partial copy?)")
+        try:
+            with open(os.path.join(path, _ID_FILE)) as f:
+                sidecar_id = f.read().strip()
+        except FileNotFoundError:
+            sidecar_id = None
+        if sidecar_id != wrapper["artifact_id"]:
+            raise RuntimeError(
+                f"torn orbax artifact {path}: skeleton id "
+                f"{wrapper['artifact_id']} does not match the array "
+                f"checkpoint id {sidecar_id!r} (interrupted save?)")
+        import orbax.checkpoint as ocp
+
+        arrays = ocp.PyTreeCheckpointer().restore(arrays_dir)
+        if len(arrays) != wrapper["n_arrays"]:
+            raise RuntimeError(
+                f"corrupt orbax artifact {path}: expected "
+                f"{wrapper['n_arrays']} arrays, checkpoint holds "
+                f"{len(arrays)}")
+    token = _restore_arrays.set(arrays)
+    try:
+        return pickle.loads(wrapper["payload"])
+    finally:
+        _restore_arrays.reset(token)
+
+
+def is_orbax_artifact(path: str) -> bool:
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, _SKELETON))

@@ -262,19 +262,39 @@ class FittedPipeline(Chainable):
 
     # -------------------------------------------------------- persistence
 
-    def save(self, path: str) -> None:
-        """Serialize to disk. Device arrays are converted to host numpy so
-        the artifact is portable (FittedPipeline.scala:10 'may be written
-        to and from disk')."""
-        from ..utils.serialization import save_pytree_pickle
+    def save(self, path: str, format: str = "pickle") -> None:
+        """Serialize to disk (FittedPipeline.scala:10 'may be written to
+        and from disk').
 
-        save_pytree_pickle(self, path)
+        format="pickle" (default): one file; device arrays are gathered
+        to host numpy so the artifact is portable across topologies.
+        format="orbax": a directory; arrays are checkpointed with orbax
+        so each host writes only its addressable shards — the multi-host
+        path for pod-sharded models (call collectively from every
+        process in a multi-process job)."""
+        if format == "orbax":
+            from ..utils.serialization import save_pytree_orbax
+
+            save_pytree_orbax(self, path)
+        elif format == "pickle":
+            from ..utils.serialization import save_pytree_pickle
+
+            save_pytree_pickle(self, path)
+        else:
+            raise ValueError(f"unknown save format {format!r}")
 
     @staticmethod
     def load(path: str) -> "FittedPipeline":
-        from ..utils.serialization import load_pytree_pickle
+        """Load either artifact format (auto-detected: an orbax artifact
+        is a directory with a skeleton)."""
+        from ..utils.serialization import (
+            is_orbax_artifact,
+            load_pytree_orbax,
+            load_pytree_pickle,
+        )
 
-        obj = load_pytree_pickle(path)
+        obj = (load_pytree_orbax(path) if is_orbax_artifact(path)
+               else load_pytree_pickle(path))
         if not isinstance(obj, FittedPipeline):
             raise TypeError(f"{path} does not contain a FittedPipeline")
         return obj
