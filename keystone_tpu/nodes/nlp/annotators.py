@@ -196,14 +196,81 @@ _LEMMA_EXCEPTIONS = {
     "flew": "fly", "flown": "fly", "drove": "drive", "driven": "drive",
     "ate": "eat", "eaten": "eat", "began": "begin", "begun": "begin",
     "dying": "die", "lying": "lie", "tying": "tie",
+    "taught": "teach", "caught": "catch", "slept": "sleep",
+    "crept": "creep", "swept": "sweep", "wept": "weep",
+    "fed": "feed", "led": "lead", "bled": "bleed",
+    "fought": "fight", "sought": "seek", "won": "win", "spun": "spin",
+    "dug": "dig", "hung": "hang", "stuck": "stick", "struck": "strike",
+    "spent": "spend", "lent": "lend", "bent": "bend", "meant": "mean",
+    "dealt": "deal", "sang": "sing", "sung": "sing", "rang": "ring",
+    "rung": "ring", "swam": "swim", "swum": "swim",
+    "wore": "wear", "worn": "wear", "tore": "tear", "torn": "tear",
+    "threw": "throw", "thrown": "throw", "woke": "wake",
+    "woken": "wake", "rose": "rise", "risen": "rise",
+    "beaten": "beat", "bit": "bite", "bitten": "bite",
+    "hid": "hide", "hidden": "hide", "shook": "shake",
+    "shaken": "shake", "sold": "sell", "bound": "bind",
+    "wound": "wind", "understood": "understand", "forgot": "forget",
+    "forgotten": "forget", "became": "become", "laid": "lay",
+    "lit": "light", "shot": "shoot", "slid": "slide",
     # irregular nouns
     "children": "child", "men": "man", "women": "woman",
     "people": "person", "mice": "mouse", "feet": "foot",
     "teeth": "tooth", "geese": "goose", "oxen": "ox", "lives": "life",
     "wives": "wife", "knives": "knife", "leaves": "leaf",
     "wolves": "wolf", "halves": "half", "shelves": "shelf",
-    # irregular comparatives
+    # comparatives/superlatives: -er/-est stripping is unsafe as a rule
+    # (number, water, interest...), so the frequent ones are closed-form
+    # like Morpha/WordNet's dictionary-checked er-strip
     "better": "good", "best": "good", "worse": "bad", "worst": "bad",
+    "bigger": "big", "biggest": "big", "larger": "large",
+    "largest": "large", "smaller": "small", "smallest": "small",
+    "greater": "great", "greatest": "great", "higher": "high",
+    "highest": "high", "lower": "low", "lowest": "low",
+    "older": "old", "oldest": "old", "younger": "young",
+    "youngest": "young", "stronger": "strong", "strongest": "strong",
+    "longer": "long", "longest": "long", "shorter": "short",
+    "shortest": "short", "faster": "fast", "fastest": "fast",
+    "slower": "slow", "slowest": "slow", "earlier": "early",
+    "earliest": "early", "later": "late", "latest": "late",
+    "newer": "new", "newest": "new", "closer": "close",
+    "closest": "close", "easier": "easy", "easiest": "easy",
+    "happier": "happy", "happiest": "happy", "wider": "wide",
+    "widest": "wide", "deeper": "deep", "deepest": "deep",
+    # -che nouns the -ches rule would truncate; latinate -ices plurals;
+    # -us plurals (not spelling-separable from the -use verb class:
+    # buses vs houses/excuses — the -use default wins, these are closed)
+    "caches": "cache", "aches": "ache", "niches": "niche",
+    "matrices": "matrix", "indices": "index", "vertices": "vertex",
+    "appendices": "appendix",
+    # -oes plurals (not separable from the -oe class: heroes vs
+    # shoes/toes); greek/latin plurals; invariant -s closed class
+    "heroes": "hero", "potatoes": "potato", "tomatoes": "tomato",
+    "echoes": "echo",
+    "data": "datum", "criteria": "criterion",
+    "phenomena": "phenomenon", "axes": "axis",
+    "analyses": "analysis", "hypotheses": "hypothesis",
+    "theses": "thesis", "crises": "crisis",
+    "alumni": "alumnus", "fungi": "fungus",
+    "nuclei": "nucleus", "stimuli": "stimulus",
+    "lens": "lens", "physics": "physics",
+    "mathematics": "mathematics", "economics": "economics",
+    "politics": "politics", "statistics": "statistics",
+    "always": "always", "perhaps": "perhaps",
+    "whereas": "whereas", "besides": "besides",
+    "sometimes": "sometimes",
+    "buses": "bus", "viruses": "virus", "focuses": "focus",
+    "lenses": "lens", "gases": "gas", "buzzes": "buzz",
+    "fizzes": "fizz", "quizzes": "quiz",
+    "focused": "focus", "focusing": "focus",
+    "bonuses": "bonus", "statuses": "status", "campuses": "campus",
+    "geniuses": "genius", "censuses": "census", "surpluses": "surplus",
+    # frequent forms whose stem spelling hides the lemma
+    "used": "use", "using": "use", "heard": "hear",
+    "changed": "change", "changing": "change",
+    "arranged": "arrange", "arranging": "arrange",
+    "challenged": "challenge", "challenging": "challenge",
+    "created": "create", "creating": "create",
     # invariant -s words that the -s rule would mangle
     "this": "this", "its": "its", "news": "news", "series": "series",
     "species": "species", "analysis": "analysis", "basis": "basis",
@@ -238,15 +305,35 @@ _NO_E_STEMS = {
 }
 
 
+# Inherent double-consonant stems: the un-doubling rule (running ->
+# run) must not fire for stems whose double letter is part of the word
+# (telling -> tell, not tel). Gemination vs inherent doubling is a
+# stress fact, not a spelling fact, so this is a closed set over the
+# frequent cases — the DEFAULT un-doubles, right for the productive
+# CVC-gemination class (stopped, planned, hitting, ...).
+_KEEP_DOUBLE = {
+    "tell", "call", "fall", "sell", "roll", "toll", "kill", "fill",
+    "bill", "smell", "spell", "swell", "yell", "drill", "chill",
+    "thrill", "spill", "skill", "pull", "poll", "miss",
+    "pass", "press", "kiss", "toss", "guess", "dress", "cross",
+    "discuss", "express", "address", "add", "stuff", "staff", "stress",
+    "fuss", "buzz", "fizz", "err", "purr",
+}
+
+
 def _restore_e(stem: str) -> str:
     """mak -> make, invit -> invite: consonant-vowel-consonant stems
     whose final consonant isn't doubled usually dropped a silent e;
     `_NO_E_STEMS` lists the frequent unstressed-final-syllable verbs
-    that didn't. Stems ending in v/z (believ, siz) virtually always
-    take the e back."""
+    that didn't. Stems ending in v/z (believ, serv, siz) virtually
+    always take the e back — no English word ends in bare v — as do the
+    soft-consonant clusters -nc/-rc/-rg (danc -> dance, forc -> force,
+    charg -> charge, judg -> judge)."""
     if stem in _NO_E_STEMS:
         return stem
-    if len(stem) >= 3 and stem[-1] in "vz" and stem[-2] in _VOWELS:
+    if len(stem) >= 3 and stem[-1] in "vz":
+        return stem + "e"
+    if len(stem) >= 3 and stem.endswith(("nc", "rc", "rg", "dg")):
         return stem + "e"
     if (
         len(stem) >= 3
@@ -282,6 +369,8 @@ def _lemma(token: str) -> str:
         if low.endswith(suf) and len(low) - len(suf) >= 3:
             stem = low[: -len(suf)]
             if len(stem) >= 3 and stem[-1] == stem[-2] and stem[-1] not in _VOWELS:
+                if stem in _KEEP_DOUBLE:
+                    return stem                     # telling -> tell
                 return stem[:-1]                    # running -> run
             if stem.endswith("i"):
                 return stem[:-1] + "y"              # studied -> study
