@@ -30,6 +30,9 @@ def test_two_process_job_dataset_and_solver():
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets device count via jax.config
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # hermetic: never register the
+    # axon PJRT plugin in CPU-only workers — backend discovery through a
+    # wedged device tunnel hangs the worker past the reap deadline
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
