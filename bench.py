@@ -170,6 +170,26 @@ def emit(record):
     print(json.dumps(record), flush=True)
 
 
+# Child checkpoints ranked by completeness: a later-tier partial must
+# never lose to an earlier-tier one across retry attempts (the fused
+# tier's big cold compile runs last precisely so a wedge there leaves a
+# krr_tier-ranked checkpoint holding every measured tier).
+PROGRESS_RANK = {"headline": 1, "staged": 2, "flagship": 3,
+                 "featurize_tier": 4, "krr_tier": 5, "complete": 6}
+
+
+def progress_rank(detail) -> int:
+    return PROGRESS_RANK.get(detail.get("progress", "complete"), 0)
+
+
+def pick_better_partial(best, detail):
+    """The detail to keep across attempts: the latest of the
+    highest-ranked checkpoints (ties go to the newer attempt)."""
+    if best is None or progress_rank(detail) >= progress_rank(best):
+        return detail
+    return best
+
+
 def result_record(detail, extra=None):
     imgs_per_sec = detail["images_per_sec"]
     rec = {
@@ -265,8 +285,6 @@ def main():
     t_start = time.monotonic()
     error = None
     best = None  # best LIVE (possibly partial) detail seen this window
-    progress_rank = {"headline": 1, "staged": 2, "flagship": 3,
-                     "featurize_tier": 4, "krr_tier": 5, "complete": 6}
     for attempt in range(1, args.attempts + 1):
         remaining = args.deadline - (time.monotonic() - t_start)
         if remaining <= args.liveness_timeout:
@@ -291,11 +309,8 @@ def main():
                               + bad_dir.get("reason", "missing CIFAR batches")))
             return 2
         if detail is not None:
-            rank = progress_rank.get(detail.get("progress", "complete"), 0)
-            if best is None or rank >= progress_rank.get(
-                    best.get("progress", "complete"), 0):
-                best = detail
-            if rank >= progress_rank["complete"]:
+            best = pick_better_partial(best, detail)
+            if progress_rank(detail) >= PROGRESS_RANK["complete"]:
                 rec, persist = finalize_record(detail)
                 if persist:
                     try:

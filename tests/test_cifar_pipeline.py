@@ -216,10 +216,9 @@ def test_fused_conv_vmem_accounting_lane_padding():
     assert b256 == 14, b256
 
 
-def test_bench_band_gate():
-    """bench.py's record gate: out-of-band accuracy is marked as an
-    error and never persists as the stale-fallback record; in-band TPU
-    runs persist; CPU runs never persist."""
+def _load_bench():
+    """Import bench.py as a module (it lives at the repo root, outside
+    the package)."""
     import importlib.util
     import os
 
@@ -229,6 +228,14 @@ def test_bench_band_gate():
     )
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    return bench
+
+
+def test_bench_band_gate():
+    """bench.py's record gate: out-of-band accuracy is marked as an
+    error and never persists as the stale-fallback record; in-band TPU
+    runs persist; CPU runs never persist."""
+    bench = _load_bench()
 
     base = {"images_per_sec": 1000.0, "test_accuracy": 0.85,
             "accuracy_band": [0.72, 0.96], "platform": "tpu"}
@@ -260,3 +267,35 @@ def test_bench_band_gate():
         dict(real, test_accuracy=0.9, accuracy_in_band=True,
              north_star={"target_accuracy": 0.84, "accuracy_ok": True}))
     assert persist and "error" not in rec
+
+
+def test_bench_partial_record_ranking():
+    """The parent's best-partial selection across retry attempts: a
+    later-tier checkpoint (e.g. krr_tier, everything measured except the
+    fused tier) must beat an earlier-tier one from another attempt, ties
+    go to the newer attempt, and unknown progress values rank lowest."""
+    bench = _load_bench()
+
+    d_head = {"progress": "headline", "attempt": 1}
+    d_krr = {"progress": "krr_tier", "attempt": 2}
+    d_head2 = {"progress": "headline", "attempt": 3}
+    d_unknown = {"progress": "someday_tier", "attempt": 4}
+
+    best = bench.pick_better_partial(None, d_head)
+    assert best is d_head
+    best = bench.pick_better_partial(best, d_krr)
+    assert best is d_krr
+    # an earlier-tier checkpoint from a later attempt must NOT displace it
+    best = bench.pick_better_partial(best, d_head2)
+    assert best is d_krr
+    # unknown progress ranks 0 and never displaces a ranked one
+    best = bench.pick_better_partial(best, d_unknown)
+    assert best is d_krr
+    # same-tier tie goes to the newer attempt
+    d_krr2 = {"progress": "krr_tier", "attempt": 5}
+    assert bench.pick_better_partial(d_krr, d_krr2) is d_krr2
+    # every tier the child emits is ranked (completeness ordering)
+    emitted = ["headline", "staged", "flagship", "featurize_tier",
+               "krr_tier", "complete"]
+    ranks = [bench.PROGRESS_RANK[p] for p in emitted]
+    assert ranks == sorted(ranks) and len(set(ranks)) == len(ranks)
