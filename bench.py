@@ -209,7 +209,11 @@ def finalize_record(detail):
 
     An out-of-band accuracy (solver-quality regression on the calibrated
     task) is emitted loudly marked with "error" and must NEVER become
-    the stale-fallback record; CPU runs never persist either."""
+    the stale-fallback record; CPU runs never persist either. A record
+    whose tier payloads carry {"error": ...} (failure-isolated tiers,
+    child_main) surfaces them top-level and does not persist — a
+    deterministically broken tier must not silently poison the fallback
+    record while monitoring reads a clean exit."""
     rec = result_record(detail)
     if not detail.get("accuracy_in_band", True):
         band = detail.get("accuracy_band") or [None]
@@ -219,6 +223,12 @@ def finalize_record(detail):
             f"test_accuracy {detail.get('test_accuracy')} below "
             f"{'calibrated lower bound' if detail.get('synthetic', True) else 'north-star target'} "
             f"{bound}")
+        return rec, False
+    tier_errors = {k: v["error"] for k, v in detail.items()
+                   if isinstance(v, dict) and "error" in v}
+    if tier_errors:
+        rec["error"] = "tier failures: " + "; ".join(
+            f"{k}: {e}" for k, e in sorted(tier_errors.items()))
         return rec, False
     return rec, detail.get("platform") != "cpu"
 
@@ -789,40 +799,56 @@ def child_main(args):
     })
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
-    flagship = None
-    if not args.skip_flagship:
-        phase("flagship_solver")
-        flagship = _flagship_bcd(
+    def run_tier(start_phase, done_phase, seconds_key, fn):
+        """Failure-isolated tier: a tier that raises records
+        {"error": ...} instead of killing the child and losing every
+        later tier's measurement (finalize_record surfaces tier errors
+        top-level and refuses to persist such a record)."""
+        phase(start_phase)
+        try:
+            res = fn()
+        except Exception as e:
+            res = {"error": f"{type(e).__name__}: {e}"}
+        phase(done_phase, seconds=res.get(seconds_key, "error"))
+        return res
+
+    def flagship_fn():
+        res = _flagship_bcd(
             n=args.flagship_n, d=args.flagship_d, k=args.flagship_k,
             block=4096, iters=3,
         )
         # honest f32 ceiling: the solver pins HIGHEST matmul precision
         # (6-pass bf16x3 on the MXU, ≈ peak/6), so percent-of-bf16-peak
         # understates MXU occupancy by that factor for the Gram GEMMs
-        r = flagship["roofline"]
+        r = res["roofline"]
         r["pct_peak_flops_f32_highest"] = round(
             100 * r["attained_tflops"] * 1e12 / (V5E_PEAK_FLOPS / 6.0), 1)
-        phase("flagship_done", seconds=flagship["fit_seconds"])
+        return res
+
+    flagship = None
+    if not args.skip_flagship:
+        flagship = run_tier("flagship_solver", "flagship_done",
+                            "fit_seconds", flagship_fn)
     detail.update({"progress": "flagship", "flagship_bcd_d8192": flagship})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     feat_tier = None
     if not args.skip_featurize_tier:
-        phase("featurize_tier")
-        feat_tier = _flagship_featurize(
-            batch=args.featurize_batch, reps=args.featurize_reps,
-            num_filters=config.num_filters)
-        phase("featurize_tier_done", seconds=feat_tier["per_rep_seconds"])
+        feat_tier = run_tier(
+            "featurize_tier", "featurize_tier_done", "per_rep_seconds",
+            lambda: _flagship_featurize(
+                batch=args.featurize_batch, reps=args.featurize_reps,
+                num_filters=config.num_filters))
     detail.update({"progress": "featurize_tier",
                    "flagship_featurize": feat_tier})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     krr = None
     if not args.skip_krr:
-        phase("krr_solver")
-        krr = _flagship_krr(
-            n=args.krr_n, d=args.krr_d, k=args.krr_k, block=4096)
-        phase("krr_done", seconds=krr["fit_seconds"])
+        krr = run_tier(
+            "krr_solver", "krr_done", "fit_seconds",
+            lambda: _flagship_krr(
+                n=args.krr_n, d=args.krr_d, k=args.krr_k, block=4096))
     detail.update({"progress": "krr_tier", "flagship_krr": krr})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
@@ -836,8 +862,7 @@ def child_main(args):
     # biggest single program in the bench: if the tunnel wedges inside
     # that compile, the watchdog-killed child has already checkpointed
     # everything else.
-    phase("fused_tier")
-    try:
+    def fused_fn():
         run_fused(train, test, config)  # compile + warm
         # fresh-valued timed run (PERF.md methodology: the transport
         # memoizes byte-identical executions); perturbation dispatched
@@ -853,7 +878,7 @@ def child_main(args):
         t0 = time.perf_counter()
         fused_res = run_fused(train_f, test, config)
         fused_s = time.perf_counter() - t0
-        fused_detail = {
+        return {
             "train_seconds": round(fused_s, 3),
             "images_per_sec": round(train.data.count / fused_s, 2),
             "test_accuracy": round(fused_res["test_accuracy"], 4),
@@ -861,12 +886,9 @@ def child_main(args):
                     "CLI path); includes train+test featurize and both "
                     "confusion matrices",
         }
-    except Exception as e:  # the tier must not cost the rest of the
-        # record (e.g. an OOM at these shapes on a future geometry)
-        fused_detail = {"error": f"{type(e).__name__}: {e}"}
-    phase("fused_done",
-          seconds=fused_detail.get("train_seconds", "error"))
 
+    fused_detail = run_tier("fused_tier", "fused_done", "train_seconds",
+                            fused_fn)
     detail.update({"progress": "complete", "fused": fused_detail})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
     return 0

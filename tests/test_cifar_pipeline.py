@@ -299,3 +299,23 @@ def test_bench_partial_record_ranking():
                "krr_tier", "complete"]
     ranks = [bench.PROGRESS_RANK[p] for p in emitted]
     assert ranks == sorted(ranks) and len(set(ranks)) == len(ranks)
+
+
+def test_bench_tier_errors_surface_and_never_persist():
+    """A record whose tier payload carries {"error": ...} (the child's
+    failure-isolated tiers) must surface the failure top-level and never
+    persist as the stale-fallback record, even in-band on TPU."""
+    bench = _load_bench()
+
+    base = {"images_per_sec": 1000.0, "test_accuracy": 0.85,
+            "accuracy_band": [0.72, 0.96], "platform": "tpu",
+            "accuracy_in_band": True,
+            "flagship_bcd_d8192": {"error": "RuntimeError: boom"},
+            "flagship_krr": {"fit_seconds": 1.0}}
+    rec, persist = bench.finalize_record(base)
+    assert not persist
+    assert "flagship_bcd_d8192" in rec["error"] and "boom" in rec["error"]
+    # healthy tiers still persist
+    ok = dict(base, flagship_bcd_d8192={"fit_seconds": 1.0})
+    rec, persist = bench.finalize_record(ok)
+    assert persist and "error" not in rec
