@@ -87,6 +87,10 @@ def run_child(args, timeout_s: float):
         cmd += ["--skip-featurize-tier"]
     if args.skip_krr:
         cmd += ["--skip-krr"]
+    cmd += ["--overlap-n", str(args.overlap_n),
+            "--overlap-chunk", str(args.overlap_chunk)]
+    if args.skip_overlap_tier:
+        cmd += ["--skip-overlap-tier"]
     if args.cifar_dir:
         cmd += ["--cifar-dir", args.cifar_dir]
     if args.train_path:
@@ -175,7 +179,15 @@ def emit(record):
 # tier's big cold compile runs last precisely so a wedge there leaves a
 # krr_tier-ranked checkpoint holding every measured tier).
 PROGRESS_RANK = {"headline": 1, "staged": 2, "flagship": 3,
-                 "featurize_tier": 4, "krr_tier": 5, "complete": 6}
+                 "featurize_tier": 4, "krr_tier": 5, "overlap_tier": 6,
+                 "complete": 7}
+
+# The tier payload keys a child detail may carry. finalize_record's
+# error scan is restricted to exactly these: a future informational
+# payload that happens to contain an "error" field (e.g. a north_star
+# sub-dict) must not silently block persistence.
+TIER_KEYS = ("flagship_bcd_d8192", "flagship_featurize", "flagship_krr",
+             "featurize_overlap", "fused")
 
 
 def progress_rank(detail) -> int:
@@ -224,8 +236,9 @@ def finalize_record(detail):
             f"{'calibrated lower bound' if detail.get('synthetic', True) else 'north-star target'} "
             f"{bound}")
         return rec, False
-    tier_errors = {k: v["error"] for k, v in detail.items()
-                   if isinstance(v, dict) and "error" in v}
+    tier_errors = {k: detail[k]["error"] for k in TIER_KEYS
+                   if isinstance(detail.get(k), dict)
+                   and "error" in detail[k]}
     if tier_errors:
         rec["error"] = "tier failures: " + "; ".join(
             f"{k}: {e}" for k, e in sorted(tier_errors.items()))
@@ -258,6 +271,9 @@ def main():
     p.add_argument("--krr-d", type=int, default=440)
     p.add_argument("--krr-k", type=int, default=138)
     p.add_argument("--skip-krr", action="store_true")
+    p.add_argument("--overlap-n", type=int, default=16_384)
+    p.add_argument("--overlap-chunk", type=int, default=2048)
+    p.add_argument("--skip-overlap-tier", action="store_true")
     p.add_argument("--liveness-timeout", type=float, default=90.0)
     p.add_argument("--run-timeout", type=float, default=1500.0)
     p.add_argument("--phase-timeout", type=float, default=900.0,
@@ -612,6 +628,101 @@ def _flagship_krr(n, d, k, block, epochs=2, gamma=0.01, lam=0.1):
     }
 
 
+def _flagship_overlap(n, chunk, num_filters, patch=6, block=512, iters=2,
+                      num_classes=10):
+    """Serial-vs-overlapped featurize→solve tier (overlap engine PR):
+    the SAME chunked host workload — n host-resident images featurized
+    through the fused conv kernel via `map_host_batched`, stacked, then
+    BCD-solved — timed once with the overlap engine disabled (stack →
+    dispatch → blocking pull per chunk, the pre-change behavior) and
+    once enabled (background thread stages/uploads chunk k+1 while the
+    device runs chunk k; result pulls deferred and drained in order).
+    The paths are numerically identical (asserted in
+    tests/test_overlap.py); the delta is pure pipelining of host stack,
+    host→device upload, device compute, and device→host pull."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.ops import conv_rectify_pool
+    from keystone_tpu.utils import batching
+    from keystone_tpu.workflow.env import execution_config, overlap_override
+
+    rng = np.random.default_rng(5)
+    items = [rng.uniform(0, 255, size=(32, 32, 3)).astype(np.float32)
+             for _ in range(n)]
+    labels = Dataset(
+        (2.0 * np.eye(num_classes, dtype=np.float32)[
+            rng.integers(0, num_classes, size=n)] - 1.0))
+    kernel = jnp.asarray(
+        rng.normal(size=(patch, patch, 3, num_filters)).astype(np.float32)
+        * 0.1)
+    colsum = kernel.reshape(-1, num_filters).sum(axis=0)
+    bias = jnp.zeros((num_filters,), jnp.float32)
+
+    @jax.jit
+    def feat(xb):
+        pooled = conv_rectify_pool(
+            xb / 255.0, kernel, colsum, bias, 0.25, 0.0, 14, 13, True)
+        return pooled.reshape(xb.shape[0], -1)
+
+    est = BlockLeastSquaresEstimator(block_size=block, num_iter=iters,
+                                     lam=1e-2)
+
+    class _Fresh:
+        """Lazy per-item perturbation: fresh values defeat the
+        transport's byte-identical-program memo, and the multiply is
+        paid at chunk-STACK time — on the producer thread in the
+        overlapped path, inline in the serial path — so it is part of
+        the chunked host work the engine must hide, not a constant
+        added to both timings outside the dispatcher."""
+
+        __slots__ = ("x", "eps")
+
+        def __init__(self, x, eps):
+            self.x = x
+            self.eps = eps
+
+        @property
+        def shape(self):
+            return self.x.shape
+
+        def __array__(self, dtype=None):
+            return np.asarray(self.x * self.eps, dtype or np.float32)
+
+    def run_once():
+        eps = 1.0 + float(np.random.default_rng().random()) * 1e-6
+        t0 = time.perf_counter()
+        feats = batching.map_host_batched(
+            [_Fresh(x, eps) for x in items], feat, chunk=chunk)
+        model = est.fit(Dataset(np.stack(feats)), labels)
+        np.asarray(model.W[:1, :1])  # scalar pull = sync
+        return time.perf_counter() - t0
+
+    with overlap_override(False):
+        run_once()  # warm/compile
+        t_serial = min(run_once(), run_once())
+    with overlap_override(True):
+        run_once()  # warm the producer-thread path
+        t_overlap = min(run_once(), run_once())
+    return {
+        "n": n, "chunk": chunk, "n_chunks": -(-n // chunk),
+        "num_filters": num_filters,
+        "prefetch_depth": execution_config().prefetch_depth,
+        "serial_seconds": round(t_serial, 4),
+        "overlapped_seconds": round(t_overlap, 4),
+        "speedup": round(t_serial / t_overlap, 3),
+        "images_per_sec_serial": round(n / t_serial, 1),
+        "images_per_sec_overlapped": round(n / t_overlap, 1),
+        "structure": ("map_host_batched(featurize) -> stack -> BCD "
+                      "solve; serial = blocking pull per chunk, "
+                      "overlapped = double-buffered dispatch + deferred "
+                      "in-order drains"),
+    }
+
+
 def child_main(args):
     """The measured workload. Runs in a killable subprocess; prints phase
     markers and finally one BENCH_DETAIL line."""
@@ -799,11 +910,17 @@ def child_main(args):
     })
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
-    def run_tier(start_phase, done_phase, seconds_key, fn):
+    def run_tier(key, start_phase, done_phase, seconds_key, fn):
         """Failure-isolated tier: a tier that raises records
         {"error": ...} instead of killing the child and losing every
         later tier's measurement (finalize_record surfaces tier errors
-        top-level and refuses to persist such a record)."""
+        top-level and refuses to persist such a record). ``key`` is the
+        detail key the caller will store the result under; it MUST be
+        registered in TIER_KEYS or the error gate would silently skip
+        it — fail loudly here instead of persisting a broken record."""
+        assert key in TIER_KEYS, (
+            f"tier detail key {key!r} is not in bench.TIER_KEYS; "
+            "finalize_record would ignore its errors — register it")
         phase(start_phase)
         try:
             res = fn()
@@ -827,15 +944,16 @@ def child_main(args):
 
     flagship = None
     if not args.skip_flagship:
-        flagship = run_tier("flagship_solver", "flagship_done",
-                            "fit_seconds", flagship_fn)
+        flagship = run_tier("flagship_bcd_d8192", "flagship_solver",
+                            "flagship_done", "fit_seconds", flagship_fn)
     detail.update({"progress": "flagship", "flagship_bcd_d8192": flagship})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     feat_tier = None
     if not args.skip_featurize_tier:
         feat_tier = run_tier(
-            "featurize_tier", "featurize_tier_done", "per_rep_seconds",
+            "flagship_featurize", "featurize_tier",
+            "featurize_tier_done", "per_rep_seconds",
             lambda: _flagship_featurize(
                 batch=args.featurize_batch, reps=args.featurize_reps,
                 num_filters=config.num_filters))
@@ -846,10 +964,22 @@ def child_main(args):
     krr = None
     if not args.skip_krr:
         krr = run_tier(
-            "krr_solver", "krr_done", "fit_seconds",
+            "flagship_krr", "krr_solver", "krr_done", "fit_seconds",
             lambda: _flagship_krr(
                 n=args.krr_n, d=args.krr_d, k=args.krr_k, block=4096))
     detail.update({"progress": "krr_tier", "flagship_krr": krr})
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
+    overlap = None
+    if not args.skip_overlap_tier:
+        overlap = run_tier(
+            "featurize_overlap", "overlap_tier", "overlap_done",
+            "overlapped_seconds",
+            lambda: _flagship_overlap(
+                n=args.overlap_n, chunk=args.overlap_chunk,
+                num_filters=config.num_filters))
+    detail.update({"progress": "overlap_tier",
+                   "featurize_overlap": overlap})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     # Fused tier LAST: the SAME training run as one XLA program (the
@@ -887,8 +1017,8 @@ def child_main(args):
                     "confusion matrices",
         }
 
-    fused_detail = run_tier("fused_tier", "fused_done", "train_seconds",
-                            fused_fn)
+    fused_detail = run_tier("fused", "fused_tier", "fused_done",
+                            "train_seconds", fused_fn)
     detail.update({"progress": "complete", "fused": fused_detail})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
     return 0

@@ -23,17 +23,30 @@ def cifar_loader(path: str, mesh=None) -> LabeledData:
         if os.path.isdir(path)
         else [path]
     )
-    raws = []
-    for f in files:
-        raw = np.fromfile(f, dtype=np.uint8)
-        if raw.size % RECORD_BYTES:
-            raise ValueError(f"{f}: size {raw.size} is not a multiple of {RECORD_BYTES}")
-        raws.append(raw.reshape(-1, RECORD_BYTES))
-    records = np.concatenate(raws)
-    # native multithreaded parse (channel-planar -> HWC); numpy fallback
+    # native multithreaded parse (channel-planar -> HWC); numpy fallback.
+    # The disk read of batch k+1 runs in a bounded background queue while
+    # batch k parses (prefetch_iterator is a no-op for a single file or
+    # with the overlap engine disabled); per-file parse + concatenate is
+    # record-wise identical to parsing the concatenated records.
+    from ..utils.batching import prefetch_iterator
     from ..utils.native_io import parse_cifar
 
-    images, labels = parse_cifar(records)
+    def read(f):
+        raw = np.fromfile(f, dtype=np.uint8)
+        if raw.size % RECORD_BYTES:
+            raise ValueError(
+                f"{f}: size {raw.size} is not a multiple of {RECORD_BYTES}")
+        return raw.reshape(-1, RECORD_BYTES)
+
+    parsed = [
+        parse_cifar(records)
+        for records in prefetch_iterator(read(f) for f in files)
+    ]
+    if len(parsed) == 1:
+        images, labels = parsed[0]
+    else:
+        images = np.concatenate([p[0] for p in parsed])
+        labels = np.concatenate([p[1] for p in parsed])
     return LabeledData(
         labels=Dataset(labels, mesh=mesh), data=Dataset(images, mesh=mesh)
     )

@@ -47,23 +47,34 @@ def load_images_from_tar(
     native = _load_tar_native(path, label_fn, max_images)
     if native is not None:
         return native
+    from ..utils.batching import prefetch_iterator
+
+    def raw_entries():
+        # Producer side of the decode prefetch: the sequential tar
+        # walk + member reads (I/O-bound) run in a background thread,
+        # bounded by the config prefetch depth, while the consumer
+        # below runs the CPU-bound PIL decode — the same overlap the
+        # native path gets from its thread pool.
+        with tarfile.open(path, "r:*") as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                label = label_fn(member.name)
+                if label is None:
+                    continue
+                f = tar.extractfile(member)
+                if f is None:
+                    continue
+                yield member.name, f.read(), label
+
     out = []
-    with tarfile.open(path, "r:*") as tar:
-        for member in tar:
-            if not member.isfile():
-                continue
-            label = label_fn(member.name)
-            if label is None:
-                continue
-            f = tar.extractfile(member)
-            if f is None:
-                continue
-            img = _decode_image(f.read())
-            if img is None:
-                continue
-            out.append((member.name, img, label))
-            if max_images and len(out) >= max_images:
-                break
+    for name, data, label in prefetch_iterator(raw_entries()):
+        img = _decode_image(data)
+        if img is None:
+            continue
+        out.append((name, img, label))
+        if max_images and len(out) >= max_images:
+            break
     return out
 
 

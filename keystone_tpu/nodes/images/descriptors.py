@@ -23,7 +23,12 @@ from ...workflow.pipeline import Transformer
 class _GridDescriptorExtractor(Transformer):
     """Shared batch plumbing: jit per item fn, vmap for device batches.
     HostDataset items (variable-size images) are bucketed by shape and
-    dispatched one vmapped program per bucket chunk, not per item."""
+    dispatched one vmapped program per bucket chunk, not per item. The
+    host path both produces and consumes chunk streams (overlap engine):
+    chunks are dispatched double-buffered and flow to the next stage as
+    they drain off the device."""
+
+    chunkable = True  # per-item host map: distributes over chunks
 
     def _fn(self):
         raise NotImplementedError
@@ -50,6 +55,11 @@ class _GridDescriptorExtractor(Transformer):
                 batching.map_host_batched(data.items, self._batch_fn())
             )
         return data.map_batches(self._batch_fn(), jitted=False)
+
+    def apply_batch_stream(self, data):
+        from ...utils import batching
+
+        return batching.map_host_batched_stream(data.items, self._batch_fn())
 
 
 class LCSExtractor(_GridDescriptorExtractor):

@@ -195,15 +195,29 @@ class SIFTExtractor(SIFTExtractorInterface):
             self.__dict__["_jitted"] = fn
         return fn(jnp.asarray(image, jnp.float32))
 
-    def apply_batch(self, data):
+    chunkable = True  # per-item host map: distributes over chunks
+
+    def _batch_fn(self):
         fn = self.__dict__.get("_jitted_batch")
         if fn is None:
             single = self._fn()
             fn = jax.jit(jax.vmap(single))
             self.__dict__["_jitted_batch"] = fn
+        return fn
+
+    def apply_batch(self, data):
         if isinstance(data, HostDataset):
             # bucket-by-shape: one dispatch per (shape, chunk), not per image
             from ...utils import batching
 
-            return HostDataset(batching.map_host_batched(data.items, fn))
-        return data.map_batches(fn, jitted=False)
+            return HostDataset(
+                batching.map_host_batched(data.items, self._batch_fn())
+            )
+        return data.map_batches(self._batch_fn(), jitted=False)
+
+    def apply_batch_stream(self, data):
+        # overlap engine: double-buffered dispatch, chunks stream to the
+        # consumer as they drain (see utils/batching.py)
+        from ...utils import batching
+
+        return batching.map_host_batched_stream(data.items, self._batch_fn())

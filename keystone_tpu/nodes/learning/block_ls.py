@@ -104,6 +104,74 @@ def _bcd_fit_impl(X, Y, mask, lam, block_size, num_blocks, num_iter, center,
     return W_full, b
 
 
+@partial(
+    jax.jit,
+    static_argnames=("block_size", "num_blocks", "center", "x_sharding"),
+)
+def _bcd_prepare(X, Y, mask, block_size: int, num_blocks: int, center: bool,
+                 x_sharding=None):
+    """Centering/masking pass + zero-initialized model and residual
+    buffers for the donated epoch loop. Identical arithmetic to the
+    prologue of `_bcd_fit_impl`."""
+    with jax.default_matmul_precision("highest"):
+        d_pad = X.shape[1]
+        k = Y.shape[1]
+        dtype = X.dtype
+        count = jnp.sum(mask)
+        if center:
+            xm = jnp.sum(X, axis=0) / count
+            ym = jnp.sum(Y, axis=0) / count
+            Xc = (X - xm) * mask[:, None]
+            Yc = (Y - ym) * mask[:, None]
+        else:
+            xm = jnp.zeros((d_pad,), dtype)
+            ym = jnp.zeros((k,), dtype)
+            Xc = X * mask[:, None]
+            Yc = Y * mask[:, None]
+        if x_sharding is not None:
+            Xc = jax.lax.with_sharding_constraint(Xc, x_sharding)
+        W0 = jnp.zeros((num_blocks, block_size, k), dtype)
+        return Xc, Yc, xm, ym, W0
+
+
+@partial(
+    jax.jit,
+    static_argnames=("block_size", "num_blocks"),
+    donate_argnums=(0, 1),
+)
+def _bcd_epoch(W, R, Xc, lam, block_size: int, num_blocks: int):
+    """One BCD sweep over all feature blocks with the model W and
+    residual R DONATED: XLA reuses their buffers for the outputs, so the
+    per-epoch host loop updates solver state in place instead of
+    re-allocating (num_blocks, B, k) + (n, k) of HBM every epoch. Same
+    block_step arithmetic as `_bcd_fit_impl`'s inner scan, hence
+    allclose-identical fits (tests/test_solvers.py)."""
+    with jax.default_matmul_precision("highest"):
+        eye = lam * jnp.eye(block_size, dtype=Xc.dtype)
+
+        def block_step(carry, b_idx):
+            W, R = carry
+            Xb = jax.lax.dynamic_slice_in_dim(
+                Xc, b_idx * block_size, block_size, axis=1)
+            Wb = W[b_idx]
+            R1 = R + Xb @ Wb
+            G = Xb.T @ Xb + eye          # all-reduce over the data axis
+            C = Xb.T @ R1                # all-reduce over the data axis
+            Wb_new = jax.scipy.linalg.solve(G, C, assume_a="pos")
+            R2 = R1 - Xb @ Wb_new
+            return (W.at[b_idx].set(Wb_new), R2), None
+
+        (W, R), _ = jax.lax.scan(block_step, (W, R), jnp.arange(num_blocks))
+        return W, R
+
+
+@jax.jit
+def _bcd_finalize(W, xm, ym):
+    with jax.default_matmul_precision("highest"):
+        W_full = W.reshape(-1, ym.shape[0])
+        return W_full, ym - xm @ W_full
+
+
 @partial(jax.jit, static_argnames=("block_size", "n_chunk"))
 def _partial_preds_scan(X, W, b, acc0, start, block_size: int, n_chunk: int):
     """Cumulative partial predictions for ``n_chunk`` consecutive feature
@@ -206,15 +274,24 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         d_pad = num_blocks * bs
         if d_pad != d:
             X = jnp.pad(X, [(0, 0), (0, d_pad - d)])
-        W, b = _bcd_fit(
+        # Donated-buffer epoch loop: prepare once, then each sweep
+        # updates (W, R) IN PLACE via donate_argnums — no fresh
+        # model/residual allocation per epoch, and the host loop's
+        # dispatches pipeline through jax's async queue (no sync until
+        # the caller pulls the model). `_bcd_fit`/_bcd_fit_impl (the
+        # single-program scan form) remains the fused-pipeline path and
+        # the numerics reference for these steps.
+        Xc, R, xm, ym, W = _bcd_prepare(
             X,
             Y,
             data.mask.astype(X.dtype),
-            jnp.asarray(self.lam, X.dtype),
             bs,
             num_blocks,
-            self.num_iter,
             self.fit_intercept,
             x_sharding=meshlib.feature_sharding(data.mesh, d_pad),
         )
+        lam = jnp.asarray(self.lam, X.dtype)
+        for _ in range(self.num_iter):
+            W, R = _bcd_epoch(W, R, Xc, lam, bs, num_blocks)
+        W, b = _bcd_finalize(W, xm, ym)
         return BlockLinearMapper(W, b if self.fit_intercept else None, self.block_size)

@@ -84,6 +84,58 @@ def _lbfgs_fit_impl(X, Y, mask, lam, count, num_iters, memory_size, fit_intercep
     return W, b, values
 
 
+@partial(jax.jit, static_argnames=("fit_intercept", "x_sharding"))
+def _lbfgs_prepare(X, Y, mask, count, fit_intercept: bool, x_sharding=None):
+    """Centering pass + zero model and initial optimizer state for the
+    donated step loop. Same prologue arithmetic as `_lbfgs_fit_impl`."""
+    with jax.default_matmul_precision("highest"):
+        d, k = X.shape[1], Y.shape[1]
+        dtype = X.dtype
+        if x_sharding is not None:
+            X = jax.lax.with_sharding_constraint(X, x_sharding)
+        if fit_intercept:
+            xm = jnp.sum(X, axis=0) / count
+            ym = jnp.sum(Y, axis=0) / count
+            Xc = (X - xm) * mask[:, None]
+            Yc = (Y - ym) * mask[:, None]
+        else:
+            xm = jnp.zeros((d,), dtype)
+            ym = jnp.zeros((k,), dtype)
+            Xc = X * mask[:, None]
+            Yc = Y * mask[:, None]
+        return Xc, Yc, xm, ym
+
+
+@partial(jax.jit, static_argnames=("memory_size",))
+def _lbfgs_init(Xc, Yc, memory_size: int):
+    W0 = jnp.zeros((Xc.shape[1], Yc.shape[1]), Xc.dtype)
+    return W0, optax.lbfgs(memory_size=memory_size).init(W0)
+
+
+@partial(jax.jit, static_argnames=("memory_size",), donate_argnums=(0, 1))
+def _lbfgs_step(W, state, Xc, Yc, lam, memory_size: int):
+    """One L-BFGS update with the model W and optimizer state (history
+    ring buffers, cached value/grad) DONATED: every iteration writes
+    into the previous iteration's buffers instead of allocating a fresh
+    (2m+1)·d·k of history. Identical step arithmetic to `_lbfgs_fit`'s
+    scan body, hence allclose-identical fits (tests/test_solvers.py).
+    Callers must rebind (W, state) every call and never touch the old
+    values."""
+    with jax.default_matmul_precision("highest"):
+
+        def loss(W):
+            resid = Xc @ W - Yc
+            return 0.5 * jnp.sum(resid * resid) + 0.5 * lam * jnp.sum(W * W)
+
+        opt = optax.lbfgs(memory_size=memory_size)
+        value, grad = optax.value_and_grad_from_state(loss)(W, state=state)
+        updates, state = opt.update(
+            grad, state, W, value=value, grad=grad, value_fn=loss
+        )
+        W = optax.apply_updates(W, updates)
+        return W, state, value
+
+
 class DenseLBFGSwithL2(LabelEstimator):
     """Least-squares + L2 via L-BFGS on dense features
     (LBFGS.scala `DenseLBFGSwithL2`)."""
@@ -105,18 +157,32 @@ class DenseLBFGSwithL2(LabelEstimator):
         from ...parallel import mesh as meshlib
 
         X, Y = data.array, labels.array
-        W, b, self.loss_history = _lbfgs_fit(
+        # Donated-buffer iteration loop: model + L-BFGS history are
+        # updated in place each step (donate_argnums), and the host
+        # loop's dispatches pipeline asynchronously — no host sync until
+        # the model is pulled. `_lbfgs_fit` (the one-program scan form)
+        # remains as the numerics reference for these steps.
+        Xc, Yc, xm, ym = _lbfgs_prepare(
             X,
             Y,
             data.mask.astype(X.dtype),
-            jnp.asarray(self.lam, X.dtype),
             jnp.asarray(data.count, X.dtype),
-            self.num_iters,
-            self.memory_size,
             self.fit_intercept,
             x_sharding=meshlib.feature_sharding(data.mesh, X.shape[1]),
         )
-        return LinearMapper(W, b if self.fit_intercept else None)
+        lam = jnp.asarray(self.lam, X.dtype)
+        W, state = _lbfgs_init(Xc, Yc, self.memory_size)
+        values = []
+        for _ in range(self.num_iters):
+            W, state, value = _lbfgs_step(
+                W, state, Xc, Yc, lam, self.memory_size)
+            values.append(value)
+        self.loss_history = jnp.stack(values) if values else jnp.zeros((0,))
+        if not self.fit_intercept:
+            return LinearMapper(W, None)
+        with jax.default_matmul_precision("highest"):
+            b = ym - xm @ W
+        return LinearMapper(W, b)
 
 
 @partial(jax.jit, static_argnames=("num_iters", "memory_size"))

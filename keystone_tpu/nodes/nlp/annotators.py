@@ -325,13 +325,20 @@ def _restore_e(stem: str) -> str:
     """mak -> make, invit -> invite: consonant-vowel-consonant stems
     whose final consonant isn't doubled usually dropped a silent e;
     `_NO_E_STEMS` lists the frequent unstressed-final-syllable verbs
-    that didn't. Stems ending in v/z (believ, serv, siz) virtually
-    always take the e back — no English word ends in bare v — as do the
-    soft-consonant clusters -nc/-rc/-rg (danc -> dance, forc -> force,
-    charg -> charge, judg -> judge)."""
+    that didn't. Stems ending in v (believ, serv) virtually always take
+    the e back — no English word ends in bare v — and so do
+    vowel-preceded z stems (siz -> size, doz -> doze, analyz ->
+    analyze, with y acting as a vowel exactly as in the CVC rule
+    below); a true CONSONANT before the z means the z closes a real
+    cluster that never dropped an e (waltz -> waltz, blitz -> blitz),
+    so only the vowel case restores. The soft-consonant clusters
+    -nc/-rc/-rg/-dg (danc -> dance, forc -> force, charg -> charge,
+    judg -> judge) restore too."""
     if stem in _NO_E_STEMS:
         return stem
-    if len(stem) >= 3 and stem[-1] in "vz":
+    if len(stem) >= 3 and (
+        stem[-1] == "v" or (stem[-1] == "z" and stem[-2] in _VOWELS + "y")
+    ):
         return stem + "e"
     if len(stem) >= 3 and stem.endswith(("nc", "rc", "rg", "dg")):
         return stem + "e"

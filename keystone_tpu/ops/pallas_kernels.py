@@ -335,6 +335,7 @@ def _fused_conv_canary_ok(h: int, w: int, c: int, k: int, pool: int,
     state = _fused_conv_canary.get(key)
     if state is True or state is False:
         return state
+    multihost = jax.process_count() > 1
     try:
         import numpy as np
 
@@ -355,7 +356,22 @@ def _fused_conv_canary_ok(h: int, w: int, c: int, k: int, pool: int,
         logging.getLogger(__name__).warning(
             "fused conv canary failed at geometry %s (%s: %s); "
             "using the XLA path for it", key, type(e).__name__, e)
-        ok = False if state == 1 else 1
+        # Single-host: retry once (a transient device blip must not
+        # demote a working geometry for the whole process). Multi-host:
+        # no retry marker — the verdict is settled collectively below.
+        ok = False if (multihost or state == 1) else 1
+    if multihost:
+        # Every process must compile the SAME program for the collective
+        # launch, but a transient blip can hit only SOME hosts, leaving
+        # them with different local verdicts (fused on one, XLA on the
+        # rest → a wedged collective). Adopt process 0's verdict
+        # everywhere: the canary runs at the same SPMD program point on
+        # every process (same geometry key, same call site), so this
+        # broadcast lines up like parallel.multihost.barrier() does.
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        ok = bool(multihost_utils.broadcast_one_to_all(np.asarray(bool(ok))))
     _fused_conv_canary[key] = ok
     return ok is True
 

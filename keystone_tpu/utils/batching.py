@@ -1,4 +1,5 @@
-"""Host→device batching for variable-shape item collections.
+"""Host→device batching for variable-shape item collections, with an
+async double-buffered dispatch engine.
 
 The reference amortizes JVM→native costs by processing images
 per-partition (ImageLoaderUtils.scala:56-94). The TPU analog: group a
@@ -6,13 +7,229 @@ per-partition (ImageLoaderUtils.scala:56-94). The TPU analog: group a
 ONE vmapped XLA dispatch per (shape, chunk) instead of one dispatch per
 item — on a high-latency link the per-item path costs a full round trip
 per image (VERDICT r1 item 8).
+
+The overlap engine (this PR) removes the remaining serialization: the
+serial path stacks chunk k, dispatches it, and BLOCKS on a host
+``np.asarray`` pull before touching chunk k+1, so host stacking, the
+host→device upload, device compute, and the device→host pull all take
+turns. Overlapped (`workflow.env.execution_config().overlap`, default
+on):
+
+  - a background producer thread converts/stacks chunk k+1 and
+    ``device_put``s it while the device runs chunk k, feeding a queue
+    bounded at ``prefetch_depth`` (peak host memory stays
+    O(depth × chunk) items);
+  - the main thread only *dispatches* — jax's async dispatch returns
+    device futures immediately — and keeps a sliding window of
+    ``prefetch_depth + 1`` in-flight results, draining the oldest with
+    ``np.asarray`` only when the window is full (total residency:
+    ≤ depth queued + 1 being stacked + depth + 1 dispatched, i.e.
+    ≤ 2·depth + 2 chunks — still O(depth), never O(n));
+  - results come back in dispatch order, are re-placed in the original
+    item order, and a producer exception re-raises in the caller
+    (never a hang).
+
+Single-chunk inputs fall back to the serial path (there is nothing to
+overlap). `prefetch_iterator` is the same bounded producer-thread
+pattern over any generator, reused by the archive/CIFAR loaders.
 """
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+
+class _ProducerError:
+    """Sentinel carrying an exception out of a producer thread."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_DONE = object()
+
+
+def _bounded_put(q: "queue.Queue", item, cancel: threading.Event) -> bool:
+    """Put that can be cancelled while the queue is full (a consumer that
+    stopped draining must not leave the producer blocked forever)."""
+    while not cancel.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def prefetch_iterator(
+    it: Iterable, depth: Optional[int] = None
+) -> Iterator:
+    """Drain ``it`` in a background thread through a queue bounded at
+    ``depth`` (default: config ``prefetch_depth``), yielding items in
+    order. Producer exceptions re-raise at the consumer's next pull;
+    closing the generator early cancels the producer. This is the
+    loaders' decode-prefetch primitive: the producer does the blocking
+    I/O (tar member reads, file reads) while the consumer decodes."""
+    from ..workflow.env import execution_config
+
+    cfg = execution_config()
+    if not cfg.overlap:
+        yield from it
+        return
+    if depth is None:
+        depth = cfg.prefetch_depth
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    cancel = threading.Event()
+
+    def producer():
+        try:
+            for item in it:
+                if not _bounded_put(q, (item,), cancel):
+                    return
+        except BaseException as e:  # re-raised at the consumer
+            _bounded_put(q, _ProducerError(e), cancel)
+            return
+        _bounded_put(q, _DONE, cancel)
+
+    t = threading.Thread(
+        target=producer, name="keystone-prefetch", daemon=True
+    )
+    t.start()
+    try:
+        while True:
+            msg = q.get()
+            if msg is _DONE:
+                break
+            if isinstance(msg, _ProducerError):
+                raise msg.exc
+            yield msg[0]
+    finally:
+        cancel.set()
+
+
+# --------------------------------------------------------------------------
+# Chunk planning (shared by the serial and overlapped paths)
+
+
+def _plan_chunks(
+    items: Sequence, chunk: Optional[int]
+) -> List[List[int]]:
+    """Bucket item indices by shape, then split each bucket into chunks.
+    Dispatch count is Σ_buckets ceil(bucket_size / chunk), independent of
+    item count within a chunk."""
+    buckets: dict = {}
+    for i, x in enumerate(items):
+        shape = x.shape if hasattr(x, "shape") else np.asarray(x).shape
+        buckets.setdefault(shape, []).append(i)
+    plan: List[List[int]] = []
+    for idxs in buckets.values():
+        step = chunk or len(idxs)
+        for start in range(0, len(idxs), step):
+            plan.append(idxs[start : start + step])
+    return plan
+
+
+def _stack_chunk(items: Sequence, part: List[int]) -> np.ndarray:
+    return np.stack([np.asarray(items[i], np.float32) for i in part])
+
+
+def _split_result(res, part: List[int]) -> Tuple[List[int], List]:
+    res = np.asarray(res)
+    return part, [res[j] for j in range(len(part))]
+
+
+def _stream_serial(items, plan, batch_fn) -> Iterator[Tuple[List[int], List]]:
+    """Pre-overlap behavior: stack → dispatch → blocking pull, one chunk
+    at a time."""
+    for part in plan:
+        yield _split_result(batch_fn(_stack_chunk(items, part)), part)
+
+
+_device_put_warned = False
+
+
+def _device_put_host(stacked: np.ndarray):
+    """Upload a stacked chunk from the producer thread so the transfer
+    overlaps the device's work on the previous chunk. Falls back to the
+    host array when no device placement is possible (e.g. an
+    uninitialized backend in a pure-host test) — warning ONCE, because a
+    persistently failing upload (backend misconfiguration, device OOM
+    while staging) silently moves the H2D transfer back into the
+    dispatch path and erases the overlap win."""
+    try:
+        import jax
+
+        return jax.device_put(stacked)
+    except Exception as e:
+        global _device_put_warned
+        if not _device_put_warned:
+            _device_put_warned = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "overlap dispatcher could not device_put a staged chunk "
+                "(%s: %s); falling back to host arrays — the host→device "
+                "upload will no longer overlap device compute",
+                type(e).__name__, e)
+        return stacked
+
+
+def _stream_overlapped(
+    items, plan, batch_fn, depth: int
+) -> Iterator[Tuple[List[int], List]]:
+    """Double-buffered dispatch: `prefetch_iterator` runs the
+    stack-and-upload of chunk k+1 in its producer thread while chunk k
+    runs; the consumer keeps ≤ ``depth + 1`` dispatched results in
+    flight and drains the oldest in dispatch order (at depth=1 that is
+    classic double buffering: one result being pulled while the next is
+    on the device)."""
+    from collections import deque
+
+    staged = prefetch_iterator(
+        ((part, _device_put_host(_stack_chunk(items, part)))
+         for part in plan),
+        depth,
+    )
+    inflight: "deque" = deque()  # (part, device result future)
+    try:
+        for part, chunk in staged:
+            # async dispatch: returns immediately, device queues the work
+            inflight.append((part, batch_fn(chunk)))
+            if len(inflight) > depth:
+                part0, res0 = inflight.popleft()
+                yield _split_result(res0, part0)  # deferred pull, in order
+        while inflight:
+            part0, res0 = inflight.popleft()
+            yield _split_result(res0, part0)
+    finally:
+        staged.close()  # early exit / batch_fn failure cancels the producer
+
+
+def map_host_batched_stream(
+    items: Sequence,
+    batch_fn: Callable,
+    chunk: Optional[int] = 256,
+) -> Iterator[Tuple[List[int], List]]:
+    """Streaming form of `map_host_batched`: yields ``(indices, results)``
+    per drained chunk, in dispatch (bucket-major) order. ``indices`` are
+    positions in the original item order; the union over all chunks is
+    exactly ``range(len(items))``. Consumers that only need the final
+    collection should use `map_host_batched`; chunk-capable pipeline
+    stages consume this directly so downstream host work starts before
+    the last chunk is off the device."""
+    plan = _plan_chunks(items, chunk)
+    from ..workflow.env import execution_config
+
+    cfg = execution_config()
+    if cfg.overlap and len(plan) > 1:
+        return _stream_overlapped(items, plan, batch_fn, cfg.prefetch_depth)
+    return _stream_serial(items, plan, batch_fn)
 
 
 def map_host_batched(
@@ -24,21 +241,14 @@ def map_host_batched(
 
     Items are bucketed by shape; each bucket is stacked and dispatched
     through ``batch_fn`` in chunks of ``chunk`` (bounding peak host+device
-    memory). Results come back in the original item order. Dispatch count
-    is Σ_buckets ceil(bucket_size / chunk), independent of item count
-    within a chunk.
+    memory). Results come back in the original item order. With the
+    overlap engine on (the default), stacking/upload of chunk k+1, device
+    compute on chunk k, and the result pull of chunk k−depth all proceed
+    concurrently; the serial path (single chunk, or overlap disabled)
+    computes the identical result one blocking chunk at a time.
     """
-    arrays = [np.asarray(x, np.float32) for x in items]
-    buckets: dict = {}
-    for i, a in enumerate(arrays):
-        buckets.setdefault(a.shape, []).append(i)
-    out: List = [None] * len(arrays)
-    for shape, idxs in buckets.items():
-        step = chunk or len(idxs)
-        for start in range(0, len(idxs), step):
-            part = idxs[start : start + step]
-            stacked = np.stack([arrays[i] for i in part])
-            res = np.asarray(batch_fn(stacked))
-            for j, i in enumerate(part):
-                out[i] = res[j]
+    out: List = [None] * len(items)
+    for part, results in map_host_batched_stream(items, batch_fn, chunk):
+        for i, r in zip(part, results):
+            out[i] = r
     return out

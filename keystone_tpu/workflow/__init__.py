@@ -5,6 +5,7 @@ from .expressions import (
     DatasetExpression,
     DatumExpression,
     Expression,
+    StreamingDatasetExpression,
     TransformerExpression,
 )
 from .operators import (
@@ -17,7 +18,15 @@ from .operators import (
     Operator,
     TransformerOperator,
 )
-from .env import PipelineEnv, Prefix, compute_prefix
+from .env import (
+    ExecutionConfig,
+    PipelineEnv,
+    Prefix,
+    compute_prefix,
+    execution_config,
+    overlap_override,
+    set_execution_config,
+)
 from .executor import GraphExecutor
 from .optimizer import (
     AutoCachingOptimizer,

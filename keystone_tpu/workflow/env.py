@@ -15,11 +15,79 @@ single-threaded host orchestrator and immutable graphs
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import os
+from contextlib import contextmanager
+from dataclasses import dataclass, replace
 from typing import Dict, Optional, Tuple
 
 from .expressions import Expression
 from .graph import Graph, NodeId, SourceId
+
+
+# --------------------------------------------------------------------------
+# Execution configuration (overlapped execution engine)
+
+
+@dataclass(frozen=True)
+class ExecutionConfig:
+    """Knobs for the overlapped execution engine (utils/batching.py).
+
+    ``overlap`` (default on; env ``KEYSTONE_OVERLAP=0`` disables) turns on
+    the async double-buffered host→device dispatcher: a background thread
+    stacks/uploads chunk k+1 while the device runs chunk k, result pulls
+    are deferred and drained in order, loaders prefetch decode work
+    through a bounded queue, and forced Expressions stream per-chunk
+    results to chunk-capable consumers. Single-chunk inputs always take
+    the serial path, so the flag only changes *when* work happens, never
+    what is computed.
+
+    ``prefetch_depth`` bounds every background queue and the in-flight
+    result window (which holds up to depth + 1 dispatched results),
+    capping peak host memory at O(depth × chunk) items — at most
+    2·depth + 2 chunks resident per stage (env
+    ``KEYSTONE_PREFETCH_DEPTH``).
+    """
+
+    overlap: bool = True
+    prefetch_depth: int = 2
+
+
+_exec_config: Optional[ExecutionConfig] = None
+
+
+def execution_config() -> ExecutionConfig:
+    global _exec_config
+    if _exec_config is None:
+        _exec_config = ExecutionConfig(
+            overlap=os.environ.get("KEYSTONE_OVERLAP", "1").lower()
+            not in ("0", "false", "off"),
+            prefetch_depth=max(
+                1, int(os.environ.get("KEYSTONE_PREFETCH_DEPTH", "2"))
+            ),
+        )
+    return _exec_config
+
+
+def set_execution_config(config: Optional[ExecutionConfig]) -> None:
+    """Install ``config`` process-wide; None re-derives from the env."""
+    global _exec_config
+    _exec_config = config
+
+
+@contextmanager
+def overlap_override(enabled: bool, prefetch_depth: Optional[int] = None):
+    """Scoped overlap toggle — the serial-vs-overlapped bench tier and
+    tests flip the engine without touching process env state."""
+    global _exec_config
+    prev = _exec_config
+    cfg = replace(execution_config(), overlap=enabled)
+    if prefetch_depth is not None:
+        cfg = replace(cfg, prefetch_depth=max(1, prefetch_depth))
+    _exec_config = cfg
+    try:
+        yield cfg
+    finally:
+        _exec_config = prev
 
 
 @dataclass(frozen=True)

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Dict, Optional, Tuple
 
 from .env import PipelineEnv, Prefix
-from .expressions import Expression
+from .expressions import Expression, StreamingDatasetExpression
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
 
 
@@ -78,3 +78,15 @@ class GraphExecutor:
             return expr
 
         return go(graph_id)
+
+    def execute_stream(self, graph_id: GraphId):
+        """Execute up to ``graph_id``, yielding ``(indices, payload)``
+        chunks as the terminal stage drains (overlap engine) instead of
+        materializing the full stage. Non-streaming terminals yield one
+        ``(None, value)`` whole-value chunk, so consumers can treat every
+        pipeline uniformly."""
+        expr = self.execute(graph_id)
+        if isinstance(expr, StreamingDatasetExpression):
+            yield from expr.iter_chunks()
+        else:
+            yield None, expr.get

@@ -5,11 +5,20 @@ call-by-name computation and forces it at most once. `DatasetExpression`
 holds a distributed dataset (here: a `keystone_tpu.data.Dataset` or any
 batch container), `DatumExpression` a single item, and
 `TransformerExpression` a fitted transformer (forcing it runs the fit).
+
+`StreamingDatasetExpression` (overlap engine) is a dataset expression
+whose value can additionally be consumed chunk-by-chunk: the producer
+stage (e.g. a bucketed host-batch dispatcher) yields per-chunk results
+as they drain off the device, and a chunk-capable consumer maps each
+chunk without waiting for the stage to materialize — so two host-batched
+stages in a pipeline overlap instead of running strictly one after the
+other. Forcing ``.get`` still materializes (and memoizes) the complete
+value, so non-streaming consumers see ordinary Expression semantics.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Iterator, List, Optional, Tuple
 
 
 _UNSET = object()
@@ -49,6 +58,129 @@ class DatasetExpression(Expression):
 
 class DatumExpression(Expression):
     """Wraps a (lazy) single datum (Expression.scala:23-30)."""
+
+
+# Chunk protocol: a stream yields ``(indices, payload)`` pairs. With
+# ``indices`` a list of positions in the original item order, ``payload``
+# is the list of per-item results for those positions (the union of all
+# indices is exactly range(n)). With ``indices is None`` the stage could
+# not stream and ``payload`` is the COMPLETE stage value verbatim — the
+# graceful fallback for device datasets and non-chunkable operators.
+Chunk = Tuple[Optional[List[int]], Any]
+
+
+class StreamingDatasetExpression(DatasetExpression):
+    """A dataset expression whose value arrives chunk-by-chunk.
+
+    ``chunks_thunk`` is called at most once; it returns an iterator of
+    `Chunk`s. ``iter_chunks()`` drains it while memoizing, so after a
+    full drain (or a ``.get``) the expression behaves exactly like a
+    forced `DatasetExpression` and later consumers re-chunk the cached
+    value. Interleaved partial drains by two consumers are a programming
+    error (execution is depth-first: a consumer drains fully inside its
+    own force) and raise instead of silently double-running the producer.
+    """
+
+    __slots__ = ("_chunks_thunk", "_draining", "_drained", "_live_iter",
+                 "_failed")
+
+    def __init__(self, chunks_thunk: Callable[[], Iterator[Chunk]]):
+        super().__init__(self._materialize)
+        self._chunks_thunk = chunks_thunk
+        self._draining = False
+        # Partial-drain bookkeeping: chunks already pulled from the
+        # producer, and the suspended producer iterator. A consumer that
+        # stops mid-stream (e.g. breaks out of PipelineResult.stream())
+        # must not cause a later force to RE-RUN the producer — the
+        # prefix replays from here and the live iterator resumes.
+        self._drained: List[Chunk] = []
+        self._live_iter: Optional[Iterator[Chunk]] = None
+        # A producer failure is STICKY: the generator is dead, so a
+        # later force must re-raise instead of silently assembling the
+        # truncated prefix as if it were the complete value.
+        self._failed: Optional[BaseException] = None
+
+    def _materialize(self):
+        for _ in self.iter_chunks():
+            pass
+        return self._value
+
+    def _assemble(self, indexed: List[Tuple[List[int], Any]]):
+        from ..data.dataset import HostDataset
+
+        n = sum(len(idxs) for idxs, _ in indexed)
+        out: List[Any] = [None] * n
+        for idxs, items in indexed:
+            for i, item in zip(idxs, items):
+                out[i] = item
+        return HostDataset(out)
+
+    def iter_chunks(self) -> Iterator[Chunk]:
+        """Yield chunks, memoizing the assembled value on completion.
+
+        ``chunks_thunk`` runs at most once even across interrupted
+        consumers: an early exit leaves the producer iterator suspended
+        and the pulled prefix cached, so the next ``iter_chunks()`` (or
+        a ``.get``) replays the prefix and resumes the iterator — no
+        chunk is ever dispatched twice."""
+        if self.is_forced:
+            # already materialized: one whole-value chunk from the cache
+            yield None, self._value
+            return
+        if self._failed is not None:
+            raise self._failed
+        if self._draining:
+            raise RuntimeError(
+                "StreamingDatasetExpression is already being drained; "
+                "interleaved chunk consumers are not supported"
+            )
+        self._draining = True
+        try:
+            for chunk in self._drained:  # replay a partial drain's prefix
+                yield chunk
+            if self._live_iter is None:
+                self._live_iter = self._chunks_thunk()
+            try:
+                for chunk in self._live_iter:
+                    self._drained.append(chunk)
+                    yield chunk
+            except GeneratorExit:
+                raise  # early close: prefix + live iterator stay resumable
+            except BaseException as e:
+                self._failed = e  # producer died; later forces re-raise
+                raise
+            indexed: List[Tuple[List[int], Any]] = []
+            whole = _UNSET
+            for idxs, payload in self._drained:
+                if idxs is None:
+                    whole = payload
+                else:
+                    indexed.append((idxs, payload))
+            self._value = whole if whole is not _UNSET else self._assemble(indexed)
+            self._thunk = None
+            self._chunks_thunk = None  # release captured state
+            self._live_iter = None
+            self._drained = []
+        finally:
+            self._draining = False
+
+    def map_chunks(
+        self,
+        chunk_fn: Callable[[List[Any]], List[Any]],
+        whole_fn: Callable[[Any], Any],
+    ) -> "StreamingDatasetExpression":
+        """Lazily apply a stage per chunk: ``chunk_fn`` maps a list of
+        items to the same-length list of results; ``whole_fn`` handles
+        the whole-value fallback chunk."""
+
+        def thunk():
+            for idxs, payload in self.iter_chunks():
+                if idxs is None:
+                    yield None, whole_fn(payload)
+                else:
+                    yield idxs, chunk_fn(payload)
+
+        return StreamingDatasetExpression(thunk)
 
 
 class TransformerExpression(Expression):

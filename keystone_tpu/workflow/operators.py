@@ -19,8 +19,52 @@ from .expressions import (
     DatasetExpression,
     DatumExpression,
     Expression,
+    StreamingDatasetExpression,
     TransformerExpression,
 )
+
+
+def _overlap_enabled() -> bool:
+    from .env import execution_config
+
+    return execution_config().overlap
+
+
+def _chunk_items(transformer, items: List[Any]) -> List[Any]:
+    """Run a chunkable transformer's batch path over one host chunk,
+    returning the per-item results as a plain list."""
+    from ..data.dataset import HostDataset
+
+    out = transformer.batch_transform([HostDataset(items)])
+    return list(out.items) if isinstance(out, HostDataset) else list(out)
+
+
+def _streamed_batch(transformer, dep: Expression):
+    """Per-chunk iterator for one transformer stage over one dependency.
+
+    Consumes the dependency chunk-by-chunk when it streams and the
+    transformer distributes over chunks (``chunkable``); produces a
+    fresh stream when the transformer has its own streaming batch path
+    (``batch_transform_stream``); otherwise yields the ordinary batch
+    result as a single whole-value chunk — so the expression type stays
+    uniform and laziness is preserved in every case.
+    """
+    if isinstance(dep, StreamingDatasetExpression) and getattr(
+        transformer, "chunkable", False
+    ):
+        for idxs, payload in dep.iter_chunks():
+            if idxs is None:
+                yield None, transformer.batch_transform([payload])
+            else:
+                yield idxs, _chunk_items(transformer, payload)
+        return
+    value = dep.get
+    stream_fn = getattr(transformer, "batch_transform_stream", None)
+    stream = stream_fn([value]) if stream_fn is not None else None
+    if stream is None:
+        yield None, transformer.batch_transform([value])
+    else:
+        yield from stream
 
 
 class Operator:
@@ -98,6 +142,16 @@ class TransformerOperator(Operator):
                 "all datums")
         if n_datum:
             return DatumExpression(lambda: self.single_transform([d.get for d in deps]))
+        if len(deps) == 1 and _overlap_enabled():
+            # Overlap engine: keep the chunk stream flowing through the
+            # graph. The stream thunk decides at FORCE time whether this
+            # operator consumes chunks, produces them, or falls back to
+            # one whole-value chunk, so laziness and the expression's
+            # dataset type are preserved either way.
+            dep = deps[0]
+            return StreamingDatasetExpression(
+                lambda: _streamed_batch(self, dep)
+            )
         return DatasetExpression(lambda: self.batch_transform([d.get for d in deps]))
 
 
@@ -137,6 +191,15 @@ class DelegatingOperator(Operator):
         if n_datum:
             return DatumExpression(
                 lambda: transformer_expr.get.single_transform([d.get for d in data_deps])
+            )
+        if len(data_deps) == 1 and _overlap_enabled():
+            # The fitted transformer exists only at force time, so the
+            # chunk-capability check lives inside the stream thunk;
+            # forcing the transformer expression here would run the fit
+            # eagerly and break estimator laziness.
+            dep = data_deps[0]
+            return StreamingDatasetExpression(
+                lambda: _streamed_batch(transformer_expr.get, dep)
             )
         return DatasetExpression(
             lambda: transformer_expr.get.batch_transform([d.get for d in data_deps])

@@ -54,6 +54,15 @@ class PipelineResult:
     def get(self):
         return self.executor.execute(self.sink).get
 
+    def stream(self):
+        """Consume the result chunk-by-chunk (overlap engine): yields
+        ``(indices, items)`` as the terminal stage drains, or a single
+        ``(None, value)`` chunk when the pipeline doesn't stream.
+        Drained chunks are memoized: after a full drain a later
+        ``.get()`` is free, and after an early exit it resumes the
+        remaining chunks without re-running the ones already seen."""
+        return self.executor.execute_stream(self.sink)
+
 
 class PipelineDataset(PipelineResult):
     """Lazy distributed dataset result (PipelineDataset.scala:10-23)."""
@@ -308,10 +317,35 @@ class Transformer(TransformerOperator, Chainable):
     """Per-item function with a default vectorized bulk path
     (Transformer.scala:18-70). Subclasses implement `apply(x)`; override
     `apply_batch` when a fused whole-batch implementation exists (e.g. a
-    single GEMM for a linear model)."""
+    single GEMM for a linear model).
+
+    Overlap-engine hooks: ``chunkable = True`` declares that the host
+    batch path distributes over chunks (f(concat(chunks)) ==
+    concat(f(chunks)) with host items in, host items out), letting this
+    stage consume an upstream chunk stream as it drains;
+    ``apply_batch_stream`` (return an iterator of ``(indices, results)``
+    chunks, or None) makes the stage a stream *producer* — the bucketed
+    host-batch dispatchers (SIFT, grid descriptors) yield each chunk as
+    it comes off the device instead of materializing the whole stage.
+    """
+
+    chunkable = False
 
     def apply(self, x: Any) -> Any:
         raise NotImplementedError
+
+    def apply_batch_stream(self, data: Any):
+        """Optional streaming batch path over a HostDataset; None means
+        'no streaming implementation' (the operator layer falls back to
+        one whole-value chunk)."""
+        return None
+
+    def batch_transform_stream(self, inputs: List[Any]):
+        from ..data.dataset import HostDataset
+
+        if isinstance(inputs[0], HostDataset):
+            return self.apply_batch_stream(inputs[0])
+        return None
 
     def apply_batch(self, data: Any) -> Any:
         from ..data.dataset import Dataset, HostDataset
@@ -360,6 +394,8 @@ class Transformer(TransformerOperator, Chainable):
 
 
 class _FunctionTransformer(Transformer):
+    chunkable = True  # pure per-item fn: distributes over chunks
+
     def __init__(self, fn: Callable[[Any], Any]):
         self.fn = fn
         self._label = None
@@ -437,6 +473,10 @@ class LabelEstimator(EstimatorOperator, Chainable):
 class TransformerChain(Transformer):
     def __init__(self, stages: Sequence[Transformer]):
         self.stages = list(stages)
+
+    @property
+    def chunkable(self) -> bool:  # a chain distributes iff every stage does
+        return all(getattr(s, "chunkable", False) for s in self.stages)
 
     @property
     def label(self) -> str:

@@ -296,7 +296,7 @@ def test_bench_partial_record_ranking():
     assert bench.pick_better_partial(d_krr, d_krr2) is d_krr2
     # every tier the child emits is ranked (completeness ordering)
     emitted = ["headline", "staged", "flagship", "featurize_tier",
-               "krr_tier", "complete"]
+               "krr_tier", "overlap_tier", "complete"]
     ranks = [bench.PROGRESS_RANK[p] for p in emitted]
     assert ranks == sorted(ranks) and len(set(ranks)) == len(ranks)
 
@@ -319,3 +319,33 @@ def test_bench_tier_errors_surface_and_never_persist():
     ok = dict(base, flagship_bcd_d8192={"fit_seconds": 1.0})
     rec, persist = bench.finalize_record(ok)
     assert persist and "error" not in rec
+
+
+def test_bench_tier_error_scan_ignores_informational_payloads():
+    """The error scan is restricted to the known tier keys: a future
+    informational dict that happens to carry an "error" field (e.g. a
+    diagnostics payload) must NOT block persistence — only real tier
+    payloads gate the record."""
+    bench = _load_bench()
+
+    base = {"images_per_sec": 1000.0, "test_accuracy": 0.85,
+            "accuracy_band": [0.72, 0.96], "platform": "tpu",
+            "accuracy_in_band": True,
+            # informational payloads with an embedded "error" field
+            "tunnel_diagnostics": {"error": "transient wedge at 03:12"},
+            "north_star": {"target_accuracy": 0.84, "accuracy_ok": True,
+                           "error": "informational only"},
+            # healthy real tiers
+            "flagship_krr": {"fit_seconds": 1.0},
+            "featurize_overlap": {"serial_seconds": 2.0,
+                                  "overlapped_seconds": 1.0}}
+    rec, persist = bench.finalize_record(base)
+    assert persist and "error" not in rec
+    # a real tier key carrying an error still gates
+    bad = dict(base, featurize_overlap={"error": "ValueError: nope"})
+    rec, persist = bench.finalize_record(bad)
+    assert not persist and "featurize_overlap" in rec["error"]
+    # every gating key the child can emit is covered by the scan list
+    assert set(bench.TIER_KEYS) == {
+        "flagship_bcd_d8192", "flagship_featurize", "flagship_krr",
+        "featurize_overlap", "fused"}

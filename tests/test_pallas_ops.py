@@ -288,3 +288,66 @@ def test_fused_conv_canary_demotes_compile_failures(monkeypatch):
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
     assert pk._fused_conv_canary and list(
         pk._fused_conv_canary.values()) == [True]
+
+
+def test_fused_conv_canary_multihost_verdict_is_broadcast(monkeypatch):
+    """In a multi-process job a transient blip can hit only SOME hosts,
+    leaving them with different local canary verdicts and therefore
+    divergent compiled programs in a collective launch. With
+    process_count > 1 every process must adopt process 0's verdict
+    (broadcast), with no per-process transient-retry marker. (The
+    single-process retry fallback is covered by
+    test_fused_conv_canary_demotes_compile_failures.)"""
+    import jax
+    from jax.experimental import multihost_utils
+
+    import keystone_tpu.ops.pallas_kernels as pk
+
+    rng = np.random.default_rng(6)
+    imgs = jnp.asarray(rng.random(size=(2, 16, 16, 3)).astype(np.float32))
+    kern = jnp.asarray(rng.normal(size=(5, 5, 3, 8)).astype(np.float32))
+    colsum = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+    bias = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+
+    calls = {"n": 0}
+    broadcasts = []
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("transient blip (simulated)")
+
+    def fake_broadcast(x):
+        # this process plays the non-0 host: process 0's verdict (False
+        # here — it also failed) comes back regardless of local state
+        broadcasts.append(bool(np.asarray(x)))
+        return np.asarray(False)
+
+    monkeypatch.setattr(pk, "use_fused_conv", lambda: True)
+    monkeypatch.setattr(pk, "conv_rectify_pool_pallas", boom)
+    monkeypatch.setattr(pk, "_fused_conv_canary", {})
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "broadcast_one_to_all", fake_broadcast)
+
+    want = np.asarray(pk.conv_rectify_pool_reference(
+        imgs, kern, colsum, bias, 0.1, 0.0, 5, 4, True))
+    for _ in range(3):
+        got = np.asarray(pk.conv_rectify_pool(
+            imgs, kern, colsum, bias, 0.1, 0.0, 5, 4, True))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    # ONE local attempt, ONE broadcast, then a permanent cached verdict
+    # — never the transient retry marker that made verdicts process-local
+    assert calls["n"] == 1, calls["n"]
+    assert broadcasts == [False]
+    assert list(pk._fused_conv_canary.values()) == [False]
+
+    # a host whose local canary PASSES must still adopt process 0's
+    # failing verdict (the divergence the broadcast exists to close)
+    pk._fused_conv_canary.clear()
+    monkeypatch.setattr(pk, "conv_rectify_pool_pallas",
+                        lambda *a, **k: jnp.zeros((2, 2, 2, 8)))
+    got = np.asarray(pk.conv_rectify_pool(
+        imgs, kern, colsum, bias, 0.1, 0.0, 5, 4, True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert broadcasts[-1] is True  # local verdict was pass...
+    assert list(pk._fused_conv_canary.values()) == [False]  # ...p0 wins

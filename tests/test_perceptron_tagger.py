@@ -156,6 +156,18 @@ def test_lemmatizer_rules_and_exceptions():
     assert _lemma("walked") == "walk"
     assert _lemma("sizes") == "size"         # -zes: -ze stem class
     assert _lemma("prizes") == "prize"
+    # -z silent-e restore requires a preceding vowel: a consonant
+    # cluster before the z never dropped an e
+    assert _lemma("sized") == "size"         # vowel+z -> restore
+    assert _lemma("dozed") == "doze"
+    assert _lemma("analyzed") == "analyze"   # y counts as the vowel
+    assert _lemma("paralyzed") == "paralyze"
+    assert _lemma("waltzed") == "waltz"      # consonant+z -> keep
+    assert _lemma("waltzing") == "waltz"
+    assert _lemma("blitzed") == "blitz"
+    # v-final stays unconditional regardless of the preceding letter
+    assert _lemma("carved") == "carve"
+    assert _lemma("served") == "serve"
     # invariants the rules must NOT mangle
     assert _lemma("news") == "news"
     assert _lemma("species") == "species"

@@ -515,3 +515,141 @@ def test_sparse_lbfgs_iterative_dp_sharded_agrees():
     Wref, bref = ridge_closed_form(dense, Y, 1.0)
     np.testing.assert_allclose(np.asarray(m_mesh.W), Wref, atol=5e-2, rtol=5e-2)
     np.testing.assert_allclose(np.asarray(m_mesh.b), bref, atol=5e-2)
+
+
+# --------------------------------------------------------------------------
+# Donated solver buffers (overlap engine PR): the host-looped steps with
+# donate_argnums must produce fits identical to the single-program scan
+# forms they replaced (the pre-change solvers, kept as the numerics
+# reference / fused-pipeline path).
+
+
+def test_bcd_donated_epochs_match_scan_form(problem):
+    """BlockLeastSquaresEstimator now loops a donated `_bcd_epoch`; the
+    result must be allclose-identical to the one-program `_bcd_fit` scan
+    (same block_step arithmetic, same op order)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.learning.block_ls import _bcd_fit
+
+    X, Y = problem
+    for bs, iters, center in ((8, 3, True), (7, 2, False)):
+        est = BlockLeastSquaresEstimator(
+            block_size=bs, num_iter=iters, lam=0.5, fit_intercept=center)
+        data, labels = Dataset(X), Dataset(Y)
+        model = est.fit(data, labels)
+        nb = -(-X.shape[1] // bs)
+        d_pad = nb * bs
+        Xp = data.array
+        if d_pad != X.shape[1]:
+            Xp = jnp.pad(Xp, [(0, 0), (0, d_pad - X.shape[1])])
+        Wref, bref = _bcd_fit(
+            Xp, labels.array, data.mask.astype(Xp.dtype),
+            jnp.asarray(0.5, Xp.dtype), bs, nb, iters, center,
+            x_sharding=None,
+        )
+        np.testing.assert_allclose(
+            np.asarray(model.W), np.asarray(Wref), atol=1e-5, rtol=1e-5)
+        if center:
+            np.testing.assert_allclose(
+                np.asarray(model.b), np.asarray(bref), atol=1e-5, rtol=1e-5)
+
+
+def test_lbfgs_donated_steps_match_scan_form(problem):
+    """DenseLBFGSwithL2 now loops a donated `_lbfgs_step`; must be
+    allclose-identical to the one-program `_lbfgs_fit` scan."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.nodes.learning import DenseLBFGSwithL2
+    from keystone_tpu.nodes.learning.lbfgs import _lbfgs_fit
+
+    X, Y = problem
+    for intercept in (True, False):
+        est = DenseLBFGSwithL2(
+            lam=3.0, num_iters=25, fit_intercept=intercept)
+        data, labels = Dataset(X), Dataset(Y)
+        model = est.fit(data, labels)
+        Wref, bref, values = _lbfgs_fit(
+            data.array, labels.array, data.mask.astype(np.float32),
+            jnp.asarray(3.0, jnp.float32),
+            jnp.asarray(data.count, jnp.float32),
+            25, 10, intercept, x_sharding=None,
+        )
+        np.testing.assert_allclose(
+            np.asarray(model.W), np.asarray(Wref), atol=1e-4, rtol=1e-4)
+        if intercept:
+            np.testing.assert_allclose(
+                np.asarray(model.b), np.asarray(bref), atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(est.loss_history), np.asarray(values),
+            atol=1e-3, rtol=1e-5)
+
+
+def test_krr_donated_step_matches_undonated_reference():
+    """`_krr_step` donates (alpha, KA); one step must equal the same
+    update computed without donation, and the fit loop's rebinding
+    discipline must keep multi-step fits identical to a hand-rolled
+    undonated Gauss-Seidel loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.nodes.learning.kernels import (
+        KernelRidgeRegression,
+        _krr_step,
+        _rbf_block,
+    )
+
+    rng = np.random.default_rng(7)
+    n, d, k = 64, 6, 2
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    data, labels = Dataset(X), Dataset(Y)
+
+    est = KernelRidgeRegression(gamma=0.5, lam=0.1, block_size=16,
+                                num_epochs=2, seed=3)
+    model = est.fit(data, labels)
+
+    # hand-rolled undonated reference replaying the same block orders
+    n_pad = data.padded_count
+    mask = np.asarray(data.mask).astype(np.float32)
+    Xp = np.asarray(data.array)
+    Yp = np.asarray(labels.array) * mask[:, None]
+    alpha = np.zeros((n_pad, k), np.float32)
+    KA = np.zeros_like(alpha)
+    B = 16
+    n_blocks = -(-data.count // B)
+    for epoch in range(2):
+        perm = np.random.default_rng(3 + epoch).permutation(data.count)
+        pad = (-len(perm)) % (n_blocks * B)
+        ids = np.concatenate([perm, perm[:pad]]) if pad else perm
+        for b in range(n_blocks):
+            blk = ids[b * B : (b + 1) * B]
+            Kb = np.asarray(
+                _rbf_block(jnp.asarray(Xp), jnp.asarray(Xp[blk]), 0.5)
+            ) * mask[:, None]
+            Kbb = Kb[blk]
+            resid = Yp[blk] - KA[blk] - 0.1 * alpha[blk]
+            delta = np.linalg.solve(Kbb + 0.1 * np.eye(B, dtype=np.float32),
+                                    resid)
+            alpha[blk] += delta
+            KA = KA + Kb @ delta
+    np.testing.assert_allclose(
+        np.asarray(model.alpha), alpha, atol=1e-3, rtol=1e-3)
+
+    # single donated step vs an undonated jit of the same update
+    alpha0 = jnp.zeros((n_pad, k), jnp.float32)
+    KA0 = jnp.zeros_like(alpha0)
+    blk = jnp.arange(16, dtype=jnp.int32)
+    a1, K1 = _krr_step(
+        jnp.asarray(Xp), jnp.asarray(Yp), jnp.asarray(mask),
+        alpha0, KA0, jnp.float32(0.1), 0.5, blk, False)
+    undonated = jax.jit(
+        _krr_step.__wrapped__, static_argnames=("gamma", "use_pal"))
+    a2, K2 = undonated(
+        jnp.asarray(Xp), jnp.asarray(Yp), jnp.asarray(mask),
+        jnp.zeros((n_pad, k), jnp.float32),
+        jnp.zeros((n_pad, k), jnp.float32),
+        jnp.float32(0.1), 0.5, blk, False)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(K1), np.asarray(K2), atol=1e-6)
