@@ -1,0 +1,130 @@
+"""Static pipeline analyzer — verify pipelines abstractly, before any
+data loads.
+
+KeystoneML's optimizer reasons about the whole DAG before execution; this
+package extends that discipline from topology to *semantics*: abstract
+shape/dtype propagation (`jax.eval_shape` traces, zero data movement),
+static memory estimation against an HBM budget, and donation/streaming
+hazard lints. A shape mismatch, HBM blowup, or donated-buffer aliasing
+bug fails in milliseconds here instead of minutes into a TPU job.
+
+Entry points:
+
+  - ``Pipeline.validate(source_spec, level=...)`` — the user-facing API.
+  - ``validate_graph(graph, source_specs, ...)`` — the graph-level core.
+  - ``python -m keystone_tpu.analysis`` — CLI validating every example
+    pipeline in `keystone_tpu/pipelines/` with synthetic specs.
+  - `GraphExecutor` runs the structural tier automatically before the
+    first force.
+
+Levels are cumulative: ``"structure"`` (topology lints only) ⊂
+``"specs"`` (+ shape/dtype propagation) ⊂ ``"memory"`` (+ live-memory
+estimates) ⊂ ``"full"`` (+ donation/streaming hazards). Rule ids and the
+suppression story are documented in ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+from .diagnostics import (
+    RULES,
+    Diagnostic,
+    PipelineValidationError,
+    Severity,
+    ValidationReport,
+)
+from .hazards import hazard_pass
+from .memory import DEFAULT_CHUNK_ROWS, MemoryEstimate, memory_pass
+from .propagate import spec_pass, structural_pass, toposort
+from .specs import (
+    UNKNOWN,
+    DataSpec,
+    SpecDataset,
+    SpecMismatchError,
+    TransformerSpec,
+    as_source_spec,
+    element_nbytes,
+    shape_struct,
+    spec_of,
+)
+
+LEVELS = ("structure", "specs", "memory", "full")
+
+
+def validate_graph(
+    graph,
+    source_specs: Optional[Dict] = None,
+    *,
+    level: str = "full",
+    ignore: Iterable[str] = (),
+    hbm_budget_bytes: Optional[int] = None,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+) -> ValidationReport:
+    """Run the analyzer tiers up to ``level`` over a lowered graph.
+
+    ``source_specs`` maps each unbound `SourceId` to its abstract input
+    spec (anything `as_source_spec` accepts); unlisted sources propagate
+    UNKNOWN. Never touches data or devices."""
+    if level not in LEVELS:
+        raise ValueError(f"level must be one of {LEVELS}, got {level!r}")
+    tier = LEVELS.index(level)
+
+    diags = list(structural_pass(graph))
+    specs: Dict = {}
+    memory: Optional[MemoryEstimate] = None
+
+    if tier >= 1:
+        normalized = {
+            src: as_source_spec(s) for src, s in (source_specs or {}).items()
+        }
+        specs, spec_diags = spec_pass(graph, normalized)
+        # toposort cycle errors already reported by the structural pass
+        diags.extend(d for d in spec_diags if d.rule != "KP001")
+    if tier >= 2:
+        memory, mem_diags = memory_pass(
+            graph, specs, hbm_budget_bytes=hbm_budget_bytes,
+            chunk_rows=chunk_rows)
+        diags.extend(mem_diags)
+    if tier >= 3:
+        from ..workflow.env import execution_config
+
+        diags.extend(hazard_pass(
+            graph, specs, overlap=execution_config().overlap))
+
+    report = ValidationReport(diags, specs=specs, memory=memory, level=level)
+    return report.filter(ignore) if ignore else report
+
+
+def structural_report(graph) -> ValidationReport:
+    """Structure tier only — the cheap O(V+E) gate `GraphExecutor` runs
+    before the first force."""
+    return ValidationReport(structural_pass(graph), level="structure")
+
+
+__all__ = [
+    "DEFAULT_CHUNK_ROWS",
+    "DataSpec",
+    "Diagnostic",
+    "LEVELS",
+    "MemoryEstimate",
+    "PipelineValidationError",
+    "RULES",
+    "Severity",
+    "SpecDataset",
+    "SpecMismatchError",
+    "TransformerSpec",
+    "UNKNOWN",
+    "ValidationReport",
+    "as_source_spec",
+    "element_nbytes",
+    "hazard_pass",
+    "memory_pass",
+    "shape_struct",
+    "spec_of",
+    "spec_pass",
+    "structural_pass",
+    "structural_report",
+    "toposort",
+    "validate_graph",
+]

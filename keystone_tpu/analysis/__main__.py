@@ -1,0 +1,78 @@
+"""CLI: statically validate the example pipelines.
+
+    python -m keystone_tpu.analysis                 # all examples, level=full
+    python -m keystone_tpu.analysis MnistRandomFFT  # one example
+    python -m keystone_tpu.analysis --level specs --hbm-budget-gb 16
+    python -m keystone_tpu.analysis --list-rules
+
+Exit code 1 if any example produces ERROR-severity findings (or any
+finding at all with ``--strict``). Runs entirely abstractly — no data
+loads, no device programs execute.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import LEVELS, RULES, Severity, validate_graph
+from .examples import EXAMPLES, build_example
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m keystone_tpu.analysis", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("examples", nargs="*", metavar="EXAMPLE",
+                   help="example names (default: all registered)")
+    p.add_argument("--level", choices=LEVELS, default="full")
+    p.add_argument("--hbm-budget-gb", type=float, default=None,
+                   help="HBM budget for KP201/KP202 (GiB)")
+    p.add_argument("--ignore", action="append", default=[], metavar="RULE",
+                   help="suppress a rule id (repeatable)")
+    p.add_argument("--strict", action="store_true",
+                   help="fail on warnings too")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    names = args.examples or sorted(EXAMPLES)
+    unknown = [n for n in names if n not in EXAMPLES]
+    if unknown:
+        print(f"unknown example(s): {', '.join(unknown)}; "
+              f"known: {', '.join(sorted(EXAMPLES))}", file=sys.stderr)
+        return 2
+
+    budget = (int(args.hbm_budget_gb * (1 << 30))
+              if args.hbm_budget_gb else None)
+    failed = False
+    for name in names:
+        try:
+            pipeline, source_spec = build_example(name)
+            report = pipeline.validate(
+                source_spec, level=args.level, ignore=args.ignore,
+                hbm_budget_bytes=budget, raise_on_error=False)
+        except Exception as e:  # a factory bug is a failure, not a crash
+            print(f"✗ {name}: failed to build/validate: "
+                  f"{type(e).__name__}: {e}")
+            failed = True
+            continue
+        bad = bool(report.errors) or (args.strict and report.warnings)
+        mark = "✗" if bad else "✓"
+        print(f"{mark} {name}: {len(report.errors)} error(s), "
+              f"{len(report.warnings)} warning(s)"
+              + (f", peak ≈ {report.memory.peak_bytes >> 20} MiB"
+                 if report.memory and report.memory.peak_bytes else ""))
+        for d in report.diagnostics:
+            if d.severity >= Severity.WARNING or args.strict:
+                print(f"    {d}")
+        failed |= bad
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

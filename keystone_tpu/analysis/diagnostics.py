@@ -1,0 +1,142 @@
+"""Diagnostics emitted by the static pipeline analyzer.
+
+Every finding is a `Diagnostic` with a stable rule id (documented in
+ANALYSIS.md), a severity, and the graph vertex it anchors to. Diagnostics
+key on ``{operator.label}@{vertex}`` — operator labels are audited to be
+stable and unique per node (tests/test_analysis.py), so a rule id +
+anchor is a reproducible address for suppression and triage.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Iterable, List, Optional, Sequence
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+
+#: rule id -> one-line description (ANALYSIS.md holds the full docs).
+RULES = {
+    # structural tier
+    "KP001": "cycle: the graph contains a dependency cycle",
+    "KP002": "arity: an operator has the wrong number of dependencies",
+    "KP003": "fit-before-use: an estimator's output is consumed as data",
+    "KP004": "delegate-without-estimator: a DelegatingOperator's first "
+             "dependency does not produce a transformer",
+    "KP005": "dangling-source: a source has no consumers",
+    # spec tier
+    "KP101": "shape-mismatch: abstract tracing proved a stage cannot run "
+             "on its input shapes/dtypes",
+    "KP102": "count-mismatch: sibling datasets disagree on example count",
+    # memory tier
+    "KP201": "node-hbm: one node's materialized output exceeds the HBM budget",
+    "KP202": "peak-hbm: peak live memory across the schedule exceeds the "
+             "HBM budget",
+    "KP203": "overlap-amplification: prefetch depth multiplies a streaming "
+             "stage's resident footprint",
+    # hazard tier
+    "KP301": "donation-reuse: a buffer donated by one consumer is still "
+             "reachable by another sink",
+    "KP302": "stream-materialization: a streaming stage feeds a "
+             "non-chunkable operator, silently materializing the stream",
+    "KP303": "cache-on-stream: a cache node on a streaming stage "
+             "materializes the stream and defeats overlap",
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    rule: str
+    severity: Severity
+    message: str
+    vertex: Optional[Any] = None  # GraphId
+    label: str = ""
+
+    @property
+    def anchor(self) -> str:
+        """Stable diagnostic key: ``label@vertex``."""
+        if self.vertex is None:
+            return self.label or "<graph>"
+        return f"{self.label}@{self.vertex}" if self.label else str(self.vertex)
+
+    def __str__(self) -> str:
+        return f"[{self.severity.name}] {self.rule} {self.anchor}: {self.message}"
+
+
+class ValidationReport:
+    """The analyzer's result: diagnostics plus (when the spec/memory
+    tiers ran) the per-vertex specs and the memory estimate."""
+
+    def __init__(
+        self,
+        diagnostics: Sequence[Diagnostic],
+        specs: Optional[dict] = None,
+        memory: Optional[Any] = None,
+        level: str = "structure",
+    ):
+        self.diagnostics: List[Diagnostic] = list(diagnostics)
+        self.specs = specs or {}
+        self.memory = memory
+        self.level = level
+
+    # ------------------------------------------------------------- views
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.WARNING]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def by_rule(self, rule: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.rule == rule]
+
+    def filter(self, ignore: Iterable[str]) -> "ValidationReport":
+        """Drop diagnostics whose rule id is in ``ignore`` (the
+        `validate(ignore=[...])` suppression channel)."""
+        ignore = set(ignore)
+        return ValidationReport(
+            [d for d in self.diagnostics if d.rule not in ignore],
+            specs=self.specs, memory=self.memory, level=self.level,
+        )
+
+    def raise_for_errors(self) -> "ValidationReport":
+        if self.errors:
+            raise PipelineValidationError(self)
+        return self
+
+    def __str__(self) -> str:
+        head = (
+            f"pipeline validation [{self.level}]: "
+            f"{len(self.errors)} error(s), {len(self.warnings)} warning(s)"
+        )
+        if not self.diagnostics:
+            return head
+        return head + "\n" + "\n".join(f"  {d}" for d in self.diagnostics)
+
+    def __repr__(self) -> str:
+        return (
+            f"ValidationReport(level={self.level!r}, "
+            f"errors={len(self.errors)}, warnings={len(self.warnings)})"
+        )
+
+
+class PipelineValidationError(ValueError):
+    """Static validation rejected the pipeline before any data loaded.
+
+    Subclasses ValueError so call sites treating malformed graphs as
+    value errors (the pre-analyzer contract) keep working."""
+
+    def __init__(self, report: ValidationReport):
+        super().__init__(str(report))
+        self.report = report

@@ -1,0 +1,32 @@
+"""Registry of statically-analyzable example pipelines.
+
+Every example app in `keystone_tpu/pipelines/` exposes an
+``analyzable()`` factory building its full predictor graph over abstract
+placeholder data (`SpecDataset`) — no data loads, no fits run. The CLI
+(`python -m keystone_tpu.analysis`) and the tier-1 parametrized test
+validate each one, so a refactor that breaks an example's wiring or
+shape contract fails the lint gate in milliseconds.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict, Tuple
+
+#: name -> (module, factory attr). Factories return (pipeline, source_spec).
+EXAMPLES: Dict[str, Tuple[str, str]] = {
+    "MnistRandomFFT": ("keystone_tpu.pipelines.mnist_random_fft", "analyzable"),
+    "RandomPatchCifar": ("keystone_tpu.pipelines.random_patch_cifar", "analyzable"),
+    "LinearPixels": ("keystone_tpu.pipelines.cifar_variants", "analyzable"),
+    "TimitPipeline": ("keystone_tpu.pipelines.timit", "analyzable"),
+    "NewsgroupsPipeline": ("keystone_tpu.pipelines.text_pipelines", "analyzable"),
+    "VOCSIFTFisher": ("keystone_tpu.pipelines.voc_sift_fisher", "analyzable"),
+    "ImageNetSiftLcsFV": ("keystone_tpu.pipelines.imagenet_sift_lcs_fv", "analyzable"),
+}
+
+
+def build_example(name: str):
+    """Build one registered example: returns ``(pipeline, source_spec)``."""
+    module, attr = EXAMPLES[name]
+    factory: Callable = getattr(importlib.import_module(module), attr)
+    return factory()

@@ -1,0 +1,172 @@
+"""Static per-node and peak live-memory estimation.
+
+Follows the compile-time memory analysis of "Memory Safe Computations
+with XLA Compiler" (arxiv 2206.14148): with every vertex's abstract spec
+known (shape × dtype × count), walk the execution schedule and track the
+live set — a vertex's output is resident from the step that produces it
+until its last consumer has run. The peak of that walk is the static
+HBM/host-RAM watermark, available in milliseconds before any data loads.
+
+The overlap engine changes residency: a streaming stage never
+materializes — at most ``2·prefetch_depth + 2`` chunks are in flight
+(utils/batching.py's documented bound) — but prefetch *amplifies* the
+chunk footprint by that same factor. Both effects are modeled: streaming
+stages get the chunk-resident discount and a KP203 note when the
+amplified footprint is a meaningful share of the budget.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..workflow.graph import Graph, GraphId, NodeId, SinkId, SourceId
+from .diagnostics import Diagnostic, Severity
+from .propagate import _label, toposort
+from .specs import DataSpec, element_nbytes, is_known
+
+#: Default chunk row-count assumed for streaming stages — matches
+#: `utils.batching.map_host_batched`'s default ``chunk=256``.
+DEFAULT_CHUNK_ROWS = 256
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _may_stream(op) -> bool:
+    """Statically: could this operator's output arrive chunk-by-chunk
+    under the overlap engine? True for declared stream producers
+    (overridden ``apply_batch_stream``/``batch_transform_stream``) and
+    chunk-passthrough stages (``chunkable``)."""
+    if getattr(op, "chunkable", False):
+        return True
+    from ..workflow.pipeline import Transformer
+
+    fn = getattr(type(op), "apply_batch_stream", None)
+    return fn is not None and fn is not Transformer.apply_batch_stream
+
+
+@dataclass
+class MemoryEstimate:
+    """Static memory picture of one graph."""
+
+    per_node: Dict[NodeId, Optional[int]] = field(default_factory=dict)
+    resident: Dict[NodeId, Optional[int]] = field(default_factory=dict)
+    peak_bytes: int = 0
+    peak_at: Optional[GraphId] = None
+    unknown_nodes: int = 0
+
+    def __repr__(self) -> str:
+        return (
+            f"MemoryEstimate(peak={_fmt_bytes(self.peak_bytes)} at "
+            f"{self.peak_at}, {self.unknown_nodes} unknown node(s))"
+        )
+
+
+def memory_pass(
+    graph: Graph,
+    specs: Dict[GraphId, Any],
+    *,
+    hbm_budget_bytes: Optional[int] = None,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    prefetch_depth: Optional[int] = None,
+    overlap: Optional[bool] = None,
+) -> Tuple[MemoryEstimate, List[Diagnostic]]:
+    from ..workflow.env import execution_config
+
+    cfg = execution_config()
+    if prefetch_depth is None:
+        prefetch_depth = cfg.prefetch_depth
+    if overlap is None:
+        overlap = cfg.overlap
+    if hbm_budget_bytes is None:
+        hbm_budget_bytes = cfg.hbm_budget_bytes
+    inflight_chunks = 2 * prefetch_depth + 2  # utils/batching.py bound
+
+    order, _ = toposort(graph)
+    sched_pos = {v: i for i, v in enumerate(order)}
+    est = MemoryEstimate()
+    diags: List[Diagnostic] = []
+
+    # Residency per produced vertex: full bytes, discounted for streaming.
+    for vid in order:
+        if not isinstance(vid, NodeId):
+            continue
+        spec = specs.get(vid)
+        op = graph.get_operator(vid)
+        full = spec.nbytes if isinstance(spec, DataSpec) else None
+        est.per_node[vid] = full
+        if full is None:
+            est.unknown_nodes += 1
+            est.resident[vid] = None
+            continue
+        resident = full
+        if overlap and isinstance(spec, DataSpec) and spec.kind == "dataset" \
+                and (spec.streaming or _may_stream(op)):
+            per_elem = element_nbytes(spec.element)
+            if per_elem is not None:
+                chunk_bytes = per_elem * chunk_rows * inflight_chunks
+                if chunk_bytes < full:
+                    resident = chunk_bytes
+                    if hbm_budget_bytes and chunk_bytes > hbm_budget_bytes // 20:
+                        diags.append(Diagnostic(
+                            "KP203", Severity.INFO,
+                            f"overlap amplification: {inflight_chunks} "
+                            f"in-flight chunks × {_fmt_bytes(per_elem * chunk_rows)}"
+                            f"/chunk = {_fmt_bytes(chunk_bytes)} resident "
+                            f"(prefetch_depth={prefetch_depth})",
+                            vertex=vid, label=_label(graph, vid)))
+        est.resident[vid] = resident
+
+        if hbm_budget_bytes and full > hbm_budget_bytes:
+            diags.append(Diagnostic(
+                "KP201", Severity.WARNING,
+                f"materialized output is {_fmt_bytes(full)}, over the "
+                f"{_fmt_bytes(hbm_budget_bytes)} HBM budget"
+                + (" (streams under overlap, resident "
+                   f"{_fmt_bytes(resident)})" if resident < full else ""),
+                vertex=vid, label=_label(graph, vid)))
+
+    # Live-set walk: vertex output is live from production through its
+    # last consumer's schedule position (sinks pin their dep forever).
+    last_use: Dict[NodeId, int] = {}
+    pinned: set = set()
+    for vid in est.per_node:
+        users = graph.users_of(vid)
+        if any(isinstance(u, SinkId) for u in users):
+            pinned.add(vid)
+        last_use[vid] = max(
+            (sched_pos[u] for u in users if u in sched_pos),
+            default=sched_pos[vid],
+        )
+
+    live = 0
+    expiring: Dict[int, List[NodeId]] = {}
+    for vid, end in last_use.items():
+        expiring.setdefault(end, []).append(vid)
+    for i, v in enumerate(order):
+        if isinstance(v, NodeId) and est.resident.get(v) is not None:
+            live += est.resident[v]
+            if live > est.peak_bytes:
+                est.peak_bytes, est.peak_at = live, v
+        for dead in expiring.get(i, ()):
+            if dead not in pinned and est.resident.get(dead) is not None:
+                live -= est.resident[dead]
+
+    if hbm_budget_bytes and est.peak_bytes > hbm_budget_bytes:
+        diags.append(Diagnostic(
+            "KP202", Severity.WARNING,
+            f"peak live memory {_fmt_bytes(est.peak_bytes)} exceeds the "
+            f"{_fmt_bytes(hbm_budget_bytes)} HBM budget (peak at "
+            f"{_label(graph, est.peak_at)}@{est.peak_at})"
+            + (f"; {est.unknown_nodes} node(s) unestimated"
+               if est.unknown_nodes else ""),
+            vertex=est.peak_at, label=_label(graph, est.peak_at)))
+    return est, diags

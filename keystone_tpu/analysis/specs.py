@@ -1,0 +1,360 @@
+"""Abstract value specs flowing through the static pipeline analyzer.
+
+The analyzer (see `propagate.py`) walks a lowered `Graph` in topological
+order and assigns each vertex a *spec* — an abstract description of the
+Expression the vertex would produce at force time, without touching any
+data. Specs follow the static-compilation discipline of arxiv 1810.09868
+(abstract interpretation of the whole program before any device work) and
+are deliberately tiny:
+
+  - ``DataSpec``     — a dataset or datum: a pytree of
+    `jax.ShapeDtypeStruct` element specs plus an example count. This is
+    exactly what `jax.eval_shape` consumes and produces, so spec
+    propagation through dense transformers is a zero-FLOP trace.
+  - ``TransformerSpec`` — the output of an estimator node: an abstract
+    fitted transformer, optionally carrying an element→element shape
+    function so the downstream apply's output spec is known before the
+    fit ever runs.
+  - ``UNKNOWN``      — the honest bottom: host objects (strings, token
+    lists, variable-size images) and untraceable stages propagate
+    UNKNOWN instead of guessing. Unknown in, unknown out — never an
+    error by itself.
+
+This module intentionally imports nothing from `workflow` so operator
+classes can import it lazily without cycles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class _Unknown:
+    """Singleton bottom spec: 'statically unknowable, not an error'."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "UNKNOWN"
+
+    def __reduce__(self):
+        return (_Unknown, ())
+
+
+UNKNOWN = _Unknown()
+
+
+class SpecMismatchError(Exception):
+    """An abstract-eval hook proved the pipeline cannot run: shapes,
+    dtypes, counts, or arity are inconsistent. Carries the analyzer rule
+    id so `propagate` files the diagnostic under the right lint."""
+
+    def __init__(self, message: str, rule: str = "KP101"):
+        super().__init__(message)
+        self.rule = rule
+
+
+def is_known(spec: Any) -> bool:
+    return spec is not UNKNOWN and spec is not None
+
+
+def element_nbytes(element: Any) -> Optional[int]:
+    """Bytes of one element (pytree of ShapeDtypeStruct), or None when
+    the element spec is UNKNOWN / contains unknown leaves."""
+    if not is_known(element):
+        return None
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(element):
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            return None
+        total += int(np.prod(leaf.shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+@dataclass(frozen=True)
+class DataSpec:
+    """Abstract dataset/datum: element pytree + example count.
+
+    ``streaming`` marks values that arrive chunk-by-chunk under the
+    overlap engine (a stream-producing stage, or a chunkable stage fed
+    by one) — the hazard pass keys on it.
+    """
+
+    element: Any = UNKNOWN  # pytree of jax.ShapeDtypeStruct, or UNKNOWN
+    count: Optional[int] = None
+    kind: str = "dataset"  # "dataset" | "datum"
+    on_device: bool = True
+    streaming: bool = False
+
+    @property
+    def nbytes(self) -> Optional[int]:
+        """Full materialized size (count × element bytes); None when
+        unknowable."""
+        per = element_nbytes(self.element)
+        if per is None:
+            return None
+        if self.kind == "datum":
+            return per
+        if self.count is None:
+            return None
+        return per * int(self.count)
+
+    def with_element(self, element: Any) -> "DataSpec":
+        return replace(self, element=element)
+
+    def __repr__(self) -> str:
+        def fmt(e):
+            if not is_known(e):
+                return "?"
+            leaves = jax.tree_util.tree_leaves(e)
+            if len(leaves) == 1 and leaves[0] is e:
+                return f"{tuple(e.shape)}:{np.dtype(e.dtype).name}"
+            return jax.tree_util.tree_map(
+                lambda l: f"{tuple(l.shape)}:{np.dtype(l.dtype).name}", e
+            ).__repr__()
+
+        n = "?" if self.count is None else self.count
+        tag = "~stream" if self.streaming else ""
+        return f"DataSpec[{self.kind} n={n} elem={fmt(self.element)}{tag}]"
+
+
+@dataclass(frozen=True)
+class TransformerSpec:
+    """Abstract fitted transformer (the spec of a TransformerExpression).
+
+    ``elem_fn`` maps an input element spec to the fitted transformer's
+    output element spec; it may raise `SpecMismatchError` when the input
+    provably cannot feed the model (e.g. feature-dim mismatch against
+    the training data the estimator saw). None means the estimator
+    declared nothing — downstream applies propagate UNKNOWN."""
+
+    elem_fn: Optional[Callable[[Any], Any]] = field(default=None, compare=False)
+    label: str = ""
+    chunkable: bool = False
+
+    def apply_element(self, element: Any) -> Any:
+        if self.elem_fn is None or not is_known(element):
+            return UNKNOWN
+        return self.elem_fn(element)
+
+    def __repr__(self) -> str:
+        known = "known" if self.elem_fn is not None else "opaque"
+        return f"TransformerSpec[{self.label or 'fitted'}:{known}]"
+
+
+def shape_struct(shape, dtype) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(int(s) for s in shape), np.dtype(dtype))
+
+
+class SpecDataset:
+    """A dataset *placeholder* carrying only an abstract spec.
+
+    Used to build example pipelines for validation without loading any
+    data: `Pipeline.apply` / `Estimator.with_data` accept it (it is
+    flagged ``is_dataset``), the graph wires up exactly as with real
+    data, and `DatasetOperator.abstract_eval` reads the declared spec —
+    but any attempt to actually force the pipeline fails loudly.
+
+    ``element=None`` declares a host dataset of opaque objects (strings,
+    images of varying size): the spec propagates UNKNOWN elements, which
+    exercises the structural tier without pretending to know shapes.
+    """
+
+    is_dataset = True
+
+    def __init__(self, shape=None, dtype=np.float32, count: Optional[int] = None,
+                 on_device: bool = True, name: str = "spec", element=None):
+        if element is None and shape is not None:
+            element = shape_struct(shape, dtype)
+        self.spec = DataSpec(
+            element=element if element is not None else UNKNOWN,
+            count=count,
+            kind="dataset",
+            on_device=on_device if element is not None else False,
+        )
+        self.name = name
+
+    @property
+    def count(self) -> Optional[int]:
+        return self.spec.count
+
+    def __len__(self) -> int:
+        if self.spec.count is None:
+            raise TypeError(f"SpecDataset {self.name!r} has no declared count")
+        return self.spec.count
+
+    def __repr__(self) -> str:
+        return f"SpecDataset[{self.name}]({self.spec})"
+
+    def _refuse(self, what: str):
+        raise RuntimeError(
+            f"SpecDataset {self.name!r} is an abstract placeholder for static "
+            f"validation; {what} would require real data. Build the pipeline "
+            "with a real Dataset/HostDataset to execute it."
+        )
+
+    # Any materialization path fails loudly instead of fabricating data.
+    @property
+    def array(self):
+        self._refuse("reading .array")
+
+    @property
+    def items(self):
+        self._refuse("reading .items")
+
+    def numpy(self):
+        self._refuse("collecting to numpy")
+
+    def cache(self):
+        return self
+
+
+def spec_of(value: Any) -> Any:
+    """Best-effort spec of a concrete value (used by DatasetOperator /
+    DatumOperator and for forced ExpressionOperators)."""
+    from ..data.dataset import Dataset, HostDataset
+
+    if isinstance(value, SpecDataset):
+        return value.spec
+    if isinstance(value, Dataset):
+        element = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype), value.data
+        )
+        return DataSpec(element=element, count=value.count, kind="dataset",
+                        on_device=True)
+    if isinstance(value, HostDataset):
+        element = UNKNOWN
+        if value.items:
+            first = value.items[0]
+            if hasattr(first, "shape") and hasattr(first, "dtype"):
+                element = jax.ShapeDtypeStruct(tuple(first.shape), first.dtype)
+        return DataSpec(element=element, count=len(value.items), kind="dataset",
+                        on_device=False)
+    if hasattr(value, "shape") and hasattr(value, "dtype"):
+        return DataSpec(
+            element=jax.ShapeDtypeStruct(tuple(value.shape), value.dtype),
+            count=None, kind="datum",
+            on_device=not isinstance(value, np.ndarray),
+        )
+    return UNKNOWN
+
+
+def as_source_spec(spec: Any) -> Any:
+    """Normalize the user-facing ``source_spec`` argument of
+    `Pipeline.validate`: accepts a DataSpec, a SpecDataset, a
+    ShapeDtypeStruct (one element), a ``(shape, dtype)`` pair, a bare
+    shape tuple (defaults float32), or None (UNKNOWN source)."""
+    if spec is None or spec is UNKNOWN:
+        return UNKNOWN
+    if isinstance(spec, DataSpec):
+        return spec
+    if isinstance(spec, SpecDataset):
+        return spec.spec
+    if isinstance(spec, jax.ShapeDtypeStruct):
+        return DataSpec(element=spec, kind="dataset")
+    if isinstance(spec, tuple) and len(spec) == 2 and not isinstance(spec[0], int):
+        return DataSpec(element=shape_struct(*spec), kind="dataset")
+    if isinstance(spec, tuple) and all(isinstance(s, int) for s in spec):
+        return DataSpec(element=shape_struct(spec, np.float32), kind="dataset")
+    raise TypeError(f"cannot interpret {spec!r} as a source spec")
+
+
+def leaf_vector_dim(spec: Any) -> Optional[int]:
+    """Length of a dataset spec's 1-D single-leaf element, else None."""
+    if not isinstance(spec, DataSpec) or not is_known(spec.element):
+        return None
+    leaves = jax.tree_util.tree_leaves(spec.element)
+    if len(leaves) == 1 and getattr(leaves[0], "ndim", None) == 1:
+        return int(leaves[0].shape[0])
+    return None
+
+
+def supervised_fit_spec(in_specs, label: str, out_dtype=np.float32,
+                        max_in_dim: Optional[int] = None) -> TransformerSpec:
+    """TransformerSpec for the y = f(xW)-family of supervised estimators
+    (data (d,) + labels (k,) → fitted model mapping (d,) → (k,)).
+
+    The returned ``elem_fn`` verifies the apply-time feature dim against
+    the training dim (``max_in_dim`` relaxes to ≤, for feature-padding
+    solvers like BlockLeastSquares) and yields the label-width output
+    element. Degrades to an opaque TransformerSpec when the training
+    specs are unknown."""
+    data = in_specs[0] if in_specs else UNKNOWN
+    labels = in_specs[1] if len(in_specs) > 1 else UNKNOWN
+    d = leaf_vector_dim(data)
+    k = leaf_vector_dim(labels)
+    if k is None:
+        return TransformerSpec(None, label=label)
+
+    def elem_fn(elem):
+        got = None
+        leaves = jax.tree_util.tree_leaves(elem)
+        if len(leaves) == 1 and getattr(leaves[0], "ndim", None) == 1:
+            got = int(leaves[0].shape[0])
+        if d is not None and got is not None:
+            limit = max_in_dim if max_in_dim is not None else d
+            bad = got > limit if max_in_dim is not None else got != d
+            if bad:
+                raise SpecMismatchError(
+                    f"{label} was fit on {d}-dim features but is applied "
+                    f"to a {got}-dim element")
+        dtype = out_dtype if out_dtype is not None else leaves[0].dtype
+        return shape_struct((k,), dtype)
+
+    return TransformerSpec(elem_fn, label=label)
+
+
+# ---------------------------------------------------------------- tracing
+
+#: Exceptions that mean "this stage runs host code the tracer cannot
+#: enter" — the default abstract-eval answers UNKNOWN for them instead of
+#: reporting an error (NLP nodes, PIL images, python string ops...).
+_HOST_CODE_ERRORS = (
+    jax.errors.TracerArrayConversionError,
+    jax.errors.ConcretizationTypeError,
+    jax.errors.TracerIntegerConversionError,
+    AttributeError,
+    KeyError,
+    IndexError,
+    NotImplementedError,
+)
+
+#: TypeError/ValueError substrings that identify a genuine jax/XLA
+#: shape-system complaint (vs. host code stumbling over a tracer).
+_SHAPE_ERROR_MARKERS = (
+    "shape", "dtype", "dimension", "broadcast", "dot_general", "rank",
+    "incompatible", "matmul", "concatenate", "scatter", "conv",
+)
+
+
+def trace_element(fn: Callable, elems) -> Any:
+    """`jax.eval_shape` one per-item call over element specs — ZERO data
+    movement, zero device allocation.
+
+    Returns the output element pytree, UNKNOWN when ``fn`` is host code
+    the tracer cannot enter, and raises `SpecMismatchError` when the
+    trace dies on a shape/dtype complaint (the stage provably cannot run
+    on these inputs)."""
+    try:
+        return jax.eval_shape(fn, *elems)
+    except SpecMismatchError:
+        raise
+    except _HOST_CODE_ERRORS:
+        return UNKNOWN
+    except (TypeError, ValueError) as e:
+        msg = str(e)
+        low = msg.lower()
+        if any(marker in low for marker in _SHAPE_ERROR_MARKERS):
+            raise SpecMismatchError(msg, rule="KP101") from e
+        return UNKNOWN
+    except Exception:
+        return UNKNOWN

@@ -199,6 +199,16 @@ class BlockLinearMapper(Transformer):
         self.b = b if b is not None else jnp.zeros(W.shape[1], dtype=W.dtype)
         self.block_size = block_size
 
+    def abstract_apply(self, elem):
+        from ...analysis.specs import SpecMismatchError, shape_struct
+
+        d, k = self.W.shape
+        if getattr(elem, "ndim", None) == 1 and elem.shape[0] > d:
+            raise SpecMismatchError(
+                f"BlockLinearMapper holds a {d}-row model but the input "
+                f"element has {elem.shape[0]} features")
+        return shape_struct((k,), self.W.dtype)
+
     def apply(self, x):
         x = jnp.asarray(x)
         d = self.W.shape[0]
@@ -263,6 +273,20 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         self.fit_intercept = fit_intercept
         # passes over the input: weight for auto-caching
         self.weight = 3 * num_iter + 1
+
+    def abstract_fit(self, in_specs):
+        """Static fit: (d,) features + (k,) labels → model mapping (d,)
+        to (k,). The solver zero-pads features to a block multiple, so
+        apply accepts any dim ≤ ceil(d/bs)·bs."""
+        from ...analysis.specs import leaf_vector_dim, supervised_fit_spec
+
+        d = leaf_vector_dim(in_specs[0] if in_specs else None)
+        d_pad = None
+        if d is not None:
+            bs = min(self.block_size, d)
+            d_pad = -(-d // bs) * bs
+        return supervised_fit_spec(
+            in_specs, self.label, max_in_dim=d_pad)
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         from ...parallel import mesh as meshlib

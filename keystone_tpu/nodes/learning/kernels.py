@@ -171,6 +171,16 @@ class KernelBlockLinearMapper(Transformer):
         self.gamma = gamma
         self.block_size = block_size
 
+    def abstract_apply(self, elem):
+        from ...analysis.specs import SpecMismatchError, shape_struct
+
+        d = self.train_X.shape[1]
+        if getattr(elem, "ndim", None) == 1 and elem.shape[0] != d:
+            raise SpecMismatchError(
+                f"kernel model was trained on {d}-dim features but the "
+                f"input element has {elem.shape[0]}")
+        return shape_struct((self.alpha.shape[1],), self.alpha.dtype)
+
     def apply(self, x):
         K = _rbf_block(
             jnp.atleast_2d(jnp.asarray(x)), self.train_X, float(self.gamma)
@@ -215,6 +225,11 @@ class KernelRidgeRegression(LabelEstimator):
         # `blocks_before_checkpoint` blocks and restored on restart.
         self.checkpoint_dir = checkpoint_dir
         self.blocks_before_checkpoint = blocks_before_checkpoint
+
+    def abstract_fit(self, in_specs):
+        from ...analysis.specs import supervised_fit_spec
+
+        return supervised_fit_spec(in_specs, self.label)
 
     @property
     def weight(self):

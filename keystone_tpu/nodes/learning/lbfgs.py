@@ -153,6 +153,11 @@ class DenseLBFGSwithL2(LabelEstimator):
         self.fit_intercept = fit_intercept
         self.weight = num_iters  # passes over the input
 
+    def abstract_fit(self, in_specs):
+        from ...analysis.specs import supervised_fit_spec
+
+        return supervised_fit_spec(in_specs, self.label)
+
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         from ...parallel import mesh as meshlib
 

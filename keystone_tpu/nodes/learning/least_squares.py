@@ -55,6 +55,13 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             CostModel._weights(cpu_weight, mem_weight, network_weight)
         )
 
+    def abstract_fit(self, in_specs):
+        """Whichever concrete solver the cost model picks, the fitted
+        model maps (d,) features to (k,) label scores."""
+        from ...analysis.specs import supervised_fit_spec
+
+        return supervised_fit_spec(in_specs, self.label)
+
     @classmethod
     def calibrated(
         cls, lam: float = 0.0, probe_kwargs: Optional[dict] = None, **kwargs

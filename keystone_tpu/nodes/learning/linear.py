@@ -44,6 +44,16 @@ class LinearMapper(Transformer):
         self.b = b
         self.feature_scaler = feature_scaler
 
+    def abstract_apply(self, elem):
+        from ...analysis.specs import SpecMismatchError, shape_struct
+
+        d, k = self.W.shape
+        if getattr(elem, "ndim", None) == 1 and elem.shape[0] != d:
+            raise SpecMismatchError(
+                f"LinearMapper holds a ({d}, {k}) model but the input "
+                f"element has {elem.shape[0]} features")
+        return shape_struct((k,), self.W.dtype)
+
     def apply(self, x):
         if self.feature_scaler is not None:
             x = self.feature_scaler.apply(x)
@@ -93,6 +103,11 @@ class LinearMapEstimator(LabelEstimator):
     def __init__(self, lam: float = 0.0, fit_intercept: bool = True):
         self.lam = lam
         self.fit_intercept = fit_intercept
+
+    def abstract_fit(self, in_specs):
+        from ...analysis.specs import supervised_fit_spec
+
+        return supervised_fit_spec(in_specs, self.label)
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         from ...parallel import mesh as meshlib
@@ -184,6 +199,11 @@ class LocalLeastSquaresEstimator(LabelEstimator):
 
     def __init__(self, lam: float = 0.0):
         self.lam = lam
+
+    def abstract_fit(self, in_specs):
+        from ...analysis.specs import supervised_fit_spec
+
+        return supervised_fit_spec(in_specs, self.label)
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         W = _dual_solve(

@@ -47,6 +47,19 @@ class PCATransformer(Transformer):
     def __init__(self, components):
         self.components = jnp.asarray(components)  # (d, k)
 
+    def abstract_apply(self, elem):
+        from ...analysis.specs import SpecMismatchError, shape_struct
+
+        d, k = self.components.shape
+        if getattr(elem, "ndim", 0) >= 1:
+            if elem.shape[-1] != d:
+                raise SpecMismatchError(
+                    f"PCA components are ({d}, {k}) but the input element's "
+                    f"last axis is {elem.shape[-1]}")
+            return shape_struct(tuple(elem.shape[:-1]) + (k,),
+                                self.components.dtype)
+        raise SpecMismatchError("PCA input element must be at least 1-D")
+
     def apply(self, x):
         return jnp.asarray(x) @ self.components
 
@@ -92,12 +105,45 @@ def _svd_components(X):
         return _sign_convention(Vt.T)
 
 
+def _pca_fit_spec(dims: int, label: str, train_spec=None):
+    """TransformerSpec of a to-be-fitted PCA: last axis d → dims, with d
+    pinned from the training spec when known."""
+    from ...analysis.specs import (
+        SpecMismatchError,
+        TransformerSpec,
+        is_known,
+        shape_struct,
+    )
+    import jax as _jax
+
+    d = None
+    if train_spec is not None and is_known(getattr(train_spec, "element", None)):
+        leaves = _jax.tree_util.tree_leaves(train_spec.element)
+        if len(leaves) == 1 and getattr(leaves[0], "ndim", 0) >= 1:
+            d = int(leaves[0].shape[-1])
+
+    def elem_fn(elem):
+        if getattr(elem, "ndim", 0) < 1:
+            raise SpecMismatchError(f"{label} input element must be ≥ 1-D")
+        if d is not None and elem.shape[-1] != d:
+            raise SpecMismatchError(
+                f"{label} was fit on {d}-dim rows but the input element's "
+                f"last axis is {elem.shape[-1]}")
+        return shape_struct(tuple(elem.shape[:-1]) + (dims,), np.float32)
+
+    return TransformerSpec(elem_fn, label=label)
+
+
 class PCAEstimator(Estimator):
     """Local PCA via SVD (PCA.scala:162-247)."""
 
     def __init__(self, dims: int, sample_rows: Optional[int] = 100_000):
         self.dims = dims
         self.sample_rows = sample_rows
+
+    def abstract_fit(self, in_specs):
+        return _pca_fit_spec(self.dims, self.label,
+                             in_specs[0] if in_specs else None)
 
     def fit(self, data) -> PCATransformer:
         X = _collect_rows(data, self.sample_rows)
@@ -140,6 +186,10 @@ class DistributedPCAEstimator(Estimator):
 
     def __init__(self, dims: int):
         self.dims = dims
+
+    def abstract_fit(self, in_specs):
+        return _pca_fit_spec(self.dims, self.label,
+                             in_specs[0] if in_specs else None)
 
     def fit(self, data) -> PCATransformer:
         if isinstance(data, HostDataset):
@@ -186,6 +236,10 @@ class ApproximatePCAEstimator(Estimator):
         self.oversample = oversample
         self.q = q
         self.seed = seed
+
+    def abstract_fit(self, in_specs):
+        return _pca_fit_spec(self.dims, self.label,
+                             in_specs[0] if in_specs else None)
 
     def fit(self, data) -> PCATransformer:
         X = (

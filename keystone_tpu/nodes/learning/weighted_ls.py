@@ -98,6 +98,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         self.mixture_weight = mixture_weight
         self.weight = 3 * num_iter + 1
 
+    def abstract_fit(self, in_specs):
+        from ...analysis.specs import supervised_fit_spec
+
+        return supervised_fit_spec(in_specs, self.label)
+
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         X, Y = data.array, labels.array
         d = X.shape[1]

@@ -70,6 +70,8 @@ class ColumnSampler(Transformer):
     """Sample ≤ num_cols columns from each item's (cols × dim) matrix —
     used to subsample descriptors per image (Sampling.scala:12-25)."""
 
+    chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+
     def __init__(self, num_cols: int, seed: int = 0):
         self.num_cols = num_cols
         self.seed = seed

@@ -52,6 +52,17 @@ class CosineRandomFeatures(Transformer):
             rng.uniform(0, 2 * np.pi, size=(num_features,)), dtype=jnp.float32
         )
 
+    def abstract_apply(self, elem):
+        from ...analysis.specs import SpecMismatchError, shape_struct
+
+        d, m = self.W.shape
+        if getattr(elem, "ndim", 0) >= 1 and elem.shape[-1] != d:
+            raise SpecMismatchError(
+                f"CosineRandomFeatures expects {d}-dim inputs "
+                f"(input_dim={d}) but the element's last axis is "
+                f"{elem.shape[-1]}")
+        return shape_struct(tuple(elem.shape[:-1]) + (m,), self.W.dtype)
+
     def apply(self, x):
         return jnp.cos(x @ self.W + self.b)
 

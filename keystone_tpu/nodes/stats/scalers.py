@@ -63,6 +63,28 @@ class StandardScaler(Estimator):
         self.normalize_std_dev = normalize_std_dev
         self.eps = eps
 
+    def abstract_fit(self, in_specs):
+        """Static fit: the scaler is shape-preserving, but the fitted
+        mean/std pin the feature dim — applying to a different width is
+        a static error."""
+        from ...analysis.specs import (
+            SpecMismatchError,
+            TransformerSpec,
+            leaf_vector_dim,
+        )
+
+        d = leaf_vector_dim(in_specs[0] if in_specs else None)
+
+        def elem_fn(elem):
+            if d is not None and getattr(elem, "ndim", None) == 1 \
+                    and elem.shape[0] != d:
+                raise SpecMismatchError(
+                    f"StandardScaler was fit on {d}-dim features but is "
+                    f"applied to a {elem.shape[0]}-dim element")
+            return elem
+
+        return TransformerSpec(elem_fn, label=self.label)
+
     def fit(self, data: Dataset) -> StandardScalerModel:
         mean, std = _moments(
             data.array, jnp.float32(data.count), self.normalize_std_dev

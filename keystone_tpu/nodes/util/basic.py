@@ -16,6 +16,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...data.dataset import Dataset, HostDataset, zip_datasets
 from ...workflow.pipeline import Transformer
@@ -53,6 +54,14 @@ class ClassLabelIndicatorsFromInt(Transformer):
             raise ValueError("num_classes must be >= 2")
         self.num_classes = num_classes
 
+    def abstract_apply(self, elem):
+        from ...analysis.specs import shape_struct
+
+        # one_hot appends the class axis; scalar int labels → (k,)
+        return shape_struct(
+            tuple(getattr(elem, "shape", ())) + (self.num_classes,),
+            np.float32)
+
     def apply(self, y):
         return 2.0 * jax.nn.one_hot(y, self.num_classes) - 1.0
 
@@ -81,6 +90,14 @@ class MaxClassifier(Transformer):
     """argmax over scores → int label (MaxClassifier.scala)."""
 
     fusable = True
+
+    def abstract_apply(self, elem):
+        from ...analysis.specs import SpecMismatchError, shape_struct
+
+        if getattr(elem, "ndim", 0) < 1:
+            raise SpecMismatchError(
+                "MaxClassifier needs a score vector, got a scalar element")
+        return shape_struct(tuple(elem.shape[:-1]), np.int32)
 
     def apply(self, x):
         return jnp.argmax(x, axis=-1)

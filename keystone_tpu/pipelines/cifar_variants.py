@@ -65,6 +65,30 @@ class LinearPixelsConfig:
     seed: int = 0
 
 
+def analyzable(config: Optional[LinearPixelsConfig] = None):
+    """Abstract LinearPixels predictor graph for static validation.
+    Returns ``(pipeline, source_spec)``."""
+    from ..analysis import SpecDataset
+
+    config = config or LinearPixelsConfig()
+    h = w = 32
+    c = 3
+    n = 256
+    featurizer = (
+        FusedBatchTransformer(
+            [PixelScaler(), GrayScaler(), ImageVectorizer()], microbatch=4096
+        ).to_pipeline()
+        >> Cacher("pixels")
+    )
+    data = SpecDataset((h, w, c), np.float32, count=n, name="cifar-images")
+    raw_labels = SpecDataset((), np.int32, count=n, name="cifar-labels")
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(raw_labels)
+    predictor = featurizer.and_then(
+        LinearMapEstimator(config.lam), data, labels
+    ) >> MaxClassifier()
+    return predictor, (h, w, c)
+
+
 def run_linear_pixels(config: LinearPixelsConfig):
     train, test = _load(config)
     t0 = time.perf_counter()

@@ -78,6 +78,33 @@ def _fv_branch(base: Pipeline, train, config) -> Pipeline:
     )
 
 
+def analyzable(config: Optional[ImageNetSiftLcsFVConfig] = None):
+    """Abstract dual-branch (SIFT + LCS) predictor graph for static
+    validation. Returns ``(pipeline, source_spec)``."""
+    from ..analysis import SpecDataset
+
+    config = config or ImageNetSiftLcsFVConfig()
+    n = 64
+    train = SpecDataset(count=n, name="imagenet-images", on_device=False)
+    img = _Image().to_pipeline() >> PixelScaler()
+    sift_branch = _fv_branch(
+        img >> GrayScaler() >> SIFTExtractor(step=6, num_scales=2),
+        train, config)
+    lcs_branch = _fv_branch(img >> LCSExtractor(stride=6), train, config)
+
+    class _Concat(Transformer):
+        def apply(self, xs):
+            return np.concatenate([np.asarray(x).ravel() for x in xs])
+
+    featurizer = Pipeline.gather([sift_branch, lcs_branch]) >> _Concat() >> _Stack()
+    raw_labels = SpecDataset((), np.int32, count=n, name="imagenet-labels")
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(raw_labels)
+    predictor = featurizer.and_then(
+        BlockWeightedLeastSquaresEstimator(4096, 1, config.lam), train, labels
+    ) >> MaxClassifier()
+    return predictor, None
+
+
 def run(config: ImageNetSiftLcsFVConfig):
     if config.train_tar:
         labels_map = {}

@@ -59,6 +59,33 @@ def _load(config) -> tuple:
     )
 
 
+def analyzable(config: Optional[MnistRandomFFTConfig] = None):
+    """Build the full predictor graph with abstract placeholder data for
+    static validation (`python -m keystone_tpu.analysis`): no data loads,
+    no fits run — the returned pipeline exists only to be `validate()`d.
+    Returns ``(pipeline, source_spec)``."""
+    from ..analysis import SpecDataset
+
+    config = config or MnistRandomFFTConfig(num_ffts=2)
+    dim, n = 64, 256
+    branches = [
+        RandomSignNode(dim, seed=config.seed + i) >> PaddedFFT()
+        >> LinearRectifier(0.0)
+        for i in range(config.num_ffts)
+    ]
+    featurizer = Pipeline.gather(branches) >> VectorCombiner()
+    data = SpecDataset((dim,), np.float32, count=n, name="mnist-data")
+    raw_labels = SpecDataset((), np.int32, count=n, name="mnist-labels")
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(raw_labels)
+    predictor = featurizer.and_then(
+        BlockLeastSquaresEstimator(
+            min(config.block_size, dim), num_iter=1, lam=config.lam),
+        data,
+        labels,
+    ) >> MaxClassifier()
+    return predictor, (dim,)
+
+
 def run(config: MnistRandomFFTConfig):
     if config.num_ffts < 1:
         raise ValueError("--num-ffts must be >= 1")

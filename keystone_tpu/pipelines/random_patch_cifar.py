@@ -43,6 +43,44 @@ from ..nodes.util.fusion import FusedBatchTransformer
 from ..workflow import Pipeline
 
 
+def analyzable(config: Optional["RandomPatchCifarConfig"] = None):
+    """Abstract predictor graph for static validation: the prediction
+    path (conv → rectify → pool → vectorize → scale → solve → argmax)
+    with random filters standing in for the data-learned ones — filter
+    *learning* is driver-side and data-dependent, but the pipeline
+    shapes it must produce are not. Returns ``(pipeline, source_spec)``."""
+    from ..analysis import SpecDataset
+    from ..nodes.learning import BlockLeastSquaresEstimator
+
+    config = config or RandomPatchCifarConfig(num_filters=32)
+    h = w = 32
+    c = 3
+    n = 256
+    rng = np.random.default_rng(config.seed)
+    d = config.patch_size * config.patch_size * c
+    filters = rng.normal(size=(config.num_filters, d)).astype(np.float32)
+    featurizer = (
+        PixelScaler().to_pipeline()
+        >> Convolver(filters, h, w, c, whitener=None)
+        >> SymmetricRectifier(alpha=config.alpha)
+        >> Pooler(config.pool_stride, config.pool_size, pool_fn="sum")
+        >> ImageVectorizer()
+        >> Cacher("features")
+    )
+    data = SpecDataset((h, w, c), np.float32, count=n, name="cifar-images")
+    raw_labels = SpecDataset((), np.int32, count=n, name="cifar-labels")
+    labels = ClassLabelIndicatorsFromInt(config.num_classes)(raw_labels)
+    predictor = (
+        featurizer.and_then(StandardScaler(), data)
+        .and_then(
+            BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+            data, labels,
+        )
+        >> MaxClassifier()
+    )
+    return predictor, (h, w, c)
+
+
 @dataclass
 class RandomPatchCifarConfig:
     train_path: Optional[str] = None

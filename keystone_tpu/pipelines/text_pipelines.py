@@ -82,6 +82,27 @@ def build_newsgroups_predictor(train_docs, train_labels, num_classes: int,
     ) >> MaxClassifier()
 
 
+def analyzable(config: Optional["NewsgroupsConfig"] = None):
+    """Abstract Newsgroups predictor graph for static validation. The
+    NLP stages are host code (strings/token lists), so the spec tier
+    honestly propagates UNKNOWN — this exercises the structural and
+    hazard tiers over the real graph shape. Returns
+    ``(pipeline, source_spec)``."""
+    from ..analysis import SpecDataset
+
+    config = config or NewsgroupsConfig()
+    n = 128
+    num_classes = min(config.num_classes, 4)
+    docs = SpecDataset(count=n, name="newsgroups-docs", on_device=False)
+    labels = SpecDataset((), np.int32, count=n, name="newsgroups-labels",
+                         on_device=False)
+    predictor = build_newsgroups_predictor(
+        docs, labels, num_classes,
+        ngram_orders=config.ngram_orders,
+        common_features=config.common_features)
+    return predictor, None
+
+
 @dataclass
 class NewsgroupsConfig:
     train_path: Optional[str] = None

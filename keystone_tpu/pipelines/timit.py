@@ -52,6 +52,35 @@ def _synthetic_timit(n, dim, num_classes, noise_seed, class_seed=1234):
     return LabeledData.from_arrays(y, X)
 
 
+def analyzable(config: Optional[TimitConfig] = None):
+    """Abstract predictor graph for static validation — see
+    `keystone_tpu.analysis`. Returns ``(pipeline, source_spec)``."""
+    from ..analysis import SpecDataset
+    from ..nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
+
+    config = config or TimitConfig()
+    dim, n = config.synth_dim, 256
+    num_classes = min(config.num_classes, 12)
+    featurizer = (
+        CosineRandomFeatures(
+            dim, config.num_cosines, config.gamma,
+            distribution=config.distribution, seed=config.seed,
+        ).to_pipeline()
+        >> Cacher("timit-features")
+    )
+    data = SpecDataset((dim,), np.float32, count=n, name="timit-data")
+    raw_labels = SpecDataset((), np.int32, count=n, name="timit-labels")
+    labels = ClassLabelIndicatorsFromInt(num_classes)(raw_labels)
+    predictor = featurizer.and_then(
+        BlockLeastSquaresEstimator(
+            min(config.block_size, config.num_cosines),
+            config.num_epochs, config.lam),
+        data,
+        labels,
+    ) >> MaxClassifier()
+    return predictor, (dim,)
+
+
 def run(config: TimitConfig):
     if config.train_features:
         train = timit_loader(config.train_features, config.train_labels)

@@ -69,6 +69,49 @@ def _synthetic_voc(n, num_classes, noise_seed, class_seed=1234):
     return HostDataset(items)
 
 
+def analyzable(config: Optional[VOCSIFTFisherConfig] = None):
+    """Abstract VOC predictor graph for static validation: the full
+    SIFT→PCA→FisherVector→solver DAG wired over placeholder data (host
+    image stages propagate UNKNOWN specs; the structural/hazard tiers
+    see the real topology). Returns ``(pipeline, source_spec)``."""
+    from ..analysis import SpecDataset
+
+    config = config or VOCSIFTFisherConfig()
+    n = 64
+    train = SpecDataset(count=n, name="voc-images", on_device=False)
+    sift = (
+        MultiLabeledImageExtractor().to_pipeline()
+        >> PixelScaler()
+        >> GrayScaler()
+        >> SIFTExtractor(step=6, num_scales=2)
+    )
+    sampled = (sift >> ColumnSampler(config.descriptor_samples)).apply(train)
+    pca_featurizer = sift.and_then(
+        ColumnPCAEstimator(config.pca_dims).with_data(sampled)
+    )
+    fisher_sample = (
+        pca_featurizer >> ColumnSampler(config.descriptor_samples)
+    ).apply(train)
+    fisher = GMMFisherVectorEstimator(config.gmm_k).with_data(fisher_sample)
+    featurizer = (
+        pca_featurizer.and_then(fisher)
+        >> MatrixVectorizer()
+        >> SignedHellingerMapper()
+        >> NormalizeRows()
+        >> _Stack()
+    )
+    labels = SpecDataset((config.num_classes,), np.float32, count=n,
+                         name="voc-labels")
+    predictor = featurizer.and_then(
+        BlockWeightedLeastSquaresEstimator(
+            4096, 1, config.lam, config.mixture_weight
+        ),
+        train,
+        labels,
+    )
+    return predictor, None
+
+
 def run(config: VOCSIFTFisherConfig):
     if config.train_tar:
         train = voc_loader(config.train_tar, config.train_labels)

@@ -17,17 +17,15 @@ def parents(graph: Graph, vid: GraphId) -> List[GraphId]:
 
 
 def children(graph: Graph, vid: GraphId) -> Set[GraphId]:
-    """Vertices that directly depend on ``vid``."""
-    out: Set[GraphId] = set()
+    """Vertices that directly depend on ``vid``.
+
+    Thin wrapper over `Graph.users_of` — the lazily built
+    reverse-adjacency index makes each query O(1) after one O(V+E)
+    build, so `descendants`/`UnusedBranchRemovalRule`/auto-cache sweeps
+    no longer rescan every edge per vertex (the old O(V·E) path)."""
     if isinstance(vid, SinkId):
-        return out
-    for n, deps in graph.dependencies.items():
-        if vid in deps:
-            out.add(n)
-    for s, d in graph.sink_dependencies.items():
-        if d == vid:
-            out.add(s)
-    return out
+        return set()
+    return set(graph.users_of(vid))
 
 
 def ancestors(graph: Graph, vid: GraphId) -> Set[GraphId]:

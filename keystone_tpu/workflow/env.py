@@ -46,10 +46,16 @@ class ExecutionConfig:
     capping peak host memory at O(depth × chunk) items — at most
     2·depth + 2 chunks resident per stage (env
     ``KEYSTONE_PREFETCH_DEPTH``).
+
+    ``hbm_budget_bytes`` is the per-host accelerator memory budget the
+    static analyzer lints against (KP201/KP202, see
+    `keystone_tpu.analysis`); env ``KEYSTONE_HBM_BUDGET_GB`` (float,
+    GiB). None disables budget warnings.
     """
 
     overlap: bool = True
     prefetch_depth: int = 2
+    hbm_budget_bytes: Optional[int] = None
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -63,6 +69,11 @@ def execution_config() -> ExecutionConfig:
             not in ("0", "false", "off"),
             prefetch_depth=max(
                 1, int(os.environ.get("KEYSTONE_PREFETCH_DEPTH", "2"))
+            ),
+            hbm_budget_bytes=(
+                int(float(os.environ["KEYSTONE_HBM_BUDGET_GB"]) * (1 << 30))
+                if os.environ.get("KEYSTONE_HBM_BUDGET_GB")
+                else None
             ),
         )
     return _exec_config

@@ -30,6 +30,7 @@ class GraphExecutor:
         self._optimize = optimize
         self._optimized: Optional[Tuple[Graph, Dict[NodeId, Prefix]]] = plan
         self._memo: Dict[GraphId, Expression] = {}
+        self._structure_checked = False
 
     @property
     def graph(self) -> Graph:
@@ -49,10 +50,28 @@ class GraphExecutor:
                 self._optimized = (self._raw_graph, {})
         return self._optimized
 
+    def _check_structure(self, graph: Graph) -> None:
+        """Run the analyzer's structural tier once per executor before the
+        first force: cycles, arity, fit-before-use, inverted delegate
+        wiring (see `keystone_tpu.analysis`). O(V+E) and data-free, so a
+        malformed plan fails in microseconds here instead of deep inside
+        a run. ERROR findings raise `PipelineValidationError` (a
+        ValueError, matching the old runtime checks' contract)."""
+        if self._structure_checked:
+            return
+        from ..analysis import structural_report
+
+        # mark checked only on success: a caller that catches the
+        # validation error and retries gets the same error again, not a
+        # silent unvalidated run
+        structural_report(graph).raise_for_errors()
+        self._structure_checked = True
+
     def execute(self, graph_id: GraphId) -> Expression:
         """Execute up to ``graph_id``, returning its lazy Expression
         (GraphExecutor.scala:53-80)."""
         graph, prefixes = self._optimized_plan()
+        self._check_structure(graph)
         env = PipelineEnv.get()
 
         def go(vid: GraphId) -> Expression:

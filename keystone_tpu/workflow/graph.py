@@ -63,7 +63,10 @@ class Graph:
     Mirrors reference Graph.scala:32-457 (fields at :39-43).
     """
 
-    __slots__ = ("sources", "sinks", "operators", "dependencies", "sink_dependencies")
+    __slots__ = (
+        "sources", "sinks", "operators", "dependencies", "sink_dependencies",
+        "_users_index",
+    )
 
     def __init__(
         self,
@@ -80,6 +83,7 @@ class Graph:
         }
         if set(self.operators) != set(self.dependencies):
             raise ValueError("operators and dependencies must have identical node sets")
+        self._users_index: Optional[Dict[GraphId, Tuple[GraphId, ...]]] = None
 
     # ------------------------------------------------------------------ views
 
@@ -176,10 +180,25 @@ class Graph:
         sd[sink] = dep
         return Graph(self.sources, sd, self.operators, self.dependencies)
 
+    def users_of(self, vid: GraphId) -> Tuple[GraphId, ...]:
+        """All direct dependents of ``vid`` — nodes whose dependency list
+        contains it plus sinks bound to it — via a lazily built
+        reverse-adjacency index. The index costs O(V+E) once per (immutable)
+        graph; each query is O(1), versus the old O(E) rescan per call that
+        made `children`/`descendants` O(V·E)."""
+        # getattr: Graphs unpickled from pre-index artifacts lack the slot
+        if getattr(self, "_users_index", None) is None:
+            idx: Dict[GraphId, list] = {}
+            for n, deps in self.dependencies.items():
+                for d in dict.fromkeys(deps):  # dedupe repeated deps
+                    idx.setdefault(d, []).append(n)
+            for s, d in self.sink_dependencies.items():
+                idx.setdefault(d, []).append(s)
+            self._users_index = {k: tuple(v) for k, v in idx.items()}
+        return self._users_index.get(vid, ())
+
     def _users_of(self, vid: NodeOrSourceId) -> list:
-        users = [n for n, deps in self.dependencies.items() if vid in deps]
-        users += [s for s, d in self.sink_dependencies.items() if d == vid]
-        return users
+        return list(self.users_of(vid))
 
     def remove_node(self, node: NodeId) -> "Graph":
         """Remove a node; it must have no users (Graph.scala:170-186)."""

@@ -67,12 +67,71 @@ def _streamed_batch(transformer, dep: Expression):
         yield from stream
 
 
+def _check_data_specs(in_specs: List[Any]):
+    """Shared static argument checks mirroring the runtime checks of
+    `TransformerOperator.execute` / `DelegatingOperator.execute`
+    (Operator.scala:77-100): no transformer-as-data, no datum/dataset
+    mixing, agreeing dataset counts. Returns
+    ``(kind, count, on_device, elems)``. Module-level on purpose — both
+    operator classes share it and it must not depend on either's state."""
+    from ..analysis.specs import (
+        UNKNOWN,
+        DataSpec,
+        SpecMismatchError,
+        TransformerSpec,
+    )
+
+    if not in_specs:
+        raise SpecMismatchError(
+            "requires at least one data dependency", rule="KP002")
+    for s in in_specs:
+        if isinstance(s, TransformerSpec):
+            raise SpecMismatchError(
+                "a transformer output is consumed as data (fit-before-use)",
+                rule="KP003")
+    data = [s for s in in_specs if isinstance(s, DataSpec)]
+    kinds = {s.kind for s in data}
+    if kinds == {"datum", "dataset"}:
+        raise SpecMismatchError(
+            "dependencies mix datums and datasets", rule="KP002")
+    kind = "datum" if kinds == {"datum"} else "dataset"
+    counts = {
+        s.count for s in data
+        if s.kind == "dataset" and s.count is not None
+    }
+    if len(counts) > 1:
+        raise SpecMismatchError(
+            f"dependency datasets disagree on example count: "
+            f"{sorted(counts)}", rule="KP102")
+    count = next(iter(counts)) if counts else None
+    on_device = data[0].on_device if data else True
+    elems = [s.element if isinstance(s, DataSpec) else UNKNOWN
+             for s in in_specs]
+    return kind, count, on_device, elems
+
+
 class Operator:
     """Base class. Subclasses implement ``execute``."""
+
+    #: Indices of dependencies whose FORCED buffer this operator may hand
+    #: to XLA for in-place reuse (``donate_argnums`` on the value itself,
+    #: not on internal solver state). The static analyzer (KP301) requires
+    #: each donated dependency's producer to have exactly one consumer —
+    #: any other reachable sink would read a deleted buffer.
+    donates_deps: tuple = ()
 
     @property
     def label(self) -> str:
         return type(self).__name__
+
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        """Static spec propagation hook: map dependency specs to this
+        operator's output spec WITHOUT touching data (see
+        `keystone_tpu.analysis`). Default: honestly unknowable. Hooks
+        raise `SpecMismatchError` when the inputs provably cannot work."""
+        from ..analysis.specs import UNKNOWN
+
+        return UNKNOWN
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         raise NotImplementedError
@@ -93,6 +152,11 @@ class DatasetOperator(Operator):
     def label(self) -> str:
         return f"Dataset[{self.name}]"
 
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        from ..analysis.specs import spec_of
+
+        return spec_of(self.dataset)
+
     def execute(self, deps: Sequence[Expression]) -> Expression:
         assert not deps
         return DatasetExpression.of(self.dataset)
@@ -107,6 +171,18 @@ class DatumOperator(Operator):
     @property
     def label(self) -> str:
         return "Datum"
+
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        from ..analysis.specs import UNKNOWN, DataSpec
+
+        if hasattr(self.datum, "shape") and hasattr(self.datum, "dtype"):
+            import jax
+
+            return DataSpec(
+                element=jax.ShapeDtypeStruct(
+                    tuple(self.datum.shape), self.datum.dtype),
+                kind="datum")
+        return DataSpec(element=UNKNOWN, kind="datum", on_device=False)
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         assert not deps
@@ -128,6 +204,50 @@ class TransformerOperator(Operator):
 
     def batch_transform(self, inputs: List[Any]) -> Any:
         raise NotImplementedError
+
+    # ------------------------------------------------------ static analysis
+
+    def _check_data_specs(self, in_specs: List[Any]):
+        return _check_data_specs(in_specs)
+
+    def _abstract_element(self, elems: List[Any]) -> Any:
+        """Per-item output element spec. Prefers an explicit
+        ``abstract_apply(elem) -> elem`` hook; falls back to a
+        `jax.eval_shape` trace of ``single_transform`` (zero data
+        movement, zero device allocation)."""
+        from ..analysis.specs import trace_element
+
+        hook = getattr(self, "abstract_apply", None)
+        if hook is not None and len(elems) == 1:
+            return hook(elems[0])
+        return trace_element(
+            lambda *xs: self.single_transform(list(xs)), elems)
+
+    def _streams_out(self, in_specs: List[Any]) -> bool:
+        from ..analysis.hazards import _is_stream_origin
+        from ..analysis.specs import DataSpec
+
+        if _is_stream_origin(self):
+            return True
+        in_streams = any(
+            isinstance(s, DataSpec) and s.streaming for s in in_specs)
+        return in_streams and bool(getattr(self, "chunkable", False))
+
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        from ..analysis.specs import UNKNOWN, DataSpec, is_known
+
+        kind, count, on_device, elems = self._check_data_specs(in_specs)
+        if all(is_known(e) for e in elems):
+            out_elem = self._abstract_element(elems)
+        else:
+            out_elem = UNKNOWN
+        return DataSpec(
+            element=out_elem,
+            count=count if kind == "dataset" else None,
+            kind=kind,
+            on_device=on_device,
+            streaming=kind == "dataset" and self._streams_out(in_specs),
+        )
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         deps = list(deps)
@@ -162,15 +282,97 @@ class EstimatorOperator(Operator):
     def fit_datasets(self, inputs: List[Any]) -> TransformerOperator:
         raise NotImplementedError
 
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        """Static fit: generic count agreement across training datasets,
+        then the estimator's optional ``abstract_fit(in_specs) ->
+        TransformerSpec`` hook (declaring the fitted transformer's
+        element→element shape function); opaque otherwise."""
+        from ..analysis.specs import (
+            DataSpec,
+            SpecMismatchError,
+            TransformerSpec,
+        )
+
+        if not in_specs:
+            raise SpecMismatchError(
+                "estimator requires training data dependencies", rule="KP002")
+        counts = {
+            s.count for s in in_specs
+            if isinstance(s, DataSpec) and s.kind == "dataset"
+            and s.count is not None
+        }
+        if len(counts) > 1:
+            raise SpecMismatchError(
+                f"training datasets disagree on example count: "
+                f"{sorted(counts)}", rule="KP102")
+        hook = getattr(self, "abstract_fit", None)
+        if hook is not None:
+            return hook(in_specs)
+        return TransformerSpec(None, label=self.label)
+
     def execute(self, deps: Sequence[Expression]) -> Expression:
         deps = list(deps)
         return TransformerExpression(lambda: self.fit_datasets([d.get for d in deps]))
+
+
+def fitted_elem_fn(transformer: "TransformerOperator"):
+    """Element→element spec function of an already-fitted transformer:
+    its ``abstract_apply`` hook when present, else a `jax.eval_shape`
+    trace of its single-item path."""
+
+    def fn(elem):
+        from ..analysis.specs import trace_element
+
+        hook = getattr(transformer, "abstract_apply", None)
+        if hook is not None:
+            return hook(elem)
+        return trace_element(
+            lambda x: transformer.single_transform([x]), (elem,))
+
+    return fn
 
 
 class DelegatingOperator(Operator):
     """Applies the transformer produced by its first dependency to the rest
     (Operator.scala:136-163). Forcing the transformer expression is the
     moment an estimator's fit actually happens."""
+
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        from ..analysis.specs import (
+            UNKNOWN,
+            DataSpec,
+            SpecMismatchError,
+            TransformerSpec,
+            is_known,
+        )
+
+        if not in_specs:
+            raise SpecMismatchError(
+                "DelegatingOperator requires a transformer dependency",
+                rule="KP002")
+        tspec, data_specs = in_specs[0], in_specs[1:]
+        if isinstance(tspec, DataSpec):
+            raise SpecMismatchError(
+                "first dependency produces data, not a transformer",
+                rule="KP004")
+        if not data_specs:
+            raise SpecMismatchError(
+                "DelegatingOperator requires data dependencies", rule="KP002")
+        kind, count, on_device, elems = _check_data_specs(data_specs)
+        out_elem = UNKNOWN
+        if isinstance(tspec, TransformerSpec) and len(elems) == 1 \
+                and is_known(elems[0]):
+            out_elem = tspec.apply_element(elems[0])  # may raise mismatch
+        in_streams = any(
+            isinstance(s, DataSpec) and s.streaming for s in data_specs)
+        chunkable = isinstance(tspec, TransformerSpec) and tspec.chunkable
+        return DataSpec(
+            element=out_elem,
+            count=count if kind == "dataset" else None,
+            kind=kind,
+            on_device=on_device,
+            streaming=kind == "dataset" and in_streams and chunkable,
+        )
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         deps = list(deps)
@@ -218,6 +420,22 @@ class ExpressionOperator(Operator):
     def label(self) -> str:
         return f"Saved[{self.name}]"
 
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        from ..analysis.specs import UNKNOWN, TransformerSpec, spec_of
+
+        if isinstance(self.expression, TransformerExpression):
+            if self.expression.is_forced:
+                fitted = self.expression.get
+                return TransformerSpec(
+                    fitted_elem_fn(fitted),
+                    label=self.label,
+                    chunkable=bool(getattr(fitted, "chunkable", False)),
+                )
+            return TransformerSpec(None, label=self.label)
+        if self.expression.is_forced:
+            return spec_of(self.expression.get)
+        return UNKNOWN
+
     def execute(self, deps: Sequence[Expression]) -> Expression:
         return self.expression
 
@@ -228,6 +446,22 @@ class GatherTransformerOperator(TransformerOperator):
     For the batch path the branch datasets are combined elementwise via the
     dataset zip utility; for the single path the inputs are simply collected.
     """
+
+    @property
+    def label(self) -> str:
+        return "Gather"
+
+    def abstract_eval(self, in_specs: List[Any]) -> Any:
+        from ..analysis.specs import UNKNOWN, DataSpec, is_known
+
+        kind, count, on_device, elems = self._check_data_specs(in_specs)
+        out_elem = tuple(elems) if all(is_known(e) for e in elems) else UNKNOWN
+        return DataSpec(
+            element=out_elem,
+            count=count if kind == "dataset" else None,
+            kind=kind,
+            on_device=on_device,
+        )
 
     def single_transform(self, inputs: List[Any]) -> Any:
         return list(inputs)

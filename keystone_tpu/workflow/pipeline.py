@@ -63,6 +63,12 @@ class PipelineResult:
         remaining chunks without re-running the ones already seen."""
         return self.executor.execute_stream(self.sink)
 
+    def validate(self, **kwargs):
+        """Statically validate this applied pipeline's graph (all
+        sources are already bound to data, so specs derive from the
+        bound datasets). See `Pipeline.validate`."""
+        return _validate(self.graph, {}, **kwargs)
+
 
 class PipelineDataset(PipelineResult):
     """Lazy distributed dataset result (PipelineDataset.scala:10-23)."""
@@ -92,6 +98,24 @@ def _add_data_vertex(g: Graph, data: Any) -> Tuple[Graph, NodeOrSourceId]:
         return _splice_result(g, data)
     g2, nid = g.add_node(DatasetOperator(data), [])
     return g2, nid
+
+
+def _validate(graph, source_specs, *, level: str = "full", ignore=(),
+              hbm_budget_bytes=None, chunk_rows=None, raise_on_error=True):
+    """Shared implementation of `Pipeline.validate` and friends."""
+    from ..analysis import DEFAULT_CHUNK_ROWS, validate_graph
+
+    report = validate_graph(
+        graph,
+        source_specs,
+        level=level,
+        ignore=ignore,
+        hbm_budget_bytes=hbm_budget_bytes,
+        chunk_rows=chunk_rows if chunk_rows is not None else DEFAULT_CHUNK_ROWS,
+    )
+    if raise_on_error:
+        report.raise_for_errors()
+    return report
 
 
 # --------------------------------------------------------------------------
@@ -150,6 +174,39 @@ class Pipeline(Chainable):
     def to_pipeline(self) -> "Pipeline":
         return self
 
+    # ----------------------------------------------------------- validate
+
+    def validate(self, source_spec=None, *, level: str = "full", ignore=(),
+                 hbm_budget_bytes=None, chunk_rows=None,
+                 raise_on_error: bool = True):
+        """Statically validate this pipeline before any data loads.
+
+        Walks the lowered graph propagating abstract specs
+        (`jax.eval_shape` — zero data movement, zero device allocation),
+        estimates per-node and peak live memory against
+        ``hbm_budget_bytes``, and lints donation/streaming hazards. See
+        ANALYSIS.md for the rule catalog and suppression
+        (``ignore=["KP302", ...]`` or per-line ``# keystone:
+        ignore[...]`` for the AST lints).
+
+        ``source_spec`` describes the pipeline input: a
+        `analysis.SpecDataset`, a `jax.ShapeDtypeStruct`, a
+        ``(shape, dtype)`` pair, or a bare shape tuple (float32). None
+        leaves the input unknown — structural lints still run, shape
+        propagation starts at the first node with intrinsic specs.
+
+        ``level``: "structure" ⊂ "specs" ⊂ "memory" ⊂ "full".
+        Raises `analysis.PipelineValidationError` on ERROR-severity
+        findings unless ``raise_on_error=False``; always returns the
+        `ValidationReport`."""
+        from ..analysis import as_source_spec
+
+        return _validate(
+            self.graph,
+            {self.source: as_source_spec(source_spec)},
+            level=level, ignore=ignore, hbm_budget_bytes=hbm_budget_bytes,
+            chunk_rows=chunk_rows, raise_on_error=raise_on_error)
+
     # -------------------------------------------------------------- apply
 
     def apply(self, data: Any):
@@ -157,8 +214,6 @@ class Pipeline(Chainable):
         graph-spliced; `Dataset`s (or any object flagged `is_dataset`)
         follow the batch path; everything else is a single datum
         (Pipeline.scala:67-96)."""
-        from ..data.dataset import Dataset, HostDataset
-
         if isinstance(data, PipelineResult):
             g, smap, kmap = data.graph.add_graph(self.graph)
             # kmap maps *self*'s sinks; data's sink ids are unchanged.
@@ -171,7 +226,7 @@ class Pipeline(Chainable):
             )
             return cls(executor, kmap[self.sink])
 
-        if isinstance(data, (Dataset, HostDataset)):
+        if getattr(data, "is_dataset", False):
             g, nid = self.graph.add_node(DatasetOperator(data), [])
             g = g.replace_dependency(self.source, nid).remove_source(self.source)
             return PipelineDataset(GraphExecutor(g), self.sink)
@@ -255,10 +310,12 @@ class FittedPipeline(Chainable):
     def to_pipeline(self) -> Pipeline:
         return Pipeline(self.graph, self.source, self.sink)
 
-    def apply(self, data: Any):
-        from ..data.dataset import Dataset, HostDataset
+    def validate(self, source_spec=None, **kwargs):
+        """Statically validate the fitted graph (see `Pipeline.validate`)."""
+        return self.to_pipeline().validate(source_spec, **kwargs)
 
-        if isinstance(data, (Dataset, HostDataset)):
+    def apply(self, data: Any):
+        if getattr(data, "is_dataset", False):
             g, nid = self.graph.add_node(DatasetOperator(data), [])
             g = g.replace_dependency(self.source, nid).remove_source(self.source)
             return PipelineDataset(GraphExecutor(g, optimize=False), self.sink).get()

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""jaxlint — repo-specific static JAX lints for keystone_tpu.
+
+Pure-AST (no imports of the linted code, no jax required), so it runs in
+milliseconds as a pre-test gate (`scripts/lint.sh`) and as a tier-1
+pytest (tests/test_jaxlint.py). Rules encode project discipline the type
+system cannot (see ANALYSIS.md for the full catalog):
+
+  KJ001  jnp-loop-accumulation (under ``nodes/``): a raw ``jnp.*`` call
+         feeding a loop-carried accumulation inside a Python for/while.
+         Each iteration dispatches its own XLA program and the loop-
+         carried value forces a dependency chain — use `lax.scan`/
+         `lax.fori_loop`, or a jitted step function (the donated-buffer
+         epoch pattern in nodes/learning).
+  KJ002  numpy-inside-jit: a ``np.*``/``numpy.*`` *call* in the body of
+         a ``jax.jit``-decorated function. NumPy calls on tracers either
+         crash (TracerArrayConversionError) or silently constant-fold at
+         trace time. Attribute reads (``np.float32``, ``np.pi``) are
+         fine — only calls are flagged.
+  KJ003  missing-donate (under ``nodes/learning/``): a jitted function
+         named ``*_step``/``*_epoch``/``*_sweep`` — the solver-loop
+         naming convention for steps that rebuild O(model)-sized state —
+         must declare ``donate_argnums`` so XLA reuses the state buffers
+         instead of allocating fresh HBM every iteration.
+
+Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
+several rules) to the flagged line, or to the ``def`` line for KJ003.
+
+Usage: python scripts/jaxlint.py [--list-rules] [paths...]
+Exit code 1 when findings remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Iterator, List, NamedTuple, Optional, Set
+
+RULES = {
+    "KJ001": "raw jnp.* call in a Python-loop accumulation (use lax.scan "
+             "or a jitted step fn)",
+    "KJ002": "numpy call inside a jax.jit-decorated function",
+    "KJ003": "jitted solver step mutating O(model) state lacks "
+             "donate_argnums",
+}
+
+_IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
+
+#: numpy module aliases recognized in Attribute roots.
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+_JNP_NAMES = {"jnp"}
+#: names whose calls are harmless inside jit (dtype casts of constants).
+_NUMPY_CALL_ALLOWLIST = {"dtype"}
+#: jnp attrs that are scalar casts / wrappers, not compute — a loop that
+#: only casts its chunk counters while accumulating through a *jitted*
+#: step function is the approved donated-buffer pattern, not a smell.
+_JNP_CAST_ALLOWLIST = {
+    "asarray", "array", "int8", "int16", "int32", "int64", "uint8",
+    "uint16", "uint32", "uint64", "float16", "float32", "float64",
+    "bfloat16", "bool_", "dtype",
+}
+_STEP_NAME_RE = re.compile(r"_(step|epoch|sweep)$")
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    """Root name of an attribute chain: ``np.linalg.svd`` → ``np``."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _calls_rooted_at(
+    tree: ast.AST, roots: Set[str], skip_attrs: Set[str] = frozenset()
+) -> Iterator[ast.Call]:
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            if _attr_root(sub.func) in roots \
+                    and sub.func.attr not in skip_attrs:
+                yield sub
+
+
+def _names_loaded(tree: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(tree)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _jit_decorator(fn: ast.FunctionDef) -> Optional[ast.AST]:
+    """The decorator node if ``fn`` is jitted: ``@jax.jit``, ``@jit``,
+    ``@jax.jit(...)``, or ``@partial(jax.jit, ...)``."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name) and target.id == "jit":
+            return dec
+        if isinstance(target, ast.Attribute) and target.attr == "jit" \
+                and _attr_root(target) == "jax":
+            return dec
+        if isinstance(dec, ast.Call) and isinstance(dec.func, ast.Name) \
+                and dec.func.id == "partial" and dec.args:
+            inner = dec.args[0]
+            if isinstance(inner, ast.Attribute) and inner.attr == "jit" \
+                    and _attr_root(inner) == "jax":
+                return dec
+            if isinstance(inner, ast.Name) and inner.id == "jit":
+                return dec
+    return None
+
+
+def _decorator_kwargs(dec: ast.AST) -> Set[str]:
+    if isinstance(dec, ast.Call):
+        return {kw.arg for kw in dec.keywords if kw.arg}
+    return set()
+
+
+# ---------------------------------------------------------------- rules
+
+
+def _check_loop_accumulation(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ001: inside for/while bodies, flag (a) augmented assignment
+    whose value calls jnp directly, (b) ``x = f(x, ...jnp call...)``
+    self-assignment with a direct jnp call, (c) ``list.append(<jnp
+    call>)`` — all loop-carried per-iteration XLA dispatch patterns."""
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for stmt in loop.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.AugAssign):
+                    if any(True for _ in _calls_rooted_at(sub.value, _JNP_NAMES, _JNP_CAST_ALLOWLIST)):
+                        yield Finding(
+                            path, sub.lineno, "KJ001",
+                            "augmented assignment accumulates a jnp result "
+                            "inside a Python loop")
+                elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name):
+                    t = sub.targets[0].id
+                    if t in _names_loaded(sub.value) and any(
+                            True for _ in _calls_rooted_at(sub.value, _JNP_NAMES, _JNP_CAST_ALLOWLIST)):
+                        yield Finding(
+                            path, sub.lineno, "KJ001",
+                            f"`{t}` is rebuilt from itself with a raw jnp "
+                            "call each iteration")
+                elif isinstance(sub, ast.Expr) and isinstance(sub.value, ast.Call):
+                    call = sub.value
+                    if isinstance(call.func, ast.Attribute) \
+                            and call.func.attr == "append" and call.args:
+                        if any(True for _ in _calls_rooted_at(
+                                call.args[0], _JNP_NAMES, _JNP_CAST_ALLOWLIST)):
+                            yield Finding(
+                                path, sub.lineno, "KJ001",
+                                "appending a per-iteration jnp result; "
+                                "each append dispatches its own program")
+
+
+def _check_numpy_in_jit(tree: ast.AST, path: str) -> Iterator[Finding]:
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _jit_decorator(fn) is None:
+            continue
+        for call in _calls_rooted_at(fn, _NUMPY_NAMES):
+            func = call.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _NUMPY_CALL_ALLOWLIST:
+                continue
+            yield Finding(
+                path, call.lineno, "KJ002",
+                f"numpy call `{ast.unparse(func)}` inside jitted "
+                f"`{fn.name}` — constant-folds at trace time or crashes "
+                "on tracers")
+
+
+def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if not _STEP_NAME_RE.search(fn.name):
+            continue
+        dec = _jit_decorator(fn)
+        if dec is None:
+            continue
+        if "donate_argnums" not in _decorator_kwargs(dec):
+            yield Finding(
+                path, fn.lineno, "KJ003",
+                f"jitted solver step `{fn.name}` has no donate_argnums; "
+                "its state buffers reallocate every iteration")
+
+
+# ----------------------------------------------------------------- driver
+
+
+def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [Finding(str(path), e.lineno or 0, "KJ000",
+                        f"syntax error: {e.msg}")]
+    rel = str(path if repo_root is None else path.relative_to(repo_root))
+    findings: List[Finding] = []
+    findings.extend(_check_numpy_in_jit(tree, rel))
+    if "nodes/" in rel.replace("\\", "/") + "/":
+        findings.extend(_check_loop_accumulation(tree, rel))
+    if "nodes/learning" in rel.replace("\\", "/"):
+        findings.extend(_check_missing_donate(tree, rel))
+
+    # nested loops make ast.walk revisit inner statements: keep one
+    # finding per (line, rule)
+    findings = list(dict.fromkeys(findings))
+
+    # per-line suppression: # keystone: ignore[KJ001,KJ002]
+    lines = src.splitlines()
+    kept = []
+    for f in findings:
+        line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        m = _IGNORE_RE.search(line)
+        if m and f.rule in {r.strip() for r in m.group(1).split(",")}:
+            continue
+        kept.append(f)
+    return kept
+
+
+def iter_py_files(paths: List[str]) -> Iterator[Path]:
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=["keystone_tpu"])
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    repo_root = Path(__file__).resolve().parent.parent
+    total = 0
+    for f in iter_py_files(args.paths or ["keystone_tpu"]):
+        root = repo_root if f.resolve().is_relative_to(repo_root) else None
+        for finding in lint_file(f.resolve() if root else f, repo_root=root):
+            print(finding)
+            total += 1
+    if total:
+        print(f"jaxlint: {total} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

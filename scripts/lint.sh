@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Fast pre-test lint gate: AST-level JAX lints + static validation of
+# every example pipeline. Runs in seconds with no data and no devices
+# beyond the CPU backend (the pipeline validator traces with
+# jax.eval_shape only). Mirrored in tier-1 by the `lint` pytest marker
+# (tests/test_jaxlint.py, tests/test_analysis.py).
+#
+#   scripts/lint.sh              # whole gate
+#   scripts/lint.sh --list-rules # rule catalog
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--list-rules" ]]; then
+    python scripts/jaxlint.py --list-rules
+    JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --list-rules
+    exit 0
+fi
+
+echo "== jaxlint (AST rules) =="
+python scripts/jaxlint.py keystone_tpu
+
+echo "== pipeline validation (abstract specs) =="
+JAX_PLATFORMS=cpu python -m keystone_tpu.analysis "$@"
+
+echo "lint: OK"

@@ -1,0 +1,390 @@
+"""Static pipeline analyzer tests (keystone_tpu/analysis/).
+
+The acceptance contract: `Pipeline.validate()` statically rejects a
+shape-mismatched pipeline and flags a donated-buffer reuse fixture with
+ZERO device allocation (asserted via `jax.live_arrays()` around the
+validate call — everything routes through `jax.eval_shape`)."""
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis import (
+    PipelineValidationError,
+    Severity,
+    SpecDataset,
+    UNKNOWN,
+    validate_graph,
+)
+from keystone_tpu.analysis.examples import EXAMPLES, build_example
+from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.stats import (
+    LinearRectifier,
+    PaddedFFT,
+    RandomSignNode,
+    StandardScaler,
+)
+from keystone_tpu.nodes.util import Cacher, MaxClassifier, VectorCombiner
+from keystone_tpu.workflow import (
+    DatasetOperator,
+    DelegatingOperator,
+    ExpressionOperator,
+    Expression,
+    GatherTransformerOperator,
+    Graph,
+    GraphExecutor,
+    Pipeline,
+    Transformer,
+    TransformerOperator,
+)
+from keystone_tpu.workflow.analysis import children, descendants
+from keystone_tpu.workflow.expressions import TransformerExpression
+
+
+def _no_new_device_arrays():
+    """Context asserting the wrapped block allocates nothing on device."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        before = {id(a) for a in jax.live_arrays()}
+        yield
+        fresh = [a for a in jax.live_arrays() if id(a) not in before]
+        assert not fresh, (
+            f"static validation allocated {len(fresh)} device array(s): "
+            f"{[tuple(a.shape) for a in fresh]}"
+        )
+
+    return ctx()
+
+
+# ------------------------------------------------------------ spec tier
+
+
+def test_shape_mismatch_rejected_with_zero_device_allocation():
+    pipe = RandomSignNode(8).to_pipeline() >> LinearRectifier(0.0)
+    with _no_new_device_arrays():
+        with pytest.raises(PipelineValidationError) as exc:
+            pipe.validate((16,))
+    report = exc.value.report
+    assert any(d.rule == "KP101" for d in report.errors)
+    # a PipelineValidationError is a ValueError (pre-analyzer contract)
+    assert isinstance(exc.value, ValueError)
+
+
+def test_matching_pipeline_validates_and_propagates_specs():
+    branches = [
+        RandomSignNode(16, seed=i) >> PaddedFFT() >> LinearRectifier(0.0)
+        for i in range(2)
+    ]
+    pipe = Pipeline.gather(branches) >> VectorCombiner()
+    with _no_new_device_arrays():
+        report = pipe.validate((16,))
+    assert report.ok
+    out = report.specs[pipe.sink]
+    # two rfft halves of a 16-wide padded FFT, concatenated
+    assert tuple(out.element.shape) == (16,)
+
+
+def test_estimator_fit_spec_and_count_mismatch():
+    feat = RandomSignNode(8).to_pipeline()
+    data = SpecDataset((8,), np.float32, count=32, name="d")
+    good = SpecDataset((3,), np.float32, count=32, name="l")
+    pred = feat.and_then(
+        BlockLeastSquaresEstimator(8, 1, 0.1), data, good) >> MaxClassifier()
+    report = pred.validate((8,))
+    assert report.ok
+    assert tuple(report.specs[pred.sink].element.shape) == ()  # argmax label
+
+    bad = SpecDataset((3,), np.float32, count=33, name="l2")
+    pred2 = feat.and_then(BlockLeastSquaresEstimator(8, 1, 0.1), data, bad)
+    with pytest.raises(PipelineValidationError) as exc:
+        pred2.validate((8,))
+    assert any(d.rule == "KP102" for d in exc.value.report.errors)
+
+
+def test_unknown_specs_propagate_without_false_errors():
+    class _HostOnly(Transformer):
+        def apply(self, x):
+            return x.upper()  # host string code; tracer cannot enter
+
+    pipe = _HostOnly().to_pipeline() >> _HostOnly()
+    report = pipe.validate(None)
+    assert report.ok
+    assert report.specs[pipe.sink] is UNKNOWN or \
+        report.specs[pipe.sink].element is UNKNOWN
+
+
+# ------------------------------------------------------ structural tier
+
+
+def _two_node_cycle():
+    class _Id(TransformerOperator):
+        def batch_transform(self, inputs):
+            return inputs[0]
+
+    g = Graph()
+    g, src = g.add_source()
+    g, a = g.add_node(_Id(), [src])
+    g, b = g.add_node(_Id(), [a])
+    g = g.set_dependencies(a, [b])
+    g, sink = g.add_sink(b)
+    return g, sink
+
+
+def test_cycle_detected_statically_and_at_executor():
+    g, sink = _two_node_cycle()
+    report = validate_graph(g, level="structure")
+    assert any(d.rule == "KP001" for d in report.errors)
+    with pytest.raises(PipelineValidationError):
+        GraphExecutor(g, optimize=False).execute(sink)
+
+
+def test_duplicated_dependency_is_not_a_false_cycle():
+    # CSE merges identical gather branches, leaving the Gather node with
+    # the same dependency twice — toposort must not report a cycle and
+    # the executor must still force the pipeline.
+    t = RandomSignNode(8)
+    pipe = Pipeline.gather([t.to_pipeline(), t.to_pipeline()])
+    assert pipe.validate((8,), raise_on_error=False).ok
+    from keystone_tpu.data.dataset import Dataset
+
+    out = pipe(Dataset(np.ones((4, 8), np.float32))).get()
+    assert len(out) == 4
+
+
+def test_structural_error_reraised_on_retry():
+    g, sink = _two_node_cycle()
+    ex = GraphExecutor(g, optimize=False)
+    with pytest.raises(PipelineValidationError):
+        ex.execute(sink)
+    with pytest.raises(PipelineValidationError):  # not silently skipped
+        ex.execute(sink)
+
+
+def test_fit_before_use_flagged():
+    class _Dense(Transformer):
+        def apply(self, x):
+            return x
+
+    g = Graph()
+    g, data = g.add_node(
+        DatasetOperator(SpecDataset((4,), count=8), "x"), [])
+    g, est = g.add_node(StandardScaler(), [data])
+    g, bad = g.add_node(_Dense(), [est])  # estimator output used as data
+    g, sink = g.add_sink(bad)
+    report = validate_graph(g, level="structure")
+    assert any(d.rule == "KP003" and d.severity == Severity.ERROR
+               for d in report.errors)
+
+
+def test_delegate_without_estimator_flagged():
+    g = Graph()
+    g, data = g.add_node(
+        DatasetOperator(SpecDataset((4,), count=8), "x"), [])
+    g, delegate = g.add_node(DelegatingOperator(), [data, data])
+    g, sink = g.add_sink(delegate)
+    report = validate_graph(g, level="structure")
+    assert any(d.rule == "KP004" for d in report.errors)
+    # the executor's automatic structural gate keeps the old
+    # ValueError-at-force contract, just earlier and with a rule id
+    with pytest.raises(ValueError):
+        GraphExecutor(g, optimize=False).execute(sink)
+
+
+def test_dangling_source_warns():
+    g = Graph()
+    g, _src = g.add_source()
+    g, data = g.add_node(
+        DatasetOperator(SpecDataset((4,), count=8), "x"), [])
+    g, sink = g.add_sink(data)
+    report = validate_graph(g, level="structure")
+    assert any(d.rule == "KP005" for d in report.warnings)
+    assert report.ok  # warnings only
+
+
+# --------------------------------------------------------- hazard tier
+
+
+class _StreamOrigin(Transformer):
+    """Fixture stream producer (overridden streaming batch path)."""
+
+    def apply(self, x):
+        return x
+
+    def apply_batch_stream(self, data):
+        yield list(range(len(data.items))), list(data.items)
+
+
+class _DenseStage(Transformer):
+    def apply(self, x):
+        return x
+
+
+def test_donated_buffer_reuse_flagged_with_zero_device_allocation():
+    class _DonatingSolver(TransformerOperator):
+        donates_deps = (0,)
+
+        def batch_transform(self, inputs):
+            return inputs[0]
+
+    g = Graph()
+    g, producer = g.add_node(
+        DatasetOperator(SpecDataset((128,), count=64), "X"), [])
+    g, donor = g.add_node(_DonatingSolver(), [producer])
+    g, sink1 = g.add_sink(donor)
+    g, sink2 = g.add_sink(producer)  # producer still reachable: hazard
+    with _no_new_device_arrays():
+        report = validate_graph(g)
+    kp301 = report.by_rule("KP301")
+    assert kp301 and kp301[0].severity == Severity.ERROR
+    # suppression channel
+    assert validate_graph(g, ignore=["KP301"]).ok
+
+
+def test_donation_reuse_within_same_node_flagged():
+    class _DonatingSolver(TransformerOperator):
+        donates_deps = (0,)
+
+        def batch_transform(self, inputs):
+            return inputs[0]
+
+    g = Graph()
+    g, producer = g.add_node(
+        DatasetOperator(SpecDataset((128,), count=64), "X"), [])
+    # the node reads the donated buffer AGAIN at dep index 1 (the
+    # duplicated-dep topology CSE-merged branches produce)
+    g, donor = g.add_node(_DonatingSolver(), [producer, producer])
+    g, sink = g.add_sink(donor)
+    report = validate_graph(g)
+    kp301 = report.by_rule("KP301")
+    assert kp301 and "dependency index 1" in kp301[0].message
+
+
+def test_donation_without_reuse_is_clean():
+    class _DonatingSolver(TransformerOperator):
+        donates_deps = (0,)
+
+        def batch_transform(self, inputs):
+            return inputs[0]
+
+    g = Graph()
+    g, producer = g.add_node(
+        DatasetOperator(SpecDataset((128,), count=64), "X"), [])
+    g, donor = g.add_node(_DonatingSolver(), [producer])
+    g, sink = g.add_sink(donor)
+    assert not validate_graph(g).by_rule("KP301")
+
+
+def test_streaming_materialization_warning():
+    pipe = _StreamOrigin().to_pipeline() >> _DenseStage()
+    report = pipe.validate(None, raise_on_error=False)
+    assert report.by_rule("KP302")
+
+    class _Chunkable(_DenseStage):
+        chunkable = True
+
+    ok = _StreamOrigin().to_pipeline() >> _Chunkable()
+    assert not ok.validate(None, raise_on_error=False).by_rule("KP302")
+
+
+def test_cache_on_streaming_stage_warning():
+    pipe = _StreamOrigin().to_pipeline() >> Cacher("c")
+    report = pipe.validate(None, raise_on_error=False)
+    assert report.by_rule("KP303")
+
+
+# --------------------------------------------------------- memory tier
+
+
+def test_memory_budget_warnings():
+    big = SpecDataset((1024, 256), np.float32, count=256, name="big")  # 256 MiB
+    pipe = _DenseStage().to_pipeline() >> Cacher("keep")
+    applied = pipe.apply(big)
+    report = applied.validate(
+        level="memory", hbm_budget_bytes=64 << 20, raise_on_error=False)
+    rules = {d.rule for d in report.warnings}
+    assert "KP201" in rules and "KP202" in rules
+    assert report.memory.peak_bytes >= 256 << 20
+    # a generous budget is quiet
+    quiet = applied.validate(
+        level="memory", hbm_budget_bytes=16 << 30, raise_on_error=False)
+    assert not quiet.warnings
+
+
+# ------------------------------------------------- examples + CLI gate
+
+
+@pytest.mark.lint
+@pytest.mark.parametrize("name", sorted(EXAMPLES))
+def test_example_pipelines_validate(name):
+    pipeline, source_spec = build_example(name)
+    report = pipeline.validate(source_spec, raise_on_error=False)
+    assert not report.errors, "\n".join(map(str, report.errors))
+
+
+# ------------------------------------------------ reverse-adjacency index
+
+
+def test_users_index_matches_children_descendants():
+    branches = [
+        RandomSignNode(16, seed=i) >> PaddedFFT() >> LinearRectifier(0.0)
+        for i in range(3)
+    ]
+    g = (Pipeline.gather(branches) >> VectorCombiner()).graph
+
+    def brute_children(vid):
+        out = set()
+        for n, deps in g.dependencies.items():
+            if vid in deps:
+                out.add(n)
+        for s, d in g.sink_dependencies.items():
+            if d == vid:
+                out.add(s)
+        return out
+
+    for vid in list(g.operators) + list(g.sources):
+        assert set(g.users_of(vid)) == brute_children(vid)
+        assert children(g, vid) == brute_children(vid)
+    # descendants of the source reach every node and sink
+    assert descendants(g, next(iter(g.sources))) == (
+        set(g.operators) | set(g.sink_dependencies))
+
+
+# ------------------------------------------------------- label audit
+
+
+def test_operator_labels_stable_unique_and_diagnostic_keyed():
+    ops = {
+        "dataset-a": DatasetOperator(SpecDataset((2,), count=2), "a"),
+        "dataset-b": DatasetOperator(SpecDataset((2,), count=2), "b"),
+        "gather": GatherTransformerOperator(),
+        "delegate": DelegatingOperator(),
+        "saved-1": ExpressionOperator(Expression.of(1), "s1"),
+        "saved-2": ExpressionOperator(
+            TransformerExpression(lambda: None), "s2"),
+        "cacher-1": Cacher("c1"),
+        "cacher-2": Cacher("c2"),
+        "fn": Transformer.from_function(lambda x: x, name="fn1"),
+        "sign": RandomSignNode(4),
+        "scaler": StandardScaler(),
+        "solver": BlockLeastSquaresEstimator(2, 1),
+        "argmax": MaxClassifier(),
+    }
+    labels = {k: op.label for k, op in ops.items()}
+    for k, lab in labels.items():
+        assert isinstance(lab, str) and lab, f"{k} has an empty label"
+        assert ops[k].label == lab, f"{k} label is unstable"
+    # named operators must not collide
+    assert labels["dataset-a"] != labels["dataset-b"]
+    assert labels["saved-1"] != labels["saved-2"]
+    assert labels["cacher-1"] != labels["cacher-2"]
+    assert labels["gather"] == "Gather"
+
+    # diagnostics key on label@vertex: unique across every example graph
+    for name in sorted(EXAMPLES):
+        g = build_example(name)[0].graph
+        anchors = {
+            f"{g.get_operator(n).label}@{n}" for n in g.operators
+        }
+        assert len(anchors) == len(g.operators), name

@@ -1,0 +1,134 @@
+"""jaxlint (scripts/jaxlint.py) — the repo-wide AST lint gate.
+
+Marked ``lint``: these run in tier-1 (they are fast and data-free) and
+mirror `scripts/lint.sh`'s first stage, so CI and pytest cannot drift."""
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _jaxlint():
+    spec = importlib.util.spec_from_file_location(
+        "jaxlint", REPO / "scripts" / "jaxlint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_is_clean():
+    jl = _jaxlint()
+    findings = []
+    for f in sorted((REPO / "keystone_tpu").rglob("*.py")):
+        findings.extend(jl.lint_file(f, repo_root=REPO))
+    assert not findings, "\n".join(map(str, findings))
+
+
+def test_seeded_violations_are_caught(tmp_path):
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "learning" / "bad_solver.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "from functools import partial\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def fit(xs, W):\n"
+        "    acc = jnp.zeros(4)\n"
+        "    for x in xs:\n"
+        "        acc = acc + jnp.dot(W, x)\n"     # KJ001
+        "    return acc\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def apply(x):\n"
+        "    return np.sum(x)\n"                   # KJ002
+        "\n"
+        "\n"
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def _bad_step(W, R, n):\n"                # KJ003: no donate_argnums
+        "    return W + R, R\n"
+    )
+    rules = sorted({f.rule for f in jl.lint_file(bad)})
+    assert rules == ["KJ001", "KJ002", "KJ003"]
+
+
+def test_suppression_comment_honored(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "nodes" / "ok.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def fit(xs, W):\n"
+        "    acc = jnp.zeros(4)\n"
+        "    for x in xs:\n"
+        "        acc = acc + jnp.dot(W, x)  # keystone: ignore[KJ001]\n"
+        "    return acc\n"
+    )
+    assert jl.lint_file(f) == []
+
+
+def test_nested_loop_reports_once(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "nodes" / "nested.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def fit(xss, total):\n"
+        "    for xs in xss:\n"
+        "        for x in xs:\n"
+        "            total += jnp.dot(x, x)\n"
+        "    return total\n"
+    )
+    findings = jl.lint_file(f)
+    assert len(findings) == 1 and findings[0].rule == "KJ001"
+
+
+def test_donate_argnums_present_passes(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "nodes" / "learning" / "good_solver.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "from functools import partial\n"
+        "import jax\n"
+        "\n"
+        "\n"
+        "@partial(jax.jit, donate_argnums=(0, 1))\n"
+        "def _good_step(W, R):\n"
+        "    return W + R, R\n"
+    )
+    assert jl.lint_file(f) == []
+
+
+def test_lint_sh_gate(tmp_path):
+    """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
+    a seeded violation (the acceptance contract)."""
+    clean = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "jaxlint.py"),
+         str(REPO / "keystone_tpu")],
+        capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad = tmp_path / "nodes" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\nimport numpy as np\n\n\n"
+        "@jax.jit\ndef f(x):\n    return np.sum(x)\n")
+    seeded = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "jaxlint.py"), str(bad)],
+        capture_output=True, text=True)
+    assert seeded.returncode == 1
+    assert "KJ002" in seeded.stdout

@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r"""
@@ -94,6 +96,20 @@ print("ORBAX_OK")
 
 
 def test_orbax_roundtrip_subprocess(tmp_path):
+    import importlib.util
+
+    import jax
+
+    # Capability probes mirroring what the WORKER script needs: the
+    # jax_num_cpu_devices config knob (absent on jaxlib < 0.5 — the
+    # worker would die in its first jax.config.update) and orbax itself.
+    if not hasattr(jax.config, "jax_num_cpu_devices"):
+        pytest.skip(
+            "jax.config has no jax_num_cpu_devices option on this "
+            "jax/jaxlib; the orbax worker cannot size its device mesh"
+        )
+    if importlib.util.find_spec("orbax") is None:
+        pytest.skip("orbax is not installed")
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
