@@ -861,6 +861,12 @@ def child_main(args):
                       "zero-egress environment; synthetic learnable task at "
                       "identical shapes/scale with CALIBRATED difficulty "
                       "(see BENCH notes in README)"),
+        # With KEYSTONE_TRACE set the child's ambient tracer writes a
+        # Chrome trace (all tiers' spans: node forces, stream chunks,
+        # solver iterations, queue stalls) at exit; the record carries
+        # the path so BENCH rounds keep span-level detail
+        # (`scripts/perf_table.py --trace <path>` to render).
+        "trace_artifact": os.environ.get("KEYSTONE_TRACE") or None,
     }
     # Checkpoint: a wedge during the staged/flagship phases still leaves
     # a live headline measurement in the parent's hands.

@@ -1,0 +1,130 @@
+"""Static-vs-observed memory reconciliation.
+
+"Memory Safe Computations with XLA Compiler" (arxiv 2206.14148) builds
+its case on compile-time memory estimates being *checked* against
+observed peaks; our KP2xx lints (memory.py) emit the static side but
+until now nothing validated them against a real run. The telemetry layer
+closes the loop: when a trace is active, `GraphExecutor` embeds the
+analyzer's per-node byte estimates in the trace metadata
+(``keystone.static_memory``), and every node force records its observed
+output bytes (``out_bytes`` span arg) plus the running live-set gauge.
+This module diffs the two, producing the estimation-error table
+`python -m keystone_tpu.telemetry <trace>` prints — the calibration data
+for tightening KP201/KP202 budget lints.
+
+Keys are ``"<vertex_id>:<label>"``: vertex ids are per-graph, so the
+label disambiguates the common fit-graph/apply-graph id collisions; a
+node forced in several executors under the same key keeps its largest
+observed force (peak residency is what the static model predicts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def node_key(vertex, label: str) -> str:
+    return f"{vertex}:{label}"
+
+
+def observed_node_bytes(trace: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """key → {label, vertex, bytes, forces} from ``cat="node"`` spans."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("cat") != "node":
+            continue
+        args = e.get("args", {})
+        vertex = args.get("vertex")
+        if vertex is None:
+            continue
+        label = e.get("name", "")
+        if label.startswith("force "):
+            label = label[len("force "):]
+        key = node_key(vertex, label)
+        rec = out.setdefault(key, {
+            "label": label, "vertex": vertex, "bytes": 0.0, "forces": 0,
+        })
+        rec["forces"] += 1
+        rec["bytes"] = max(rec["bytes"], float(args.get("out_bytes", 0.0) or 0.0))
+    return out
+
+
+def reconcile_trace(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Join the trace's static estimates against its observed bytes.
+
+    Returns ``{"rows": [...], "static_peak_bytes", "observed_peak_bytes",
+    "peak_rel_error"}`` where each row carries ``label``, ``vertex``,
+    ``static_bytes``, ``observed_bytes`` and ``rel_error`` (signed,
+    relative to the observation: +1.0 means the model predicted double).
+    Nodes with only one side known are reported with ``rel_error=None``
+    so coverage gaps stay visible instead of silently dropping."""
+    ks = trace.get("keystone", {})
+    static = (ks.get("static_memory") or {}).get("per_node", {})
+    observed = observed_node_bytes(trace)
+    rows: List[Dict[str, Any]] = []
+    for key in sorted(set(static) | set(observed)):
+        s = static.get(key)
+        o = observed.get(key)
+        static_b: Optional[float] = float(s["bytes"]) if s else None
+        obs_b: Optional[float] = float(o["bytes"]) if o else None
+        rel: Optional[float] = None
+        if static_b is not None and obs_b:
+            rel = (static_b - obs_b) / obs_b
+        rows.append({
+            "key": key,
+            "label": (s or o)["label"],
+            "vertex": (s or o).get("vertex", key.split(":", 1)[0]),
+            "static_bytes": static_b,
+            "observed_bytes": obs_b,
+            "rel_error": rel,
+        })
+    # nodes with both sides first, largest observation first — the head
+    # of the table is what calibration actually reads
+    rows.sort(key=lambda r: (r["rel_error"] is None,
+                             -(r["observed_bytes"] or 0.0)))
+    static_peak = (ks.get("static_memory") or {}).get("peak_bytes")
+    # per-run peak tracked on the tracer; the registry gauge is
+    # cumulative across every run in the process, so it is only a
+    # fallback for traces written before the per-run field existed
+    observed_peak = ks.get("observed_live_peak_bytes") or (
+        ks.get("metrics", {}).get("gauges", {})
+        .get("executor.live_bytes", {}).get("max")
+    )
+    peak_rel = None
+    if static_peak and observed_peak:
+        peak_rel = (static_peak - observed_peak) / observed_peak
+    return {
+        "rows": rows,
+        "static_peak_bytes": static_peak,
+        "observed_peak_bytes": observed_peak,
+        "peak_rel_error": peak_rel,
+    }
+
+
+def _fmt(n: Optional[float]) -> str:
+    if n is None:
+        return "—"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024
+    return str(n)
+
+
+def format_reconciliation(rec: Dict[str, Any], top: int = 20) -> str:
+    lines = ["== static vs observed memory (KP2xx calibration) =="]
+    lines.append(f"{'node':<40} {'static':>10} {'observed':>10} {'err %':>8}")
+    for r in rec["rows"][:top]:
+        err = (f"{100 * r['rel_error']:+.1f}%"
+               if r["rel_error"] is not None else "—")
+        lines.append(
+            f"{r['label'][:40]:<40} {_fmt(r['static_bytes']):>10} "
+            f"{_fmt(r['observed_bytes']):>10} {err:>8}"
+        )
+    sp, op_, pr = (rec["static_peak_bytes"], rec["observed_peak_bytes"],
+                   rec["peak_rel_error"])
+    if sp is not None or op_ is not None:
+        err = f"{100 * pr:+.1f}%" if pr is not None else "—"
+        lines.append(
+            f"{'PEAK LIVE SET':<40} {_fmt(sp):>10} {_fmt(op_):>10} {err:>8}")
+    return "\n".join(lines)

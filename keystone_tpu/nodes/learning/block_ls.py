@@ -315,7 +315,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             x_sharding=meshlib.feature_sharding(data.mesh, d_pad),
         )
         lam = jnp.asarray(self.lam, X.dtype)
-        for _ in range(self.num_iter):
-            W, R = _bcd_epoch(W, R, Xc, lam, bs, num_blocks)
+        from ...telemetry import counter, span
+
+        for i in range(self.num_iter):
+            # span measures the host-side dispatch of one donated-buffer
+            # sweep; device time pipelines asynchronously and lands on
+            # whoever pulls the model (see OBSERVABILITY.md)
+            with span("bcd_epoch", cat="step", iter=i, blocks=num_blocks):
+                W, R = _bcd_epoch(W, R, Xc, lam, bs, num_blocks)
+            counter("solver.steps").inc()
         W, b = _bcd_finalize(W, xm, ym)
         return BlockLinearMapper(W, b if self.fit_intercept else None, self.block_size)

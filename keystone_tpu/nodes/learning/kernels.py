@@ -290,6 +290,7 @@ class KernelRidgeRegression(LabelEstimator):
         lam = jnp.asarray(self.lam, X.dtype)
         gamma = float(self.gamma)
         done = 0
+        from ...telemetry import counter, span
         for epoch in range(start_epoch, self.num_epochs):
             # per-epoch seed so a resumed run replays identical block orders
             perm = np.random.default_rng(self.seed + epoch).permutation(data.count)
@@ -298,10 +299,12 @@ class KernelRidgeRegression(LabelEstimator):
             first = start_block if epoch == start_epoch else 0
             for b in range(first, n_blocks):
                 block_ids = jnp.asarray(ids[b * B : (b + 1) * B], jnp.int32)
-                alpha, KA = _krr_step(
-                    X, Y, mask, alpha, KA, lam, gamma, block_ids,
-                    use_pal=_use_pallas_now(),
-                )
+                with span("krr_step", cat="step", epoch=epoch, block=b):
+                    alpha, KA = _krr_step(
+                        X, Y, mask, alpha, KA, lam, gamma, block_ids,
+                        use_pal=_use_pallas_now(),
+                    )
+                counter("solver.steps").inc()
                 done += 1
                 if ckpt and done % self.blocks_before_checkpoint == 0:
                     # atomic write: a crash mid-save must not corrupt the

@@ -1,0 +1,18 @@
+"""`python -m keystone_tpu.pipelines <Name> [flags]` — alias for the
+top-level launcher (`python -m keystone_tpu`), so the example apps are
+runnable from the package that houses them:
+
+    KEYSTONE_TRACE=run.json python -m keystone_tpu.pipelines \\
+        MnistRandomFFT --num-ffts 2
+
+With ``KEYSTONE_TRACE`` set the run writes a Chrome trace at exit;
+summarize it with ``python -m keystone_tpu.telemetry run.json``
+(see OBSERVABILITY.md).
+"""
+
+import sys
+
+from ..__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Unified runtime telemetry: hierarchical spans, a process-wide metrics
+registry, Chrome trace-event export, and the instrumentation hooks the
+executor / overlap engine / solver loops report through.
+
+Span hierarchy (structural, via per-thread stacks):
+
+    pipeline run → optimizer phase → node force → stream chunk
+                                                → solver iteration
+
+Quick start:
+
+    from keystone_tpu.telemetry import trace_run
+    with trace_run("run.json"):
+        pipeline(data).get()
+    # -> run.json loads in chrome://tracing / Perfetto
+
+    KEYSTONE_TRACE=run.json python -m keystone_tpu.pipelines MnistRandomFFT
+    python -m keystone_tpu.telemetry run.json   # summarize
+
+Metric names, the span model, and the static-vs-observed memory
+reconciliation workflow are documented in OBSERVABILITY.md.
+"""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    registry,
+)
+from .spans import (
+    SpanRecord,
+    Tracer,
+    capabilities,
+    current_tracer,
+    record_capability,
+    set_tracer,
+    span,
+    telemetry_active,
+    trace_run,
+)
+from .export import (
+    aggregate_spans,
+    load_trace,
+    self_times,
+    summarize,
+    to_chrome_trace,
+    write_trace,
+)
+from .instrument import estimate_bytes, instrument_node_force
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "counter", "gauge", "histogram", "registry",
+    "SpanRecord", "Tracer", "capabilities", "current_tracer",
+    "record_capability", "set_tracer", "span", "telemetry_active",
+    "trace_run",
+    "aggregate_spans", "load_trace", "self_times", "summarize",
+    "to_chrome_trace", "write_trace",
+    "estimate_bytes", "instrument_node_force",
+]

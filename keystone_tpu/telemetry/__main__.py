@@ -1,0 +1,59 @@
+"""Trace summary CLI.
+
+    python -m keystone_tpu.telemetry run.json [--top N] [--json]
+
+Prints the span digest (top nodes by self-time, solver iteration and
+stream-chunk totals), overlap queue-stall totals, bytes moved, and —
+when the trace carries the static analyzer's estimates — the
+static-vs-observed memory reconciliation table that calibrates the
+KP2xx model (see OBSERVABILITY.md; rule catalog in ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .export import aggregate_spans, load_trace, summarize
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m keystone_tpu.telemetry",
+        description=__doc__.splitlines()[0],
+    )
+    p.add_argument("trace", help="Chrome trace JSON written by trace_run / "
+                                 "KEYSTONE_TRACE")
+    p.add_argument("--top", type=int, default=15,
+                   help="rows per section (default 15)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable digest (perf_table.py input)")
+    args = p.parse_args(argv)
+    try:
+        trace = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        digest = {
+            "nodes": aggregate_spans(trace, "node"),
+            "steps": aggregate_spans(trace, "step"),
+            "chunks": aggregate_spans(trace, "chunk"),
+            "metrics": trace.get("keystone", {}).get("metrics", {}),
+        }
+        try:
+            from ..analysis.reconcile import reconcile_trace
+
+            digest["memory_reconciliation"] = reconcile_trace(trace)
+        except Exception:
+            pass
+        json.dump(digest, sys.stdout, indent=1)
+        print()
+    else:
+        print(summarize(trace, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

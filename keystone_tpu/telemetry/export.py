@@ -1,0 +1,208 @@
+"""Chrome trace-event JSON export and trace summarization.
+
+The export is the Trace Event Format's object form — a ``traceEvents``
+list of complete (``"ph": "X"``) events plus counter (``"ph": "C"``)
+samples — loadable in ``chrome://tracing`` / Perfetto unchanged. Keystone
+extras ride in a top-level ``"keystone"`` object Chrome ignores:
+the metrics-registry snapshot, environment capability probes, and the
+static analyzer's per-node memory estimates (what `analysis.reconcile`
+diffs against the observed bytes).
+
+Span hierarchy survives the export: every event's ``args`` carries
+``span_id`` and (when nested) ``parent_id``, so summaries can compute
+*self* time — a span's duration minus its direct children — which is the
+per-node attribution the auto-cacher and PERF rounds care about.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from .metrics import registry
+from .spans import Tracer, capabilities
+
+
+def to_chrome_trace(tracer: Tracer) -> Dict[str, Any]:
+    """Render ``tracer`` (+ the current metrics registry and capability
+    probes) as a Chrome trace object."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "keystone_tpu"},
+    }]
+    for s in tracer.spans:
+        args = dict(s.args)
+        args["span_id"] = s.sid
+        if s.parent is not None:
+            args["parent_id"] = s.parent
+        if s.error:
+            args["error"] = True
+        events.append({
+            "name": s.name,
+            "cat": s.cat,
+            "ph": "X",
+            "ts": round(s.t0 * 1e6, 3),
+            "dur": round(s.dur * 1e6, 3),
+            "pid": pid,
+            "tid": s.tid,
+            "args": args,
+        })
+    for name, t, value, tid in tracer.counter_samples:
+        events.append({
+            "name": name,
+            "ph": "C",
+            "ts": round(t * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+            "args": {"value": value},
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "keystone": {
+            "wall_epoch": tracer.wall_epoch,
+            "metrics": registry().snapshot(),
+            "capabilities": capabilities(),
+            **tracer.metadata,
+        },
+    }
+
+
+def write_trace(tracer: Tracer, path: str) -> str:
+    trace = to_chrome_trace(tracer)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, path)  # atomic: a killed process never leaves half a trace
+    return path
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        trace = json.load(f)
+    if not isinstance(trace, dict) or "traceEvents" not in trace:
+        raise ValueError(f"{path} is not a Chrome trace object (no traceEvents)")
+    return trace
+
+
+# ------------------------------------------------------------- summaries
+
+
+def _complete_events(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+
+
+def self_times(trace: Dict[str, Any]) -> Dict[int, float]:
+    """span_id → self-time µs (duration minus direct children)."""
+    events = _complete_events(trace)
+    child_dur: Dict[int, float] = {}
+    for e in events:
+        parent = e.get("args", {}).get("parent_id")
+        if parent is not None:
+            child_dur[parent] = child_dur.get(parent, 0.0) + e.get("dur", 0.0)
+    out: Dict[int, float] = {}
+    for e in events:
+        sid = e.get("args", {}).get("span_id")
+        if sid is not None:
+            out[sid] = max(0.0, e.get("dur", 0.0) - child_dur.get(sid, 0.0))
+    return out
+
+
+def aggregate_spans(
+    trace: Dict[str, Any], cat: Optional[str] = None
+) -> Dict[str, Dict[str, float]]:
+    """name → {count, total_s, self_s, bytes} over complete events,
+    optionally restricted to one category."""
+    selfs = self_times(trace)
+    agg: Dict[str, Dict[str, float]] = {}
+    for e in _complete_events(trace):
+        if cat is not None and e.get("cat") != cat:
+            continue
+        a = agg.setdefault(e["name"], {
+            "count": 0, "total_s": 0.0, "self_s": 0.0, "bytes": 0.0,
+        })
+        a["count"] += 1
+        a["total_s"] += e.get("dur", 0.0) / 1e6
+        sid = e.get("args", {}).get("span_id")
+        a["self_s"] += selfs.get(sid, e.get("dur", 0.0)) / 1e6
+        a["bytes"] += float(e.get("args", {}).get("out_bytes", 0.0) or 0.0)
+    return agg
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def summarize(trace: Dict[str, Any], top: int = 15) -> str:
+    """Human-readable trace digest: top spans by self-time per category,
+    prefetch stall totals, bytes moved, and (when the trace carries the
+    analyzer's static estimates) the static-vs-observed memory
+    reconciliation table."""
+    lines: List[str] = []
+    n_events = len(_complete_events(trace))
+    lines.append(f"{n_events} span(s)")
+
+    for cat, title in (("node", "top node forces by self-time"),
+                       ("step", "solver iterations"),
+                       ("chunk", "stream chunks")):
+        agg = aggregate_spans(trace, cat)
+        if not agg:
+            continue
+        lines.append(f"\n== {title} ==")
+        lines.append(f"{'name':<44} {'self s':>9} {'total s':>9} "
+                     f"{'count':>6} {'bytes':>12}")
+        rows = sorted(agg.items(), key=lambda kv: -kv[1]["self_s"])
+        for name, a in rows[:top]:
+            lines.append(
+                f"{name[:44]:<44} {a['self_s']:>9.4f} {a['total_s']:>9.4f} "
+                f"{int(a['count']):>6} {_fmt_bytes(a['bytes']):>12}"
+            )
+
+    ks = trace.get("keystone", {})
+    hist = ks.get("metrics", {}).get("histograms", {})
+    stall = hist.get("prefetch.producer_stall_s")
+    wait = hist.get("prefetch.consumer_wait_s")
+    if stall or wait:
+        lines.append("\n== overlap queue stalls ==")
+        if stall:
+            lines.append(
+                f"producer stall: {stall['total']:.4f}s total over "
+                f"{int(stall['count'])} put(s) (max {stall['max']:.4f}s)")
+        if wait:
+            lines.append(
+                f"consumer wait:  {wait['total']:.4f}s total over "
+                f"{int(wait['count'])} get(s) (max {wait['max']:.4f}s)")
+    counters = ks.get("metrics", {}).get("counters", {})
+    moved = counters.get("overlap.bytes_pulled", {}).get("value")
+    if moved:
+        lines.append(f"\nbytes pulled off device: {_fmt_bytes(moved)}")
+    live = ks.get("observed_live_peak_bytes") or (
+        ks.get("metrics", {}).get("gauges", {})
+        .get("executor.live_bytes", {}).get("max"))
+    if live:
+        lines.append(f"observed peak live set: {_fmt_bytes(live)}")
+
+    try:
+        from ..analysis.reconcile import format_reconciliation, reconcile_trace
+
+        rec = reconcile_trace(trace)
+        if rec["rows"]:
+            lines.append("")
+            lines.append(format_reconciliation(rec))
+    except Exception as e:  # a malformed trace must still summarize
+        lines.append(f"\n(memory reconciliation unavailable: {e})")
+
+    caps = ks.get("capabilities") or {}
+    absent = {k: v for k, v in caps.items() if not v.get("available", True)}
+    if absent:
+        lines.append("\n== absent capabilities ==")
+        for name, v in sorted(absent.items()):
+            reason = v.get("reason", "")
+            lines.append(f"{name}: {reason}" if reason else name)
+    return "\n".join(lines)

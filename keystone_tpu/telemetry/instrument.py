@@ -1,0 +1,191 @@
+"""Node-force instrumentation — the one wrapper every profile consumer
+shares.
+
+`GraphExecutor` wraps each node's lazy Expression through
+`instrument_node_force`; the wrapper times the real force (try/finally,
+so a thunk that raises still reports its elapsed time and bumps the
+failure counter), estimates output bytes ONCE per force with the
+module-level `estimate_bytes` (no per-force import — the old
+`ExecutionProfiler.wrap` re-imported it inside the thunk on every
+force), opens a ``cat="node"`` span under the active tracer, feeds the
+observed live-set accounting, and notifies the attached profiler.
+Streaming expressions — which downstream consumers drain through
+``iter_chunks()`` without ever running the memoized thunk — are
+instrumented at the chunk generator instead (`_instrument_stream`), so
+they too appear in spans, profiles, and reconciliation. Because
+`utils.profiling.ExecutionProfiler` and `workflow.autocache.profile_nodes`
+both consume these span completions, cache decisions and user-facing
+profile reports can never disagree about a measurement.
+
+Timing semantics: with a profiler attached the forced value is
+``.sync()``-ed (scalar pull) so device compute is honestly attributed to
+the producing node — the contract `profile_nodes` and
+`profile_execution` always had. Under pure tracing no sync is injected:
+a trace must observe the overlap engine, not serialize it, so node spans
+measure dispatch+materialization and the *stall* time shows up where it
+is actually paid (chunk drains, consumer waits).
+"""
+
+from __future__ import annotations
+
+from time import perf_counter
+from typing import Optional
+
+from .metrics import counter, gauge
+from .spans import current_tracer
+
+
+def estimate_bytes(value) -> float:
+    """Estimated host/device bytes of a forced value: array leaves by
+    ``nbytes``, strings/bytes by length, opaque leaves at a nominal 64.
+    Canonical home of the estimator previously private to
+    `workflow.autocache` (which still re-exports it). Dataset-likes
+    unwrap to their payload: ``.data`` (device `Dataset`) or ``.items``
+    (`HostDataset` — summed per item, so a host stage's output is its
+    real residency, not one opaque-leaf placeholder)."""
+    import jax
+
+    payload = getattr(value, "data", None)
+    if payload is None:
+        payload = getattr(value, "items", None)
+    if payload is None:
+        payload = value
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(payload):
+        if hasattr(leaf, "nbytes"):
+            total += float(leaf.nbytes)
+        elif isinstance(leaf, (bytes, str)):
+            total += len(leaf)
+        else:
+            total += 64.0
+    return total
+
+
+def _record_node(label, vertex, profiler, dt, nbytes, failed,
+                 t0_rel=None, streamed=False):
+    """Shared completion bookkeeping for both force paths."""
+    counter("executor.node_forces").inc()
+    if failed:
+        counter("executor.node_failures").inc()
+    elif nbytes:
+        # memoized outputs stay live for the executor's lifetime: the
+        # running sum's high-water mark is the observed live-set peak
+        # the static KP2xx model reconciles against (per-run copy on the
+        # tracer; the registry gauge is cumulative across runs)
+        gauge("executor.live_bytes").add(nbytes)
+        tracer = current_tracer()
+        if tracer is not None:
+            tracer.add_live_bytes(nbytes)
+    if streamed:
+        tracer = current_tracer()
+        if tracer is not None and t0_rel is not None:
+            tracer.record_complete(
+                f"force {label}", "node", t0_rel, dt, error=failed,
+                vertex=vertex, out_bytes=nbytes, seconds=round(dt, 6),
+                streamed=True)
+    if profiler is not None:
+        profiler.on_force(label, dt, nbytes, failed=failed, vertex=vertex)
+
+
+def _instrument_stream(label, expr, vertex, profiler):
+    """Streamed stages are drained through ``iter_chunks()`` — the
+    memoized ``_thunk`` never runs on that path, so wrap the chunk
+    generator instead. Per-pull timing keeps the consumer's
+    between-chunk work OUT of this stage's duration (drains interleave
+    with downstream compute by design); on exhaustion one closed
+    ``cat="node"`` span is recorded via `Tracer.record_complete`
+    (``streamed=True``, ``dur`` = cumulative pull time) and the profiler
+    is notified — so streamed stages appear in profiles, reconciliation,
+    and live-set accounting instead of silently folding into their
+    consumer. Early close (`GeneratorExit`) records nothing: the stream
+    is resumable and will complete (and report) later."""
+    orig_chunks = expr._chunks_thunk
+
+    def chunks():
+        it = orig_chunks()
+        total = 0.0
+        nbytes = 0.0
+        t0_rel = None
+        while True:
+            t0 = perf_counter()
+            if t0_rel is None:
+                tracer = current_tracer()
+                t0_rel = tracer.now() if tracer is not None else 0.0
+            try:
+                item = next(it)
+            except StopIteration:
+                total += perf_counter() - t0
+                _record_node(label, vertex, profiler, total, nbytes,
+                             failed=False, t0_rel=t0_rel, streamed=True)
+                return
+            except GeneratorExit:
+                raise  # early close: resumable, not a completion
+            except BaseException:
+                total += perf_counter() - t0
+                _record_node(label, vertex, profiler, total, 0.0,
+                             failed=True, t0_rel=t0_rel, streamed=True)
+                raise
+            total += perf_counter() - t0
+            try:
+                nbytes += estimate_bytes(item[1])
+            except Exception:
+                pass
+            yield item
+
+    expr._chunks_thunk = chunks
+    return expr
+
+
+def instrument_node_force(
+    label: str,
+    expr,
+    vertex: Optional[int] = None,
+    profiler=None,
+):
+    """Wrap ``expr`` so its force reports spans + metrics + profiler
+    completions. Streaming expressions get their chunk generator wrapped
+    (see `_instrument_stream`); plain expressions get their thunk
+    wrapped. Already-forced expressions pass through untouched. Safe to
+    call with neither tracer nor profiler active — but the executor
+    guards the call, so the untraced hot path never even reaches here."""
+    if getattr(expr, "_chunks_thunk", None) is not None \
+            and not expr.is_forced:
+        return _instrument_stream(label, expr, vertex, profiler)
+    orig_thunk = expr._thunk
+    if orig_thunk is None:  # already forced; nothing to time
+        return expr
+
+    def forced():
+        tracer = current_tracer()
+        rec = None
+        if tracer is not None:
+            rec = tracer.start(f"force {label}", cat="node", vertex=vertex)
+        t0 = perf_counter()
+        value = None
+        failed = False
+        try:
+            value = orig_thunk()
+            if profiler is not None and hasattr(value, "sync"):
+                value.sync()  # scalar-pull sync so device time lands on
+                # this node (block_until_ready is a no-op through the
+                # axon tunnel); tracing alone never injects a sync — it
+                # must observe the overlap engine, not serialize it
+            return value
+        except BaseException:
+            failed = True
+            raise
+        finally:
+            dt = perf_counter() - t0
+            nbytes = 0.0
+            if not failed and value is not None:
+                try:
+                    nbytes = estimate_bytes(value)
+                except Exception:
+                    nbytes = 0.0
+            if rec is not None:
+                tracer.end(rec, error=failed, out_bytes=nbytes,
+                           seconds=round(dt, 6))
+            _record_node(label, vertex, profiler, dt, nbytes, failed)
+
+    expr._thunk = forced
+    return expr

@@ -1,0 +1,197 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+One trustworthy measurement substrate (KeystoneML's profile-guided
+optimizer premise, PAPER.md §5): the executor, the overlap engine, and
+the solver loops all report into the same named-metric namespace, so the
+auto-cacher, user-facing profiler reports, and trace exports can never
+disagree about what was observed.
+
+Metric names are dotted and stable — they are part of the telemetry
+contract documented in OBSERVABILITY.md:
+
+  executor.node_forces / node_failures / memo_hits /
+  executor.prefix_saves / prefix_reuse      (counters)
+  executor.live_bytes                       (gauge; .max = observed peak)
+  prefetch.queue_depth                      (gauge)
+  prefetch.producer_stall_s / consumer_wait_s   (histograms, seconds)
+  overlap.inflight_results / resident_chunks    (gauges)
+  overlap.bytes_pulled / chunks_dispatched      (counters)
+  solver.steps                              (counter)
+
+Thread-safety: one process lock guards mutation — producer threads
+(overlap engine) and the main thread share these. Updates are
+chunk/force granular (hundreds per run, not millions), so contention is
+irrelevant next to the work being measured.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_LOCK = threading.Lock()
+
+
+class Counter:
+    """Monotonic accumulator."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with _LOCK:
+            self.value += n
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"value": self.value}
+
+
+class Gauge:
+    """Point-in-time level with a high-water mark. ``set``/``add`` also
+    emit a counter sample into the active tracer (when one is installed)
+    so the level is a time series in the Chrome trace, not just a max."""
+
+    __slots__ = ("name", "value", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self.max = 0.0
+
+    def set(self, v: float) -> None:
+        with _LOCK:
+            self.value = v
+            if v > self.max:
+                self.max = v
+        from .spans import current_tracer
+
+        t = current_tracer()
+        if t is not None:
+            t.counter_sample(self.name, v)
+
+    def add(self, d: float) -> float:
+        with _LOCK:
+            self.value += d
+            v = self.value
+            if v > self.max:
+                self.max = v
+        from .spans import current_tracer
+
+        t = current_tracer()
+        if t is not None:
+            t.counter_sample(self.name, v)
+        return v
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"value": self.value, "max": self.max}
+
+
+class Histogram:
+    """Streaming count/sum/min/max — enough for stall *totals* and worst
+    cases without holding samples. The full time series lives in the
+    trace (each observation can carry a span); the registry keeps the
+    aggregate that reports and tests assert on."""
+
+    __slots__ = ("name", "count", "total", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        with _LOCK:
+            self.count += 1
+            self.total += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "total": self.total,
+            "min": self.min if self.min is not None else 0.0,
+            "max": self.max,
+            "mean": self.mean,
+        }
+
+
+class MetricsRegistry:
+    """Name→metric table. ``counter``/``gauge``/``histogram`` create on
+    first use; a name is one kind forever (a config bug, not a race —
+    raise loudly)."""
+
+    def __init__(self):
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def _get(self, table: Dict, name: str, cls):
+        m = table.get(name)
+        if m is None:
+            for other in (self.counters, self.gauges, self.histograms):
+                if other is not table and name in other:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(other[name]).__name__}"
+                    )
+            with _LOCK:
+                m = table.setdefault(name, cls(name))
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self.counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self.gauges, name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self.histograms, name, Histogram)
+
+    def snapshot(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """JSON-ready view: {counters: {...}, gauges: {...},
+        histograms: {...}} — embedded verbatim in trace exports."""
+        return {
+            "counters": {k: v.snapshot() for k, v in sorted(self.counters.items())},
+            "gauges": {k: v.snapshot() for k, v in sorted(self.gauges.items())},
+            "histograms": {
+                k: v.snapshot() for k, v in sorted(self.histograms.items())
+            },
+        }
+
+    def reset(self) -> None:
+        """Drop all metric state (tests; a fresh bench tier)."""
+        with _LOCK:
+            self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+def counter(name: str) -> Counter:
+    return _registry.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _registry.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _registry.histogram(name)

@@ -38,9 +38,12 @@ from __future__ import annotations
 
 import queue
 import threading
+from time import perf_counter
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..telemetry import counter, gauge, histogram, span
 
 
 class _ProducerError:
@@ -57,14 +60,21 @@ _DONE = object()
 
 def _bounded_put(q: "queue.Queue", item, cancel: threading.Event) -> bool:
     """Put that can be cancelled while the queue is full (a consumer that
-    stopped draining must not leave the producer blocked forever)."""
-    while not cancel.is_set():
-        try:
-            q.put(item, timeout=0.05)
-            return True
-        except queue.Full:
-            continue
-    return False
+    stopped draining must not leave the producer blocked forever).
+    Blocked time is the engine's *producer stall* — recorded so traces
+    show when the device outruns host staging (and vice versa via the
+    consumer-wait histogram)."""
+    t0 = perf_counter()
+    try:
+        while not cancel.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+    finally:
+        histogram("prefetch.producer_stall_s").observe(perf_counter() - t0)
 
 
 def prefetch_iterator(
@@ -86,11 +96,18 @@ def prefetch_iterator(
         depth = cfg.prefetch_depth
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     cancel = threading.Event()
+    depth_gauge = gauge("prefetch.queue_depth")
+    wait_hist = histogram("prefetch.consumer_wait_s")
 
     def producer():
         try:
             for item in it:
+                # count BEFORE the put: the gauge can momentarily read
+                # one high (the item in flight to the queue) but never
+                # negative, and its max stays ≤ depth + 1
+                depth_gauge.add(1)
                 if not _bounded_put(q, (item,), cancel):
+                    depth_gauge.add(-1)
                     return
         except BaseException as e:  # re-raised at the consumer
             _bounded_put(q, _ProducerError(e), cancel)
@@ -103,14 +120,28 @@ def prefetch_iterator(
     t.start()
     try:
         while True:
+            t0 = perf_counter()
             msg = q.get()
+            wait_hist.observe(perf_counter() - t0)
             if msg is _DONE:
                 break
             if isinstance(msg, _ProducerError):
                 raise msg.exc
+            depth_gauge.add(-1)
             yield msg[0]
     finally:
         cancel.set()
+        # unwind the staged-count accounting for items the consumer never
+        # pulled (early close), so the depth gauge returns to baseline —
+        # best-effort: a producer mid-put can land one more item after
+        # this drain, and the high-water mark is unaffected either way
+        while True:
+            try:
+                msg = q.get_nowait()
+            except queue.Empty:
+                break
+            if msg is not _DONE and not isinstance(msg, _ProducerError):
+                depth_gauge.add(-1)
 
 
 # --------------------------------------------------------------------------
@@ -140,15 +171,18 @@ def _stack_chunk(items: Sequence, part: List[int]) -> np.ndarray:
 
 
 def _split_result(res, part: List[int]) -> Tuple[List[int], List]:
-    res = np.asarray(res)
+    res = np.asarray(res)  # the blocking device→host pull
+    counter("overlap.bytes_pulled").inc(float(res.nbytes))
     return part, [res[j] for j in range(len(part))]
 
 
 def _stream_serial(items, plan, batch_fn) -> Iterator[Tuple[List[int], List]]:
     """Pre-overlap behavior: stack → dispatch → blocking pull, one chunk
     at a time."""
-    for part in plan:
-        yield _split_result(batch_fn(_stack_chunk(items, part)), part)
+    for i, part in enumerate(plan):
+        with span("chunk_serial", cat="chunk", idx=i, rows=len(part)):
+            out = _split_result(batch_fn(_stack_chunk(items, part)), part)
+        yield out
 
 
 _device_put_warned = False
@@ -191,22 +225,59 @@ def _stream_overlapped(
     on the device)."""
     from collections import deque
 
+    # Per-stream producer-side chunk count (stacking + uploading +
+    # queued): incremented when staging BEGINS, decremented when the
+    # consumer receives the chunk — so `resident` below is THIS stream's
+    # residency, not a mix of every concurrent prefetch queue, and the
+    # documented ≤ 2·depth + 2 bound holds exactly: producer side
+    # ≤ depth queued + 1 in hand, consumer side ≤ depth + 1 dispatched.
+    # Locked: a lost cross-thread read-modify-write would drift the
+    # count (and the exported residency series) permanently.
+    staged_count = [0]
+    staged_lock = threading.Lock()
+
+    def _bump_staged(d: int) -> None:
+        with staged_lock:
+            staged_count[0] += d
+
+    def _stage(idx_part):
+        i, part = idx_part
+        _bump_staged(1)
+        with span("chunk_stage", cat="chunk", idx=i, rows=len(part)):
+            return part, _device_put_host(_stack_chunk(items, part))
+
     staged = prefetch_iterator(
-        ((part, _device_put_host(_stack_chunk(items, part)))
-         for part in plan),
-        depth,
+        (_stage(ip) for ip in enumerate(plan)), depth,
     )
     inflight: "deque" = deque()  # (part, device result future)
+    inflight_gauge = gauge("overlap.inflight_results")
+    resident_gauge = gauge("overlap.resident_chunks")
+    dispatched = counter("overlap.chunks_dispatched")
+
+    def _note_residency():
+        inflight_gauge.set(len(inflight))
+        resident_gauge.set(len(inflight) + staged_count[0])
+
+    def _drain(idx):
+        part0, res0 = inflight.popleft()
+        _note_residency()
+        with span("chunk_drain", cat="chunk", idx=idx, rows=len(part0)):
+            return _split_result(res0, part0)  # deferred pull, in order
+
     try:
+        drained = 0
         for part, chunk in staged:
+            _bump_staged(-1)  # chunk left the producer side
             # async dispatch: returns immediately, device queues the work
             inflight.append((part, batch_fn(chunk)))
+            dispatched.inc()
+            _note_residency()
             if len(inflight) > depth:
-                part0, res0 = inflight.popleft()
-                yield _split_result(res0, part0)  # deferred pull, in order
+                yield _drain(drained)
+                drained += 1
         while inflight:
-            part0, res0 = inflight.popleft()
-            yield _split_result(res0, part0)
+            yield _drain(drained)
+            drained += 1
     finally:
         staged.close()  # early exit / batch_fn failure cancels the producer
 

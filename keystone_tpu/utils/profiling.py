@@ -3,7 +3,7 @@
 Reference tracing (SURVEY.md §5): per-node nanoTime deltas in solver
 logs, DOT plan dumps before/after optimizer rules
 (RuleExecutor.scala:44-77), and the AutoCacheRule sampled profiler
-(workflow/autocache.py here). This module adds the user-facing piece: a
+(workflow/autocache.py here). This module is the user-facing piece: a
 profiler that records wall time and output size of every node forced
 during execution.
 
@@ -11,18 +11,24 @@ during execution.
         pipeline(data).get()
     print(prof.report())
 
-Timing wraps each node's lazy Expression, so it measures the real force
-time (including device compute via the `.sync()` scalar pull) rather than
-graph construction.
+Since the telemetry PR this is a *consumer* of the shared node-force
+instrumentation (`keystone_tpu.telemetry.instrument`): `GraphExecutor`
+wraps each node's lazy Expression once, and the wrapper notifies the
+active profiler via `on_force` — the same measurement stream that feeds
+spans, the metrics registry, and `autocache.profile_nodes`, so cache
+decisions and profile reports can never disagree. Timing still wraps
+the real force (including device compute via the `.sync()` scalar pull)
+rather than graph construction; a thunk that raises keeps its elapsed
+time (try/finally in the shared wrapper) and bumps a failure count.
 """
 
 from __future__ import annotations
 
-import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import dataclass
+from typing import Dict, Optional
 
+from ..telemetry.instrument import instrument_node_force
 from ..workflow.env import PipelineEnv
 from ..workflow.expressions import Expression
 
@@ -33,43 +39,59 @@ class NodeProfile:
     seconds: float = 0.0
     bytes: float = 0.0
     forced: int = 0
+    failures: int = 0
 
 
 class ExecutionProfiler:
+    """Per-label (and, when the executor supplies one, per-vertex)
+    aggregation of node-force completions."""
+
     def __init__(self):
         self.profiles: Dict[str, NodeProfile] = {}
+        #: per-vertex-id profiles for consumers that need graph-keyed
+        #: measurements (`autocache.profile_nodes`); labels may collide
+        #: across a graph, vertex ids within one graph cannot
+        self.by_vertex: Dict[int, NodeProfile] = {}
+
+    # ------------------------------------------------- span consumption
+
+    def on_force(self, label: str, seconds: float, nbytes: float,
+                 failed: bool = False, vertex: Optional[int] = None) -> None:
+        """One node force completed (the shared instrumentation calls
+        this from its try/finally, so failed forces still report their
+        elapsed time)."""
+        p = self.profiles.setdefault(label, NodeProfile(label))
+        p.seconds += seconds
+        p.forced += 1
+        if failed:
+            p.failures += 1
+        else:
+            p.bytes += nbytes
+        if vertex is not None:
+            v = self.by_vertex.setdefault(vertex, NodeProfile(label))
+            v.seconds += seconds
+            v.forced += 1
+            if failed:
+                v.failures += 1
+            else:
+                v.bytes += nbytes
+
+    # ------------------------------------------------------- public API
 
     def wrap(self, label: str, expr: Expression) -> Expression:
-        orig_thunk = expr._thunk
-        if orig_thunk is None:  # already forced; nothing to time
-            return expr
-
-        def timed():
-            t0 = time.perf_counter()
-            value = orig_thunk()
-            if hasattr(value, "sync"):
-                value.sync()  # scalar-pull sync so device time is
-                # attributed here (block_until_ready is a no-op
-                # through the axon tunnel)
-            dt = time.perf_counter() - t0
-            p = self.profiles.setdefault(label, NodeProfile(label))
-            p.seconds += dt
-            p.forced += 1
-            from ..workflow.autocache import _estimate_bytes
-
-            p.bytes += _estimate_bytes(value)
-            return value
-
-        expr._thunk = timed
-        return expr
+        """Wrap ``expr``'s thunk so its force reports here (kept public
+        API; the executor now calls the shared instrumentation directly
+        and passes the vertex id along)."""
+        return instrument_node_force(label, expr, profiler=self)
 
     def report(self) -> str:
         rows = sorted(self.profiles.values(), key=lambda p: -p.seconds)
         lines = [f"{'node':<44} {'seconds':>9} {'MB':>9} {'forced':>6}"]
         for p in rows:
+            fail = f" ({p.failures} failed)" if p.failures else ""
             lines.append(
                 f"{p.label[:44]:<44} {p.seconds:>9.3f} {p.bytes / 1e6:>9.1f} "
-                f"{p.forced:>6}"
+                f"{p.forced:>6}{fail}"
             )
         return "\n".join(lines)
 

@@ -21,7 +21,6 @@ executors — the analog of `Cacher`'s `.cache()` + prefix saving
 from __future__ import annotations
 
 import logging
-import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -108,20 +107,6 @@ def get_runs(graph: Graph, cached: set) -> Dict[NodeId, int]:
     return runs
 
 
-def _estimate_bytes(value) -> float:
-    import jax
-
-    total = 0.0
-    for leaf in jax.tree_util.tree_leaves(getattr(value, "data", value)):
-        if hasattr(leaf, "nbytes"):
-            total += float(leaf.nbytes)
-        elif isinstance(leaf, (bytes, str)):
-            total += len(leaf)
-        else:
-            total += 64.0
-    return total
-
-
 def profile_nodes(
     graph: Graph,
     targets: List[NodeId],
@@ -129,7 +114,18 @@ def profile_nodes(
 ) -> Dict[NodeId, Profile]:
     """Execute the ancestors of each target on per-shard samples at several
     scales, then extrapolate time/memory linearly to the full data size
-    (reference `profileNodes`:153-469 + `generalizeProfiles`:104-135)."""
+    (reference `profileNodes`:153-469 + `generalizeProfiles`:104-135).
+
+    Measurement rides the shared telemetry instrumentation: an
+    `ExecutionProfiler` is installed for the sampled execution and the
+    executor's per-node wrapper reports each force (with the `.sync()`
+    scalar pull, so device compute is honestly attributed) keyed by
+    vertex id. Forcing in topological order keeps each node's reading
+    incremental — its ancestors are already forced when it runs — which
+    is exactly the old inline-timing semantics, now sourced from the
+    same span data user-facing reports and traces consume."""
+    from ..utils.profiling import ExecutionProfiler
+    from .env import PipelineEnv
     from .executor import GraphExecutor
 
     full_scale = 1
@@ -148,27 +144,29 @@ def profile_nodes(
                     node, DatasetOperator(op.dataset.sample_per_shard(scale))
                 )
         executor = GraphExecutor(sampled, optimize=False)
+        collector = ExecutionProfiler()
+        env = PipelineEnv.get()
+        prev_profiler = getattr(env, "profiler", None)
+        env.profiler = collector
+        try:
+            for target in targets:
+                order = [
+                    v
+                    for v in sorted(
+                        ancestors(sampled, target) | {target},
+                        key=lambda v: v.id if not isinstance(v, SourceId) else -1,
+                    )
+                    if isinstance(v, NodeId)
+                ]
+                for v in order:
+                    executor.execute(v).get  # noqa: B018 — forces the node
+        finally:
+            env.profiler = prev_profiler
         per_node: Dict[NodeId, Profile] = {}
-        for target in targets:
-            order = [
-                v
-                for v in sorted(
-                    ancestors(sampled, target) | {target},
-                    key=lambda v: v.id if not isinstance(v, SourceId) else -1,
-                )
-                if isinstance(v, NodeId)
-            ]
-            for v in order:
-                if v in per_node:
-                    continue
-                t0 = time.perf_counter()
-                value = executor.execute(v).get
-                if hasattr(value, "sync"):
-                    value.sync()  # scalar-pull sync: honest compute time
-                    # (block_until_ready does not block over the tunnel)
-                per_node[v] = Profile(
-                    (time.perf_counter() - t0) * 1e9, _estimate_bytes(value)
-                )
+        for node in sampled.operators:
+            m = collector.by_vertex.get(node.id)
+            if m is not None and m.forced:
+                per_node[node] = Profile(m.seconds * 1e9, m.bytes)
         measurements[scale] = per_node
 
     # Linear model per node: y ~ a + b*scale, evaluated at full_scale.

@@ -51,11 +51,18 @@ class ExecutionConfig:
     static analyzer lints against (KP201/KP202, see
     `keystone_tpu.analysis`); env ``KEYSTONE_HBM_BUDGET_GB`` (float,
     GiB). None disables budget warnings.
+
+    ``trace_path`` (env ``KEYSTONE_TRACE``) arms the telemetry layer's
+    ambient tracer: the process collects hierarchical spans + metrics
+    and writes Chrome trace-event JSON to this path at exit (see
+    `keystone_tpu.telemetry` and OBSERVABILITY.md). None disables
+    tracing (the instrumented hot paths reduce to one global read).
     """
 
     overlap: bool = True
     prefetch_depth: int = 2
     hbm_budget_bytes: Optional[int] = None
+    trace_path: Optional[str] = None
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -75,6 +82,7 @@ def execution_config() -> ExecutionConfig:
                 if os.environ.get("KEYSTONE_HBM_BUDGET_GB")
                 else None
             ),
+            trace_path=os.environ.get("KEYSTONE_TRACE") or None,
         )
     return _exec_config
 

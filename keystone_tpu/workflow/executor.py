@@ -31,6 +31,7 @@ class GraphExecutor:
         self._optimized: Optional[Tuple[Graph, Dict[NodeId, Prefix]]] = plan
         self._memo: Dict[GraphId, Expression] = {}
         self._structure_checked = False
+        self._static_recorded = False
 
     @property
     def graph(self) -> Graph:
@@ -67,15 +68,65 @@ class GraphExecutor:
         structural_report(graph).raise_for_errors()
         self._structure_checked = True
 
+    def _record_static_estimates(self, graph: Graph, tracer) -> None:
+        """Embed the analyzer's per-node byte estimates (the KP2xx memory
+        model, `analysis.memory`) in the trace metadata so
+        `analysis.reconcile` can diff them against this run's observed
+        bytes. Runs once per executor, only while tracing, and never
+        fails a run: the data graph is already bound (DatasetOperators
+        carry real specs), so `spec_pass` needs no placeholder sources."""
+        if self._static_recorded:
+            return
+        self._static_recorded = True
+        try:
+            from ..analysis.memory import memory_pass
+            from ..analysis.propagate import spec_pass
+            from ..analysis.reconcile import node_key
+
+            specs, _ = spec_pass(graph, {})
+            est, _ = memory_pass(graph, specs)
+            meta = tracer.metadata.setdefault(
+                "static_memory", {"per_node": {}, "peak_bytes": 0})
+            for vid, nbytes in est.per_node.items():
+                if nbytes is None:
+                    continue
+                label = graph.get_operator(vid).label
+                key = node_key(vid.id, label)
+                prev = meta["per_node"].get(key)
+                # structurally identical graphs (train/test applies)
+                # collide on id:label — keep the larger estimate, matching
+                # the observed side's max-over-forces semantics
+                if prev is None or prev["bytes"] < int(nbytes):
+                    meta["per_node"][key] = {
+                        "label": label,
+                        "vertex": vid.id,
+                        "bytes": int(nbytes),
+                    }
+            # several executors (fit graph, apply graph) contribute to one
+            # trace; keep the largest static peak — the model's watermark
+            meta["peak_bytes"] = max(meta["peak_bytes"], int(est.peak_bytes))
+        except Exception:  # estimation must never break execution
+            pass
+
     def execute(self, graph_id: GraphId) -> Expression:
         """Execute up to ``graph_id``, returning its lazy Expression
         (GraphExecutor.scala:53-80)."""
         graph, prefixes = self._optimized_plan()
         self._check_structure(graph)
         env = PipelineEnv.get()
+        profiler = getattr(env, "profiler", None)
+        from ..telemetry import counter, current_tracer
+        from ..telemetry.instrument import instrument_node_force
+
+        tracer = current_tracer()
+        if tracer is not None:
+            self._record_static_estimates(graph, tracer)
+        observing = tracer is not None or profiler is not None
 
         def go(vid: GraphId) -> Expression:
             if vid in self._memo:
+                if observing:
+                    counter("executor.memo_hits").inc()
                 return self._memo[vid]
             if isinstance(vid, SourceId):
                 raise ValueError(
@@ -87,12 +138,14 @@ class GraphExecutor:
                 dep_exprs = [go(d) for d in graph.get_dependencies(vid)]
                 op = graph.get_operator(vid)
                 expr = op.execute(dep_exprs)
-                profiler = getattr(env, "profiler", None)
-                if profiler is not None:
-                    expr = profiler.wrap(op.label, expr)
+                if observing:
+                    expr = instrument_node_force(
+                        op.label, expr, vertex=vid.id, profiler=profiler)
                 prefix = prefixes.get(vid)
                 if prefix is not None and prefix not in env.state:
                     env.state[prefix] = expr
+                    if observing:
+                        counter("executor.prefix_saves").inc()
             self._memo[vid] = expr
             return expr
 

@@ -68,22 +68,26 @@ class RuleExecutor:
         raise NotImplementedError
 
     def execute(self, graph: Graph) -> Plan:
+        from ..telemetry import span
+
         plan: Plan = (graph, {})
-        for batch in self.batches:
-            for iteration in range(batch.max_iterations):
-                new_plan = plan
-                for rule in batch.rules:
-                    new_plan = rule.apply(new_plan)
-                if self._plans_equal(new_plan, plan):
-                    break
-                plan = new_plan
-                if logger.isEnabledFor(logging.DEBUG):
-                    logger.debug(
-                        "after batch %s iter %d:\n%s",
-                        batch.name,
-                        iteration,
-                        plan[0].to_dot(),
-                    )
+        with span("optimize", cat="phase", batches=len(self.batches)):
+            for batch in self.batches:
+                with span(f"optimizer:{batch.name}", cat="phase"):
+                    for iteration in range(batch.max_iterations):
+                        new_plan = plan
+                        for rule in batch.rules:
+                            new_plan = rule.apply(new_plan)
+                        if self._plans_equal(new_plan, plan):
+                            break
+                        plan = new_plan
+                        if logger.isEnabledFor(logging.DEBUG):
+                            logger.debug(
+                                "after batch %s iter %d:\n%s",
+                                batch.name,
+                                iteration,
+                                plan[0].to_dot(),
+                            )
         return plan
 
     @staticmethod
@@ -126,6 +130,9 @@ class SavedStateLoadRule(Rule):
             if expr is not None and not isinstance(
                 graph.get_operator(node), ExpressionOperator
             ):
+                from ..telemetry import counter
+
+                counter("executor.prefix_reuse").inc()
                 graph = graph.set_operator(
                     node, ExpressionOperator(expr, name=str(prefix.operator_key[0]))
                 ).set_dependencies(node, ())

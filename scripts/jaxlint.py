@@ -22,6 +22,12 @@ system cannot (see ANALYSIS.md for the full catalog):
          naming convention for steps that rebuild O(model)-sized state —
          must declare ``donate_argnums`` so XLA reuses the state buffers
          instead of allocating fresh HBM every iteration.
+  KJ004  wall-clock-duration: a ``time.time()`` call inside
+         ``keystone_tpu/``. Wall-clock is NTP-steppable and coarse;
+         every duration measurement (profiler, telemetry spans, stall
+         histograms) must use ``time.perf_counter()``. Genuine
+         wall-clock timestamps (trace epoch anchors, file-mtime
+         comparisons) suppress with the standard comment.
 
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
@@ -45,6 +51,8 @@ RULES = {
     "KJ002": "numpy call inside a jax.jit-decorated function",
     "KJ003": "jitted solver step mutating O(model) state lacks "
              "donate_argnums",
+    "KJ004": "time.time() used where a duration is measured (use "
+             "time.perf_counter())",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -184,6 +192,36 @@ def _check_numpy_in_jit(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "on tracers")
 
 
+def _check_wall_clock_duration(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ004: `time.time()` calls (module-attribute form, plus the bare
+    `time()` form when the file does `from time import time`). Anything
+    timing-shaped in keystone_tpu/ must use the monotonic
+    `time.perf_counter()`; real wall-clock timestamps are rare enough to
+    carry an explicit suppression."""
+    bare_time_imported = any(
+        isinstance(n, ast.ImportFrom) and n.module == "time"
+        and any(a.name == "time" and (a.asname or a.name) == "time"
+                for a in n.names)
+        for n in ast.walk(tree)
+    )
+    for sub in ast.walk(tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        hit = (
+            isinstance(func, ast.Attribute) and func.attr == "time"
+            and isinstance(func.value, ast.Name) and func.value.id == "time"
+        ) or (
+            bare_time_imported
+            and isinstance(func, ast.Name) and func.id == "time"
+        )
+        if hit:
+            yield Finding(
+                path, sub.lineno, "KJ004",
+                "time.time() is wall-clock (steppable, coarse); durations "
+                "must use time.perf_counter()")
+
+
 def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
     for fn in ast.walk(tree):
         if not isinstance(fn, ast.FunctionDef):
@@ -213,6 +251,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
     rel = str(path if repo_root is None else path.relative_to(repo_root))
     findings: List[Finding] = []
     findings.extend(_check_numpy_in_jit(tree, rel))
+    findings.extend(_check_wall_clock_duration(tree, rel))
     if "nodes/" in rel.replace("\\", "/") + "/":
         findings.extend(_check_loop_accumulation(tree, rel))
     if "nodes/learning" in rel.replace("\\", "/"):

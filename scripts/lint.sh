@@ -22,4 +22,28 @@ python scripts/jaxlint.py keystone_tpu
 echo "== pipeline validation (abstract specs) =="
 JAX_PLATFORMS=cpu python -m keystone_tpu.analysis "$@"
 
+echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
+TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
+trap 'rm -f "$TRACE_TMP"' EXIT
+JAX_PLATFORMS=cpu KEYSTONE_SMOKE_TRACE="$TRACE_TMP" python - <<'PY'
+import json, os
+import numpy as np
+from keystone_tpu import Dataset, Transformer
+from keystone_tpu.telemetry import trace_run
+
+path = os.environ["KEYSTONE_SMOKE_TRACE"]
+with trace_run(path):
+    pipe = Transformer.from_function(lambda x: x * 2.0).to_pipeline()
+    pipe(Dataset.from_numpy(np.ones((8, 4), np.float32))).get()
+trace = json.load(open(path))
+events = trace["traceEvents"]
+assert isinstance(events, list) and events, "empty traceEvents"
+for e in events:
+    assert "ph" in e and "name" in e and "pid" in e, e
+assert any(e.get("cat") == "node" for e in events), "no node-force spans"
+assert "keystone" in trace and "metrics" in trace["keystone"]
+print(f"telemetry smoke: {len(events)} events OK")
+PY
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
+
 echo "lint: OK"

@@ -3,13 +3,67 @@
 PERF.md.
 
 Usage: python scripts/perf_table.py [path=BENCH_LAST_GOOD.json]
+       python scripts/perf_table.py --trace run.json [--top N]
+
+``--trace`` renders a Chrome trace (written via KEYSTONE_TRACE /
+`trace_run`, e.g. the ``trace_artifact`` path a bench record carries) as
+a markdown per-node self-time table, so bench rounds can diff span-level
+detail across PRs (see OBSERVABILITY.md).
 """
 
 import json
 import sys
 
 
+def trace_table(path, top=15):
+    """Markdown per-node self-time table from a Chrome trace."""
+    sys.path.insert(0, ".")
+    from keystone_tpu.telemetry import aggregate_spans, load_trace
+
+    trace = load_trace(path)
+    print(f"Trace `{path}`:\n")
+    for cat, title in (("node", "Node forces"), ("step", "Solver steps"),
+                       ("chunk", "Stream chunks")):
+        agg = aggregate_spans(trace, cat)
+        if not agg:
+            continue
+        print(f"**{title}** (top {top} by self-time)\n")
+        print("| Span | Self s | Total s | Count | MB |")
+        print("|---|---|---|---|---|")
+        for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["self_s"])[:top]:
+            print(f"| {name} | {a['self_s']:.4f} | {a['total_s']:.4f} | "
+                  f"{int(a['count'])} | {a['bytes'] / 1e6:.1f} |")
+        print()
+    hist = trace.get("keystone", {}).get("metrics", {}).get("histograms", {})
+    stall = hist.get("prefetch.producer_stall_s")
+    wait = hist.get("prefetch.consumer_wait_s")
+    if stall or wait:
+        print("**Overlap queue stalls**: "
+              + "; ".join(
+                  f"{label} {h['total']:.4f}s/{int(h['count'])}"
+                  for label, h in (("producer", stall), ("consumer", wait))
+                  if h))
+    try:
+        from keystone_tpu.analysis.reconcile import (
+            format_reconciliation,
+            reconcile_trace,
+        )
+
+        rec = reconcile_trace(trace)
+        if rec["rows"]:
+            print()
+            print("```\n" + format_reconciliation(rec) + "\n```")
+    except Exception:
+        pass
+
+
 def main():
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        path = sys.argv[i + 1]
+        top = (int(sys.argv[sys.argv.index("--top") + 1])
+               if "--top" in sys.argv else 15)
+        return trace_table(path, top)
     path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_LAST_GOOD.json"
     with open(path) as f:
         text = f.read().strip()

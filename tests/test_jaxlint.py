@@ -113,6 +113,52 @@ def test_donate_argnums_present_passes(tmp_path):
     assert jl.lint_file(f) == []
 
 
+def test_wall_clock_duration_caught(tmp_path):
+    """KJ004: time.time() flagged in both the module-attribute and the
+    from-import forms; perf_counter passes; suppression honored."""
+    jl = _jaxlint()
+    bad = tmp_path / "timing.py"
+    bad.write_text(
+        "import time\n"
+        "from time import time as _t  # not the bare name: no bare-form flag\n"
+        "\n"
+        "\n"
+        "def measure(fn):\n"
+        "    t0 = time.time()\n"                    # KJ004
+        "    fn()\n"
+        "    return time.time() - t0\n"             # KJ004
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ004", "KJ004"]
+    assert findings[0].line == 6
+
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        "from time import time\n"
+        "\n"
+        "\n"
+        "def measure():\n"
+        "    return time()\n"                       # KJ004 (bare form)
+    )
+    assert [f.rule for f in jl.lint_file(bare)] == ["KJ004"]
+
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import time\n"
+        "\n"
+        "\n"
+        "def measure(fn):\n"
+        "    t0 = time.perf_counter()\n"
+        "    fn()\n"
+        "    return time.perf_counter() - t0\n"
+        "\n"
+        "\n"
+        "def stamp():\n"
+        "    return time.time()  # keystone: ignore[KJ004]\n"
+    )
+    assert jl.lint_file(good) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""
