@@ -91,6 +91,8 @@ def run_child(args, timeout_s: float):
             "--overlap-chunk", str(args.overlap_chunk)]
     if args.skip_overlap_tier:
         cmd += ["--skip-overlap-tier"]
+    if args.skip_dispatch_tier:
+        cmd += ["--skip-dispatch-tier"]
     if args.cifar_dir:
         cmd += ["--cifar-dir", args.cifar_dir]
     if args.train_path:
@@ -180,14 +182,14 @@ def emit(record):
 # krr_tier-ranked checkpoint holding every measured tier).
 PROGRESS_RANK = {"headline": 1, "staged": 2, "flagship": 3,
                  "featurize_tier": 4, "krr_tier": 5, "overlap_tier": 6,
-                 "complete": 7}
+                 "dispatch_tier": 7, "complete": 8}
 
 # The tier payload keys a child detail may carry. finalize_record's
 # error scan is restricted to exactly these: a future informational
 # payload that happens to contain an "error" field (e.g. a north_star
 # sub-dict) must not silently block persistence.
 TIER_KEYS = ("flagship_bcd_d8192", "flagship_featurize", "flagship_krr",
-             "featurize_overlap", "fused")
+             "featurize_overlap", "dispatch_count", "fused")
 
 
 def progress_rank(detail) -> int:
@@ -274,6 +276,7 @@ def main():
     p.add_argument("--overlap-n", type=int, default=16_384)
     p.add_argument("--overlap-chunk", type=int, default=2048)
     p.add_argument("--skip-overlap-tier", action="store_true")
+    p.add_argument("--skip-dispatch-tier", action="store_true")
     p.add_argument("--liveness-timeout", type=float, default=90.0)
     p.add_argument("--run-timeout", type=float, default=1500.0)
     p.add_argument("--phase-timeout", type=float, default=900.0,
@@ -986,6 +989,33 @@ def child_main(args):
                 num_filters=config.num_filters))
     detail.update({"progress": "overlap_tier",
                    "featurize_overlap": overlap})
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
+    # Dispatch-count tier: programs-per-run for the example pipelines
+    # under serial-unfused / PR-3-legacy / optimized plans (the
+    # execution-count budget PERF.md round 4 proved the tunnel charges
+    # for). Platform-independent — the counts are a property of the
+    # optimizer plan, so CPU and TPU runs record the same numbers.
+    def dispatch_fn():
+        import time as _t
+
+        from keystone_tpu.dispatch_bench import dispatch_count_report
+
+        t0 = _t.perf_counter()
+        rep = dispatch_count_report()
+        rep["seconds"] = round(_t.perf_counter() - t0, 2)
+        if not rep["all_outputs_match"]:
+            rep["error"] = ("optimized/legacy plan predictions diverged "
+                            "from the serial unfused path")
+        return rep
+
+    dispatch_tier = None
+    if not args.skip_dispatch_tier:
+        dispatch_tier = run_tier(
+            "dispatch_count", "dispatch_tier", "dispatch_tier_done",
+            "seconds", dispatch_fn)
+    detail.update({"progress": "dispatch_tier",
+                   "dispatch_count": dispatch_tier})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     # Fused tier LAST: the SAME training run as one XLA program (the

@@ -129,7 +129,10 @@ def structural_pass(graph: Graph) -> List[Diagnostic]:
                     vertex=node, label=label))
 
         # fit-before-use: an estimator's output is a transformer, not
-        # data — only position 0 of a DelegatingOperator may consume it.
+        # data — only a consumer's declared ``estimator_positions``
+        # (position 0 of a DelegatingOperator; the leading slots of a
+        # fused super-node, workflow.fusion_rule.FusedChainOperator) may
+        # consume it.
         if isinstance(op, EstimatorOperator):
             for user in graph.users_of(node):
                 if isinstance(user, SinkId):
@@ -141,8 +144,10 @@ def structural_pass(graph: Graph) -> List[Diagnostic]:
                     continue
                 user_op = graph.get_operator(user)
                 user_deps = graph.get_dependencies(user)
-                if isinstance(user_op, DelegatingOperator) and user_deps and \
-                        user_deps[0] == node and user_deps.count(node) == 1:
+                est_positions = getattr(user_op, "estimator_positions", ())
+                positions = [i for i, d in enumerate(user_deps) if d == node]
+                if positions and len(positions) == 1 and \
+                        positions[0] in est_positions:
                     continue
                 diags.append(Diagnostic(
                     "KP003", Severity.ERROR,

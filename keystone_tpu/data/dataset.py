@@ -151,9 +151,14 @@ class Dataset:
 
     def map_batches(self, fn: Callable, jitted: bool = True, count: Optional[int] = None) -> "Dataset":
         """Apply a whole-batch function to the padded sharded pytree. The
-        result keeps the leading axis and sharding."""
+        result keeps the leading axis and sharding. One call = one
+        executed XLA program — THE library-wide jitted call boundary, so
+        it feeds the ``dispatch.programs_executed`` budget."""
+        from ..telemetry import record_dispatch
+
         if jitted:
             fn = jax.jit(fn)
+        record_dispatch()
         out = fn(self.data)
         return Dataset(out, count=count if count is not None else self.count,
                        mesh=self.mesh, _placed=True)

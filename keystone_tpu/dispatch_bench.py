@@ -1,0 +1,250 @@
+"""Dispatch accounting for the example pipelines: programs per run.
+
+Round-4 live profiling proved the headline path is bounded by *executed
+programs through the tunnel*, not bytes (PERF.md "execution count, not
+bandwidth"), so the optimizer's fusion coverage is a first-class perf
+quantity. This module measures ``dispatch.programs_executed`` for small
+CPU-runnable instances of the example pipelines under three optimizer
+plans and checks the outputs are identical:
+
+  - ``serial_unfused`` — no fusion, no overlap, no concurrent dispatch:
+    one program per node, the dispatch-per-node regime every unfused
+    boundary degenerates to;
+  - ``legacy`` — the PR-3 optimizer exactly (transformer-chain fusion
+    only, ``NodeFusionRule(fuse_apply=False)``, serial dispatch);
+  - ``optimized`` — the current default plan: expanded fusable coverage,
+    fusion through fan-out-free estimator apply boundaries
+    (`FusedChainOperator`), concurrent DAG dispatch.
+
+Each measurement reports the *fit run* (first application: estimator
+fits + train apply) and the *apply run* (re-applying the fitted
+pipeline to held-out data — the serving path) separately; the apply run
+is the headline programs-per-run number the `dispatch_count` bench tier
+records. Used by ``bench.py --child`` (the ``dispatch_count`` tier) and
+by tests/test_scheduler.py (the ≥2× acceptance gate + allclose identity
+against the serial unfused path).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+PLANS = ("serial_unfused", "legacy", "optimized")
+
+
+# ---------------------------------------------------------------- examples
+#
+# Small, data-identical instances of example pipelines from the
+# `python -m keystone_tpu.analysis` set. Builders return
+# (predictor, train_data, test_data): applying `predictor` to train_data
+# is the fit run, to test_data the apply run. Sizes are chosen so a full
+# three-plan sweep stays in tier-1 time on the 8-device CPU mesh.
+
+
+def _build_mnist_random_fft():
+    """MnistRandomFFT (pipelines/mnist_random_fft.py): gather of
+    RandomSign → PaddedFFT → LinearRectifier branches → VectorCombiner →
+    BlockLeastSquares → MaxClassifier."""
+    from .data.dataset import Dataset
+    from .nodes.learning import BlockLeastSquaresEstimator
+    from .nodes.stats import LinearRectifier, PaddedFFT, RandomSignNode
+    from .nodes.util import (
+        ClassLabelIndicatorsFromInt,
+        MaxClassifier,
+        VectorCombiner,
+    )
+    from .workflow import Pipeline
+
+    rng = np.random.default_rng(0)
+    dim, n_train, n_test, k = 32, 64, 32, 6
+    X = rng.normal(size=(n_train, dim)).astype(np.float32)
+    Xt = rng.normal(size=(n_test, dim)).astype(np.float32)
+    y = rng.integers(0, k, n_train).astype(np.int32)
+
+    branches = [
+        RandomSignNode(dim, seed=i) >> PaddedFFT() >> LinearRectifier(0.0)
+        for i in range(3)
+    ]
+    featurizer = Pipeline.gather(branches) >> VectorCombiner()
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+    predictor = featurizer.and_then(
+        BlockLeastSquaresEstimator(dim, num_iter=1, lam=1e-2), train, labels
+    ) >> MaxClassifier()
+    return predictor, train, Dataset.from_numpy(Xt)
+
+
+def _build_random_patch_cifar():
+    """RandomPatchCifar's prediction path (the `analyzable()` graph,
+    pipelines/random_patch_cifar.py): per-node conv → rectify → pool →
+    vectorize → Cacher → StandardScaler → BlockLeastSquares → argmax,
+    with random filters standing in for the data-learned ones."""
+    from .data.dataset import Dataset
+    from .nodes.images.core import (
+        Convolver,
+        ImageVectorizer,
+        PixelScaler,
+        Pooler,
+        SymmetricRectifier,
+    )
+    from .nodes.learning import BlockLeastSquaresEstimator
+    from .nodes.stats import StandardScaler
+    from .nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
+
+    rng = np.random.default_rng(1)
+    h = w = 16
+    c, nf, k = 3, 8, 4
+    X = rng.uniform(0, 255, size=(48, h, w, c)).astype(np.float32)
+    Xt = rng.uniform(0, 255, size=(24, h, w, c)).astype(np.float32)
+    y = rng.integers(0, k, 48).astype(np.int32)
+    filters = rng.normal(size=(nf, 4 * 4 * c)).astype(np.float32)
+
+    featurizer = (
+        PixelScaler().to_pipeline()
+        >> Convolver(filters, h, w, c, whitener=None)
+        >> SymmetricRectifier(alpha=0.25)
+        >> Pooler(6, 7, pool_fn="sum")
+        >> ImageVectorizer()
+        >> Cacher("features")
+    )
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+    predictor = (
+        featurizer.and_then(StandardScaler(), train)
+        .and_then(BlockLeastSquaresEstimator(64, 1, 1.0), train, labels)
+        >> MaxClassifier()
+    )
+    return predictor, train, Dataset.from_numpy(Xt)
+
+
+def _build_timit():
+    """TimitPipeline (pipelines/timit.py): CosineRandomFeatures → Cacher
+    → BlockLeastSquares → MaxClassifier over pre-featurized frames."""
+    from .data.dataset import Dataset
+    from .nodes.learning import BlockLeastSquaresEstimator
+    from .nodes.stats import CosineRandomFeatures
+    from .nodes.util import Cacher, ClassLabelIndicatorsFromInt, MaxClassifier
+
+    rng = np.random.default_rng(2)
+    dim, nf, k = 24, 48, 6
+    X = rng.normal(size=(64, dim)).astype(np.float32)
+    Xt = rng.normal(size=(32, dim)).astype(np.float32)
+    y = rng.integers(0, k, 64).astype(np.int32)
+
+    featurizer = (
+        CosineRandomFeatures(dim, nf, gamma=0.05, seed=0).to_pipeline()
+        >> Cacher("timit-features")
+    )
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+    predictor = featurizer.and_then(
+        BlockLeastSquaresEstimator(nf, num_iter=1, lam=1e-3), train, labels
+    ) >> MaxClassifier()
+    return predictor, train, Dataset.from_numpy(Xt)
+
+
+#: name (matching the analysis-set registry) -> builder
+EXAMPLES: Dict[str, Callable] = {
+    "MnistRandomFFT": _build_mnist_random_fft,
+    "RandomPatchCifar": _build_random_patch_cifar,
+    "TimitPipeline": _build_timit,
+}
+
+
+# ------------------------------------------------------------- measurement
+
+
+def _plan_context(plan: str):
+    """(optimizer, overlap_on, concurrent_on) for a named plan."""
+    from .workflow.optimizer import DefaultOptimizer
+
+    if plan == "serial_unfused":
+        return DefaultOptimizer(fuse=False), False, False
+    if plan == "legacy":
+        return DefaultOptimizer(fuse_apply=False), True, False
+    if plan == "optimized":
+        return DefaultOptimizer(), True, True
+    raise ValueError(f"unknown plan {plan!r}; expected one of {PLANS}")
+
+
+def measure_example(name: str, plan: str) -> Dict:
+    """Run one example under one plan from a clean `PipelineEnv`,
+    returning program counts and the (host) predictions of both runs."""
+    from .telemetry import counter
+    from .workflow.env import PipelineEnv, dispatch_override, overlap_override
+
+    optimizer, overlap_on, concurrent_on = _plan_context(plan)
+    PipelineEnv.reset()
+    try:
+        PipelineEnv.get().set_optimizer(optimizer)
+        with overlap_override(overlap_on), \
+                dispatch_override(concurrent_on):
+            predictor, train, test = EXAMPLES[name]()
+            c = counter("dispatch.programs_executed")
+            before = c.value
+            train_pred = np.asarray(predictor(train).get().numpy())
+            fit_programs = c.value - before
+            before = c.value
+            test_pred = np.asarray(predictor(test).get().numpy())
+            apply_programs = c.value - before
+    finally:
+        PipelineEnv.reset()
+    return {
+        "plan": plan,
+        "fit_run_programs": int(fit_programs),
+        "apply_run_programs": int(apply_programs),
+        "train_pred": train_pred,
+        "test_pred": test_pred,
+    }
+
+
+def dispatch_count_report(
+    examples: Tuple[str, ...] = ("MnistRandomFFT", "RandomPatchCifar",
+                                 "TimitPipeline"),
+    check_outputs: bool = True,
+) -> Dict:
+    """The `dispatch_count` bench-tier payload: per-example programs per
+    run under each plan, reduction ratios (apply run, the serving path),
+    and an output-identity verdict against the serial unfused path."""
+    out: Dict = {"examples": {}, "plans": list(PLANS)}
+    reductions: List[float] = []
+    for name in examples:
+        runs = {plan: measure_example(name, plan) for plan in PLANS}
+        base = runs["serial_unfused"]
+        opt = runs["optimized"]
+        outputs_match = True
+        if check_outputs:
+            for r in (runs["legacy"], opt):
+                try:
+                    np.testing.assert_allclose(
+                        r["train_pred"], base["train_pred"],
+                        rtol=1e-5, atol=1e-5)
+                    np.testing.assert_allclose(
+                        r["test_pred"], base["test_pred"],
+                        rtol=1e-5, atol=1e-5)
+                except AssertionError:
+                    outputs_match = False
+        apply_ratio = (base["apply_run_programs"] / opt["apply_run_programs"]
+                       if opt["apply_run_programs"] else float("inf"))
+        reductions.append(apply_ratio)
+        out["examples"][name] = {
+            "apply_run_programs": {
+                p: runs[p]["apply_run_programs"] for p in PLANS},
+            "fit_run_programs": {
+                p: runs[p]["fit_run_programs"] for p in PLANS},
+            "reduction_vs_serial_unfused": round(apply_ratio, 2),
+            "reduction_vs_legacy": round(
+                runs["legacy"]["apply_run_programs"]
+                / max(1, opt["apply_run_programs"]), 2),
+            "outputs_match_serial_unfused": bool(outputs_match),
+        }
+    reductions.sort(reverse=True)
+    # the acceptance gate: at least two example pipelines drop >= 2x
+    out["examples_at_or_above_2x"] = int(sum(1 for r in reductions if r >= 2.0))
+    out["top2_min_reduction"] = round(min(reductions[:2]), 2) if len(
+        reductions) >= 2 else None
+    out["all_outputs_match"] = all(
+        e["outputs_match_serial_unfused"] for e in out["examples"].values())
+    return out

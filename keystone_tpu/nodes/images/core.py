@@ -306,8 +306,10 @@ class Windower(Transformer):
         return flat.reshape(-1, self.window_size, self.window_size, image.shape[-1])
 
     def apply_batch(self, data: Dataset):
+        from ...telemetry import record_dispatch
         from ...utils.images import extract_patches_device
 
+        record_dispatch()
         h, w = data.array.shape[1], data.array.shape[2]
         gy = (h - self.window_size) // self.stride + 1
         gx = (w - self.window_size) // self.stride + 1
@@ -345,6 +347,9 @@ class RandomPatcher(Transformer):
         col0 = jnp.asarray(xs.reshape(-1))
         rows = row0[:, None, None] + jnp.arange(self.patch_h)[None, :, None]
         cols = col0[:, None, None] + jnp.arange(self.patch_w)[None, None, :]
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         out = data.array[img_idx[:, None, None], rows, cols, :]     # one gather
         return Dataset(out, count=n * ppi, mesh=data.mesh)
 
@@ -385,6 +390,9 @@ class CenterCornerPatcher(Transformer):
 
     def apply_batch(self, data: Dataset):
         # five static slices (+flips) on device, image-major output order
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         imgs = data.array
         ph, pw = self.patch_h, self.patch_w
         starts = self._starts(imgs.shape[1], imgs.shape[2])

@@ -194,10 +194,24 @@ class BlockLinearMapper(Transformer):
     very large d the apply GEMM itself can be sharded over the ``model``
     mesh axis by XLA (BlockLinearMapper.scala:22-137)."""
 
+    fusable = True   # pad + GEMM: traceable, joins fused chains
+    chunkable = True  # per-row GEMM: distributes over host chunks
+
     def __init__(self, W, b=None, block_size: Optional[int] = None):
         self.W = W
         self.b = b if b is not None else jnp.zeros(W.shape[1], dtype=W.dtype)
         self.block_size = block_size
+
+    def fuse(self):
+        d = int(self.W.shape[0])
+
+        def fn(p, X):
+            W_, b_ = p
+            if X.shape[1] < d:
+                X = jnp.pad(X, [(0, 0), (0, d - X.shape[1])])
+            return X @ W_ + b_
+
+        return (("BlockLinearMapper", d), (self.W, self.b), fn)
 
     def abstract_apply(self, elem):
         from ...analysis.specs import SpecMismatchError, shape_struct
@@ -216,7 +230,9 @@ class BlockLinearMapper(Transformer):
             x = jnp.pad(x, [(0, d - x.shape[-1])])
         return x @ self.W + self.b
 
-    def apply_batch(self, data: Dataset):
+    def apply_batch(self, data):
+        if not isinstance(data, Dataset):
+            return super().apply_batch(data)  # host chunks: per-item path
         from .linear import _gemm_bias
 
         def fn(X):
@@ -274,6 +290,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         # passes over the input: weight for auto-caching
         self.weight = 3 * num_iter + 1
 
+    #: always fits a traceable BlockLinearMapper — the optimizer may
+    #: fuse through this estimator's apply boundary
+    fusable_fit = True
+
     def abstract_fit(self, in_specs):
         """Static fit: (d,) features + (k,) labels → model mapping (d,)
         to (k,). The solver zero-pads features to a block multiple, so
@@ -315,8 +335,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             x_sharding=meshlib.feature_sharding(data.mesh, d_pad),
         )
         lam = jnp.asarray(self.lam, X.dtype)
-        from ...telemetry import counter, span
+        from ...telemetry import counter, record_dispatch, span
 
+        record_dispatch()  # _bcd_prepare
         for i in range(self.num_iter):
             # span measures the host-side dispatch of one donated-buffer
             # sweep; device time pipelines asynchronously and lands on
@@ -324,5 +345,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             with span("bcd_epoch", cat="step", iter=i, blocks=num_blocks):
                 W, R = _bcd_epoch(W, R, Xc, lam, bs, num_blocks)
             counter("solver.steps").inc()
+            record_dispatch()
         W, b = _bcd_finalize(W, xm, ym)
+        record_dispatch()  # _bcd_finalize
         return BlockLinearMapper(W, b if self.fit_intercept else None, self.block_size)

@@ -57,7 +57,7 @@ def _time_chained(build_step, x0, iters: int) -> float:
     def run(n):
         s = jnp.float32(rng.random())
         t0 = time.perf_counter()
-        np.asarray(jnp.ravel(prog(x0, s, n))[0])  # transfer → real sync
+        np.asarray(jnp.ravel(prog(x0, s, n))[0])  # keystone: ignore[KJ005] — one-element transfer IS the timing fence (the sync_pull idiom, inlined)
         return time.perf_counter() - t0
 
     run(iters), run(2 * iters)  # warm both compiles

@@ -290,7 +290,7 @@ class KernelRidgeRegression(LabelEstimator):
         lam = jnp.asarray(self.lam, X.dtype)
         gamma = float(self.gamma)
         done = 0
-        from ...telemetry import counter, span
+        from ...telemetry import counter, record_dispatch, span
         for epoch in range(start_epoch, self.num_epochs):
             # per-epoch seed so a resumed run replays identical block orders
             perm = np.random.default_rng(self.seed + epoch).permutation(data.count)
@@ -305,6 +305,7 @@ class KernelRidgeRegression(LabelEstimator):
                         use_pal=_use_pallas_now(),
                     )
                 counter("solver.steps").inc()
+                record_dispatch()
                 done += 1
                 if ckpt and done % self.blocks_before_checkpoint == 0:
                     # atomic write: a crash mid-save must not corrupt the

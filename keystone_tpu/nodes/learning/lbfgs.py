@@ -178,13 +178,14 @@ class DenseLBFGSwithL2(LabelEstimator):
         lam = jnp.asarray(self.lam, X.dtype)
         W, state = _lbfgs_init(Xc, Yc, self.memory_size)
         values = []
-        from ...telemetry import counter, span
+        from ...telemetry import counter, record_dispatch, span
 
         for i in range(self.num_iters):
             with span("lbfgs_step", cat="step", iter=i):
                 W, state, value = _lbfgs_step(
                     W, state, Xc, Yc, lam, self.memory_size)
             counter("solver.steps").inc()
+            record_dispatch()
             values.append(value)
         self.loss_history = jnp.stack(values) if values else jnp.zeros((0,))
         if not self.fit_intercept:

@@ -39,10 +39,39 @@ class LinearMapper(Transformer):
     """y = xW (+ b). The model is replicated over the mesh; the batch path
     is a single sharded GEMM (LinearMapper.scala:18-63)."""
 
+    chunkable = True  # per-row GEMM: distributes over host chunks
+
     def __init__(self, W, b=None, feature_scaler=None):
         self.W = W
         self.b = b
         self.feature_scaler = feature_scaler
+
+    @property
+    def fusable(self) -> bool:
+        """Traceable (a GEMM) unless it carries an untraceable feature
+        scaler — then the chain degrades to sequential apply."""
+        return self.feature_scaler is None or bool(
+            getattr(self.feature_scaler, "fusable", False))
+
+    def fuse(self):
+        scaler = self.feature_scaler
+        has_b = self.b is not None
+        b = self.b if has_b else jnp.zeros(self.W.shape[1], self.W.dtype)
+        if scaler is None:
+            return (("LinearMapper", has_b), (self.W, b),
+                    lambda p, X: X @ p[0] + p[1])
+        if hasattr(scaler, "fuse"):
+            s_key, s_params, s_fn = scaler.fuse()
+        else:  # fusable (traceable apply) but no decomposition: vmap it,
+            # keyed on instance identity like any opaque stage
+            s_key, s_params = ("opaque", id(scaler)), ()
+            s_fn = lambda p, X: jax.vmap(scaler.apply)(X)  # noqa: E731
+
+        def fn(p, X):
+            W_, b_, sp = p
+            return s_fn(sp, X) @ W_ + b_
+
+        return (("LinearMapper", has_b, s_key), (self.W, b, s_params), fn)
 
     def abstract_apply(self, elem):
         from ...analysis.specs import SpecMismatchError, shape_struct
@@ -62,7 +91,9 @@ class LinearMapper(Transformer):
             out = out + self.b
         return out
 
-    def apply_batch(self, data: Dataset):
+    def apply_batch(self, data):
+        if not isinstance(data, Dataset):
+            return super().apply_batch(data)  # host chunks: per-item path
         if self.feature_scaler is not None:
             data = self.feature_scaler.apply_batch(data)
         b = self.b if self.b is not None else jnp.zeros(self.W.shape[1], self.W.dtype)
@@ -100,6 +131,8 @@ class LinearMapEstimator(LabelEstimator):
     """Exact OLS/ridge via distributed normal equations
     (LinearMapper.scala:69-161)."""
 
+    fusable_fit = True  # always fits a traceable LinearMapper
+
     def __init__(self, lam: float = 0.0, fit_intercept: bool = True):
         self.lam = lam
         self.fit_intercept = fit_intercept
@@ -111,7 +144,9 @@ class LinearMapEstimator(LabelEstimator):
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         from ...parallel import mesh as meshlib
+        from ...telemetry import record_dispatch
 
+        record_dispatch()
         W, b = _normal_equations(
             data.array,
             labels.array,
@@ -197,6 +232,8 @@ class LocalLeastSquaresEstimator(LabelEstimator):
     """Dual-form ridge for d ≫ n: collect to one replica, solve the n×n
     kernelized system (LocalLeastSquaresEstimator.scala:16-61)."""
 
+    fusable_fit = True  # always fits a traceable LinearMapper
+
     def __init__(self, lam: float = 0.0):
         self.lam = lam
 
@@ -206,6 +243,9 @@ class LocalLeastSquaresEstimator(LabelEstimator):
         return supervised_fit_spec(in_specs, self.label)
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         W = _dual_solve(
             data.array, labels.array, data.mask.astype(data.array.dtype),
             jnp.float32(self.lam),

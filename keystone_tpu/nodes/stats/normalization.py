@@ -19,6 +19,8 @@ from ...workflow.pipeline import Transformer
 class NormalizeRows(Transformer):
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+
     def __init__(self, eps: float = 2.2e-16):
         self.eps = eps
 
@@ -30,6 +32,8 @@ class NormalizeRows(Transformer):
 class SignedHellingerMapper(Transformer):
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+
     def apply(self, x):
         return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
 

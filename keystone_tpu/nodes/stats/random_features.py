@@ -31,6 +31,7 @@ class CosineRandomFeatures(Transformer):
     b ~ U[0, 2π]."""
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks (KP302)
 
     def __init__(
         self,
@@ -70,7 +71,12 @@ class CosineRandomFeatures(Transformer):
         return (("CosineRandomFeatures",), (self.W, self.b),
                 lambda p, X: jnp.cos(X @ p[0] + p[1]))
 
-    def apply_batch(self, data: Dataset):
+    def apply_batch(self, data):
+        if not isinstance(data, Dataset):
+            return super().apply_batch(data)  # host chunks: per-item path
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         # module-level jit: W/b are traced args, so rebuilding a pipeline
         # (fresh weights, same shapes) reuses the compiled program
         return data.with_data(_cosine_rf(data.array, self.W, self.b))
@@ -80,6 +86,7 @@ class RandomSignNode(Transformer):
     """Elementwise multiply by a fixed random ±1 vector."""
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks (KP302)
 
     def __init__(self, dim: int, seed: int = 0):
         rng = np.random.default_rng(seed)
@@ -96,6 +103,7 @@ class PaddedFFT(Transformer):
     positive-frequency half of the FFT."""
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks (KP302)
 
     def apply(self, x):
         n = x.shape[-1]
@@ -107,6 +115,7 @@ class LinearRectifier(Transformer):
     """max(maxVal, x - alpha)."""
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks (KP302)
 
     def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
         self.max_val = max_val

@@ -42,6 +42,13 @@ def _scale(X, mean, std, mask):
 class StandardScalerModel(Transformer):
     """(x - mean) / std. Masked so padded rows stay zero."""
 
+    fusable = True   # pure elementwise apply — joins fused chains
+    chunkable = True  # distributes over host chunks (KP302)
+    #: the unfused batch path re-zeros padded rows (`_scale`'s mask);
+    #: fused programs must keep that invariant — mask-less reductions
+    #: downstream (`_moments`, `_normal_equations`) rely on it
+    fuse_masks_output = True
+
     def __init__(self, mean, std=None):
         self.mean = mean
         self.std = std
@@ -51,13 +58,33 @@ class StandardScalerModel(Transformer):
             return x - self.mean
         return (x - self.mean) / self.std
 
-    def apply_batch(self, data: Dataset):
+    def fuse(self):
+        """Fused-chain decomposition: mean/std are traced params, so
+        structurally identical pipelines share one compiled program.
+        The fusion builder re-applies the padded-row mask after this
+        stage (``fuse_masks_output``), exactly like `_scale` does."""
+        if self.std is None:
+            return (("StandardScaler", "center"), (self.mean,),
+                    lambda p, X: X - p[0])
+        return (("StandardScaler", "scale"), (self.mean, self.std),
+                lambda p, X: (X - p[0]) / p[1])
+
+    def apply_batch(self, data):
+        if not isinstance(data, Dataset):
+            return super().apply_batch(data)  # host chunks: per-item path
         std = self.std if self.std is not None else jnp.ones_like(self.mean)
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         return data.with_data(_scale(data.array, self.mean, std, data.mask))
 
 
 class StandardScaler(Estimator):
     """Fit per-feature mean/std (StandardScaler.scala:36-60)."""
+
+    #: the fit always yields a traceable StandardScalerModel, so the
+    #: optimizer may fuse through this estimator's apply boundary
+    fusable_fit = True
 
     def __init__(self, normalize_std_dev: bool = True, eps: float = 1e-12):
         self.normalize_std_dev = normalize_std_dev
@@ -83,9 +110,12 @@ class StandardScaler(Estimator):
                     f"applied to a {elem.shape[0]}-dim element")
             return elem
 
-        return TransformerSpec(elem_fn, label=self.label)
+        return TransformerSpec(elem_fn, label=self.label, chunkable=True)
 
     def fit(self, data: Dataset) -> StandardScalerModel:
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         mean, std = _moments(
             data.array, jnp.float32(data.count), self.normalize_std_dev
         )

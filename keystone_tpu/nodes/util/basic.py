@@ -49,6 +49,12 @@ def _concat_last(parts):
 class ClassLabelIndicatorsFromInt(Transformer):
     """int label → length-k vector of -1/+1."""
 
+    fusable = True   # one_hot is traceable; joins fused chains
+    chunkable = True  # pure per-item fn: distributes over chunks
+    #: unfused batch path masks padded rows to zero (`_int_indicators`);
+    #: the fusion builder re-applies the mask so label sums stay exact
+    fuse_masks_output = True
+
     def __init__(self, num_classes: int):
         if num_classes < 2:
             raise ValueError("num_classes must be >= 2")
@@ -65,13 +71,27 @@ class ClassLabelIndicatorsFromInt(Transformer):
     def apply(self, y):
         return 2.0 * jax.nn.one_hot(y, self.num_classes) - 1.0
 
-    def apply_batch(self, data: Dataset):
+    def fuse(self):
+        k = self.num_classes
+        return (("ClassLabelIndicators", k), (),
+                lambda p, y: 2.0 * jax.nn.one_hot(y, k) - 1.0)
+
+    def apply_batch(self, data):
+        if not isinstance(data, Dataset):
+            return super().apply_batch(data)
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         return data.with_data(_int_indicators(data.array, data.mask, k=self.num_classes))
 
 
 class ClassLabelIndicatorsFromIntArray(Transformer):
     """multi-label int array → ±1 indicator (ClassLabelIndicators.scala:38-55).
     Expects per-item fixed-size padded label arrays with -1 as padding."""
+
+    fusable = True
+    chunkable = True
+    fuse_masks_output = True  # see ClassLabelIndicatorsFromInt
 
     def __init__(self, num_classes: int):
         self.num_classes = num_classes
@@ -80,7 +100,21 @@ class ClassLabelIndicatorsFromIntArray(Transformer):
         onehots = jax.nn.one_hot(ys, self.num_classes)  # (L, k); -1 rows are 0
         return 2.0 * jnp.clip(jnp.sum(onehots, axis=0), 0.0, 1.0) - 1.0
 
-    def apply_batch(self, data: Dataset):
+    def fuse(self):
+        k = self.num_classes
+
+        def fn(p, Y):
+            onehots = jax.nn.one_hot(Y, k)  # (n, L, k); -1 rows are 0
+            return 2.0 * jnp.clip(jnp.sum(onehots, axis=1), 0.0, 1.0) - 1.0
+
+        return (("ClassLabelIndicatorsArray", k), (), fn)
+
+    def apply_batch(self, data):
+        if not isinstance(data, Dataset):
+            return super().apply_batch(data)
+        from ...telemetry import record_dispatch
+
+        record_dispatch()
         return data.with_data(
             _int_array_indicators(data.array, data.mask, k=self.num_classes)
         )
@@ -90,6 +124,7 @@ class MaxClassifier(Transformer):
     """argmax over scores → int label (MaxClassifier.scala)."""
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks
 
     def abstract_apply(self, elem):
         from ...analysis.specs import SpecMismatchError, shape_struct
@@ -107,6 +142,9 @@ class MaxClassifier(Transformer):
 
     def apply_batch(self, data):
         if isinstance(data, Dataset):
+            from ...telemetry import record_dispatch
+
+            record_dispatch()
             return data.with_data(_argmax_last(data.array))
         return super().apply_batch(data)
 
@@ -128,6 +166,9 @@ class VectorCombiner(Transformer):
 
     def apply_batch(self, data):
         if isinstance(data, Dataset) and isinstance(data.data, tuple):
+            from ...telemetry import record_dispatch
+
+            record_dispatch()
             return data.with_data(_concat_last(data.data))
         return super().apply_batch(data)
 

@@ -161,27 +161,105 @@ def _peephole(stages):
     return out
 
 
+def _mask_rows(y, mb):
+    """Zero the padded rows of a per-chunk result (mb: bool (chunk,))."""
+    return y * mb.reshape((-1,) + (1,) * (y.ndim - 1)).astype(y.dtype)
+
+
+#: sentinel 4th element marking a fuse() whose fn already takes
+#: (params, xb, mask_b) — produced by composing decompositions
+#: (`FusedBatchTransformer.fuse`, `_GatherConcatStage.fuse`).
+_MASK_AWARE = "mask-aware"
+
+
 def _stage_fuse(stage: Transformer):
     """Decompose a stage into (static_key, params_pytree, pure_fn) where
-    ``pure_fn(params, xb) -> yb``.
+    ``pure_fn(params, xb, mask_b) -> yb`` (``mask_b`` is the chunk's
+    valid-row mask).
 
     Stages implementing ``fuse()`` get cross-instance program caching:
     two pipelines with the same structure but different parameter VALUES
     share one compiled XLA program (params are traced arguments, not
     baked constants). Stages without it fall back to a closure keyed on
     object identity — correct, but compiled per instance.
+
+    Mask discipline: a stage whose *unfused* batch path re-zeros padded
+    rows (``fuse_masks_output = True`` — StandardScalerModel, the label
+    indicators) keeps doing so inside the fused program, so mask-less
+    whole-batch reductions downstream (`_normal_equations`, `_moments`,
+    which rely on 'padded rows are zero') see exactly the values the
+    node-by-node path would have produced.
     """
     f = getattr(stage, "fuse", None)
     if f is not None:
-        return f()
-    fn = _stage_batch_fn(stage)
-    return (("opaque", id(stage)), (), lambda params, xb: fn(xb))
+        res = f()
+        if len(res) == 4 and res[3] == _MASK_AWARE:
+            key, params, fn = res[:3]
+        else:
+            key, params, fn2 = res
+            fn = (lambda p, xb, mb, fn2=fn2: fn2(p, xb))
+    else:
+        bf = _stage_batch_fn(stage)
+        key, params = ("opaque", id(stage)), ()
+        fn = (lambda p, xb, mb, bf=bf: bf(xb))
+    if getattr(stage, "fuse_masks_output", False):
+        inner = fn
+        fn = (lambda p, xb, mb, inner=inner: _mask_rows(inner(p, xb, mb), mb))
+        key = (key, "masked")
+    return key, params, fn
 
 
 # (structure key) -> jitted program. Programs take (flat_params, xs) so
 # rebuilding a pipeline — the bench re-fits from scratch — never
 # recompiles the featurizer.
 _PROGRAM_CACHE: dict = {}
+
+
+def _contains_opaque(key) -> bool:
+    """True when a (possibly nested — composed FusedChain keys) static
+    key contains an id-keyed "opaque" entry, which must never enter the
+    global program cache (see the opaque comment in `apply_batch`)."""
+    if isinstance(key, tuple):
+        return any(_contains_opaque(k) for k in key)
+    return key == "opaque"
+
+
+class _GatherConcatStage(Transformer):
+    """N fusable branches over ONE input, concatenated along the last
+    axis — a ``Pipeline.gather`` fan-out plus its `VectorCombiner`
+    collapsed into a single traceable stage, so the whole
+    branch-and-merge diamond compiles into one XLA program
+    (NodeFusionRule's gather pass). Branch order is the gather's
+    dependency order, matching `zip_datasets` + concat semantics."""
+
+    fusable = True
+
+    def __init__(self, branches: Sequence[Transformer]):
+        self.branches = list(branches)
+
+    @property
+    def label(self) -> str:
+        return "Gather[" + " | ".join(b.label for b in self.branches) + "]"
+
+    @property
+    def chunkable(self) -> bool:
+        return all(getattr(b, "chunkable", False) for b in self.branches)
+
+    def apply(self, x):
+        return jnp.concatenate(
+            [jnp.asarray(b.apply(x)) for b in self.branches], axis=-1)
+
+    def fuse(self):
+        fused = [_stage_fuse(b) for b in self.branches]
+        statics = tuple(f[0] for f in fused)
+        params = tuple(f[1] for f in fused)
+        fns = tuple(f[2] for f in fused)
+
+        def fn(ps, xb, mb):
+            return jnp.concatenate(
+                [f(p, xb, mb) for f, p in zip(fns, ps)], axis=-1)
+
+        return (("GatherConcat",) + statics, params, fn, _MASK_AWARE)
 
 
 class FusedBatchTransformer(Transformer):
@@ -192,6 +270,10 @@ class FusedBatchTransformer(Transformer):
     microbatch: rows processed per step per shard.
     """
 
+    #: a fused chain is itself a traceable single-dep stage, so later
+    #: optimizer passes (or hand-fused example featurizers) can extend it
+    fusable = True
+
     def __init__(self, stages: Sequence[Transformer], microbatch: int = 2048):
         self.stages = list(stages)
         self.microbatch = microbatch
@@ -200,10 +282,36 @@ class FusedBatchTransformer(Transformer):
     def label(self) -> str:
         return "Fused[" + " >> ".join(s.label for s in self.stages) + "]"
 
+    @property
+    def chunkable(self) -> bool:
+        """A fused chain distributes over host chunks iff every stage
+        does — so PR-1's overlap engine keeps streaming through fused
+        chains instead of silently materializing at the fusion boundary
+        (KP302)."""
+        return all(getattr(s, "chunkable", False) for s in self.stages)
+
     def apply(self, x):
         for s in self.stages:
             x = s.apply(x)
         return x
+
+    def fuse(self):
+        """Compose the stages' own fuse decompositions, so a fused chain
+        embedded in a LARGER chain (optimizer re-fusion, fitted fused
+        chains) keeps structural program caching instead of degrading to
+        an id-keyed opaque closure. Mask-aware: inner masking stages
+        keep re-zeroing padded rows at their original chain position."""
+        fused = [_stage_fuse(s) for s in _peephole(self.stages)]
+        statics = tuple(f[0] for f in fused)
+        params = tuple(f[1] for f in fused)
+        fns = tuple(f[2] for f in fused)
+
+        def fn(ps, xb, mb):
+            for f, p in zip(fns, ps):
+                xb = f(p, xb, mb)
+            return xb
+
+        return (("FusedChain",) + statics, params, fn, _MASK_AWARE)
 
     def apply_batch(self, data):
         if not isinstance(data, Dataset):
@@ -232,7 +340,7 @@ class FusedBatchTransformer(Transformer):
         # globally would pin the stage (and its captured arrays) forever
         # and make the id-keyed entry unsafe after GC reuses the id. Keep
         # such programs on THIS instance instead.
-        opaque = any(s[0] == "opaque" for s in statics)
+        opaque = _contains_opaque(statics)
         cache = (
             self.__dict__.setdefault("_instance_programs", {})
             if opaque
@@ -242,7 +350,10 @@ class FusedBatchTransformer(Transformer):
         if program is None:
             program = self._build_program(data, treedef, fns)
             cache[key] = program
-        return data.with_data(program(flat, data.array))
+        from ...telemetry import record_dispatch
+
+        record_dispatch()  # the whole chain is ONE executed program
+        return data.with_data(program(flat, data.array, data.mask))
 
     def _build_program(self, data: Dataset, treedef, fns):
         mesh = data.mesh
@@ -252,19 +363,22 @@ class FusedBatchTransformer(Transformer):
         n_chunks = -(-local_n // chunk)
         padded_local = n_chunks * chunk
 
-        def chunk_fn(params, xb):
+        def chunk_fn(params, xb, mb):
             for f, p in zip(fns, params):
-                xb = f(p, xb)
+                xb = f(p, xb, mb)
             return xb
 
-        def per_shard(flat_params, xs):  # xs: (local_n, ...) — shard rows
+        def per_shard(flat_params, xs, ms):
+            # xs: (local_n, ...) shard rows; ms: (local_n,) valid mask
             params = jax.tree_util.tree_unflatten(treedef, flat_params)
             if padded_local != local_n:
                 pad = [(0, padded_local - local_n)] + [(0, 0)] * (xs.ndim - 1)
                 xs = jnp.pad(xs, pad)
+                ms = jnp.pad(ms, [(0, padded_local - local_n)])
             xs = xs.reshape((n_chunks, chunk) + xs.shape[1:])
+            ms = ms.reshape((n_chunks, chunk))
             # sequential chunks: bounded HBM
-            ys = lax.map(lambda xb: chunk_fn(params, xb), xs)
+            ys = lax.map(lambda xm: chunk_fn(params, xm[0], xm[1]), (xs, ms))
             ys = ys.reshape((padded_local,) + ys.shape[2:])
             return ys[:local_n]
 
@@ -275,14 +389,14 @@ class FusedBatchTransformer(Transformer):
                 from jax import shard_map
 
                 fn = shard_map(
-                    per_shard, mesh=mesh, in_specs=(flat_specs, spec),
+                    per_shard, mesh=mesh, in_specs=(flat_specs, spec, spec),
                     out_specs=spec, check_vma=False,
                 )
             except ImportError:  # older jax: experimental API, check_rep kwarg
                 from jax.experimental.shard_map import shard_map
 
                 fn = shard_map(
-                    per_shard, mesh=mesh, in_specs=(flat_specs, spec),
+                    per_shard, mesh=mesh, in_specs=(flat_specs, spec, spec),
                     out_specs=spec, check_rep=False,
                 )
         else:

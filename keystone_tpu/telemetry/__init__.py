@@ -44,13 +44,14 @@ from .spans import (
 )
 from .export import (
     aggregate_spans,
+    dispatch_summary,
     load_trace,
     self_times,
     summarize,
     to_chrome_trace,
     write_trace,
 )
-from .instrument import estimate_bytes, instrument_node_force
+from .instrument import estimate_bytes, instrument_node_force, record_dispatch
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -58,7 +59,7 @@ __all__ = [
     "SpanRecord", "Tracer", "capabilities", "current_tracer",
     "record_capability", "set_tracer", "span", "telemetry_active",
     "trace_run",
-    "aggregate_spans", "load_trace", "self_times", "summarize",
-    "to_chrome_trace", "write_trace",
-    "estimate_bytes", "instrument_node_force",
+    "aggregate_spans", "dispatch_summary", "load_trace", "self_times",
+    "summarize", "to_chrome_trace", "write_trace",
+    "estimate_bytes", "instrument_node_force", "record_dispatch",
 ]

@@ -131,6 +131,24 @@ def aggregate_spans(
     return agg
 
 
+def dispatch_summary(trace: Dict[str, Any]) -> Optional[str]:
+    """One-line per-run dispatch digest from a trace's metrics snapshot
+    (programs executed, node forces, concurrent-scheduler activity), or
+    None when the trace predates the dispatch counters. Shared by the
+    trace CLI and `scripts/perf_table.py` so the two reports cannot
+    drift."""
+    counters = trace.get("keystone", {}).get("metrics", {}).get("counters", {})
+    programs = counters.get("dispatch.programs_executed", {}).get("value")
+    if not programs:
+        return None
+    sched = counters.get("dispatch.scheduler_runs", {}).get("value", 0)
+    tasks = counters.get("dispatch.scheduled_tasks", {}).get("value", 0)
+    forces = counters.get("executor.node_forces", {}).get("value", 0)
+    return (f"programs executed: {int(programs)} "
+            f"(node forces {int(forces)}; concurrent scheduler ran "
+            f"{int(sched)}x over {int(tasks)} task(s))")
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -179,6 +197,10 @@ def summarize(trace: Dict[str, Any], top: int = 15) -> str:
                 f"consumer wait:  {wait['total']:.4f}s total over "
                 f"{int(wait['count'])} get(s) (max {wait['max']:.4f}s)")
     counters = ks.get("metrics", {}).get("counters", {})
+    dispatch = dispatch_summary(trace)
+    if dispatch:
+        lines.append("\n== dispatch ==")
+        lines.append(dispatch)
     moved = counters.get("overlap.bytes_pulled", {}).get("value")
     if moved:
         lines.append(f"\nbytes pulled off device: {_fmt_bytes(moved)}")

@@ -17,6 +17,11 @@ contract documented in OBSERVABILITY.md:
   overlap.inflight_results / resident_chunks    (gauges)
   overlap.bytes_pulled / chunks_dispatched      (counters)
   solver.steps                              (counter)
+  dispatch.programs_executed                (counter; one per jitted
+                                             call boundary — see
+                                             instrument.record_dispatch)
+  dispatch.scheduler_runs / scheduled_tasks (counters; concurrent DAG
+                                             scheduler activity)
 
 Thread-safety: one process lock guards mutation — producer threads
 (overlap engine) and the main thread share these. Updates are

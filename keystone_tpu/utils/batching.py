@@ -43,7 +43,7 @@ from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..telemetry import counter, gauge, histogram, span
+from ..telemetry import counter, gauge, histogram, record_dispatch, span
 
 
 class _ProducerError:
@@ -181,6 +181,7 @@ def _stream_serial(items, plan, batch_fn) -> Iterator[Tuple[List[int], List]]:
     at a time."""
     for i, part in enumerate(plan):
         with span("chunk_serial", cat="chunk", idx=i, rows=len(part)):
+            record_dispatch()  # one program per (shape, chunk) dispatch
             out = _split_result(batch_fn(_stack_chunk(items, part)), part)
         yield out
 
@@ -271,6 +272,7 @@ def _stream_overlapped(
             # async dispatch: returns immediately, device queues the work
             inflight.append((part, batch_fn(chunk)))
             dispatched.inc()
+            record_dispatch()  # one program per dispatched chunk
             _note_residency()
             if len(inflight) > depth:
                 yield _drain(drained)

@@ -41,6 +41,7 @@ from .optimizer import (
     SavedStateLoadRule,
     UnusedBranchRemovalRule,
 )
+from .fusion_rule import FusedChainOperator, NodeFusionRule
 from .pipeline import (
     Chainable,
     Estimator,

@@ -8,9 +8,12 @@ and swapped back in by `SavedStateLoadRule` on later optimizations, so
 re-applying or extending a pipeline never refits
 (reference PipelineEnv.scala:7-45, ExtractSaveablePrefixes.scala:9-22).
 
-Like the reference, none of this is thread-safe; safety comes from a
-single-threaded host orchestrator and immutable graphs
-(Pipeline.scala:14, PipelineEnv.scala:11).
+Graphs are immutable and the prefix table is only *mutated* on the
+thread that wires a pipeline's expressions (Pipeline.scala:14,
+PipelineEnv.scala:11); the concurrent DAG scheduler (executor.py) only
+ever *forces* already-wired expressions from its worker pool, each
+vertex by exactly one worker, so the tables never see a cross-thread
+read-modify-write.
 """
 
 from __future__ import annotations
@@ -57,12 +60,29 @@ class ExecutionConfig:
     and writes Chrome trace-event JSON to this path at exit (see
     `keystone_tpu.telemetry` and OBSERVABILITY.md). None disables
     tracing (the instrumented hot paths reduce to one global read).
+
+    ``concurrent_dispatch`` (default on; env
+    ``KEYSTONE_CONCURRENT_DISPATCH=0`` reverts to the serial recursive
+    force) turns on the executor's concurrent DAG scheduler: independent
+    subgraphs of a forced pipeline are forced by a bounded worker pool
+    in topological order, so multiple XLA programs stay in flight over
+    the tunnel instead of dispatching strictly one node at a time.
+    Results are deterministic (each vertex is forced exactly once, by
+    exactly one worker, after all of its dependencies) and single-user
+    streaming stages keep their lazy chunk flow (see
+    `GraphExecutor._force_concurrent`).
+
+    ``dispatch_workers`` bounds the scheduler's pool (env
+    ``KEYSTONE_DISPATCH_WORKERS``, default 4; values <= 1 force the
+    serial path).
     """
 
     overlap: bool = True
     prefetch_depth: int = 2
     hbm_budget_bytes: Optional[int] = None
     trace_path: Optional[str] = None
+    concurrent_dispatch: bool = True
+    dispatch_workers: int = 4
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -83,6 +103,12 @@ def execution_config() -> ExecutionConfig:
                 else None
             ),
             trace_path=os.environ.get("KEYSTONE_TRACE") or None,
+            concurrent_dispatch=os.environ.get(
+                "KEYSTONE_CONCURRENT_DISPATCH", "1").lower()
+            not in ("0", "false", "off"),
+            dispatch_workers=max(
+                1, int(os.environ.get("KEYSTONE_DISPATCH_WORKERS", "4"))
+            ),
         )
     return _exec_config
 
@@ -102,6 +128,23 @@ def overlap_override(enabled: bool, prefetch_depth: Optional[int] = None):
     cfg = replace(execution_config(), overlap=enabled)
     if prefetch_depth is not None:
         cfg = replace(cfg, prefetch_depth=max(1, prefetch_depth))
+    _exec_config = cfg
+    try:
+        yield cfg
+    finally:
+        _exec_config = prev
+
+
+@contextmanager
+def dispatch_override(enabled: bool, workers: Optional[int] = None):
+    """Scoped concurrent-dispatch toggle — the dispatch-count bench tier
+    and the scheduler test matrix flip the scheduler (and its worker
+    count) without touching process env state."""
+    global _exec_config
+    prev = _exec_config
+    cfg = replace(execution_config(), concurrent_dispatch=enabled)
+    if workers is not None:
+        cfg = replace(cfg, dispatch_workers=max(1, workers))
     _exec_config = cfg
     try:
         yield cfg

@@ -1,4 +1,4 @@
-"""Lazy memoized graph execution.
+"""Lazy memoized graph execution with a concurrent DAG scheduler.
 
 Mirrors reference workflow/GraphExecutor.scala:14-81: execution of a graph
 up to a `GraphId` optimizes the graph once (lazily, via the globally
@@ -6,15 +6,47 @@ configured optimizer), then recursively evaluates dependencies with
 per-vertex memoization. Results of nodes whose prefixes were marked
 saveable are written into the global prefix table so later executors can
 reuse them (fit-once guarantee, GraphExecutor.scala:65-71).
+
+Dispatch-bounded execution: the serial recursive force dispatches one
+node at a time — on the axon tunnel every program boundary costs 65–95 ms
+of RTT, so a pipeline's wall clock is its *program count*, not its FLOPs
+(PERF.md round 4). When `ExecutionConfig.concurrent_dispatch` is on (the
+default; ``KEYSTONE_CONCURRENT_DISPATCH=0`` reverts), forcing a sink
+first runs `_force_concurrent`: the root's ancestor sub-DAG is forced in
+topological order by a bounded worker pool, so independent subgraphs
+(gather branches, train-vs-test applies, estimator fits) keep multiple
+programs in flight concurrently. Guarantees:
+
+  - **single force** — each vertex is claimed by exactly one worker, in
+    a deterministic (topo-index) order; the memo/prefix tables are only
+    mutated during single-threaded wiring, never from the pool;
+  - **deterministic results** — values are pure functions of already-
+    forced dependencies, so worker count cannot change any output;
+  - **serial-identical exceptions** — on failure the scheduler stops
+    issuing work, drains in-flight tasks, and re-raises the failure of
+    the earliest vertex in topo order (what the depth-first serial
+    force would have hit); the failing expression stays unforced, so a
+    retry re-runs exactly as the serial path would;
+  - **streaming stays lazy** — a single-consumer streaming stage is
+    never forced by the pool; its chunks keep flowing into the consumer
+    (the PR-1 overlap engine still applies inside fused chains), while
+    fan-out streaming stages are materialized *before* their consumers
+    can race on `iter_chunks`.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+import threading
+from typing import Dict, List, Optional, Tuple
 
-from .env import PipelineEnv, Prefix
+from .env import PipelineEnv, Prefix, execution_config
 from .expressions import Expression, StreamingDatasetExpression
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
+
+# A worker thread re-entering `execute` (e.g. a fit forcing a nested
+# sample executor) must not spawn a nested pool: the flag makes inner
+# schedules run serially on the worker itself.
+_sched_local = threading.local()
 
 
 class GraphExecutor:
@@ -32,6 +64,7 @@ class GraphExecutor:
         self._memo: Dict[GraphId, Expression] = {}
         self._structure_checked = False
         self._static_recorded = False
+        self._concurrent_wrapped: set = set()
 
     @property
     def graph(self) -> Graph:
@@ -149,7 +182,214 @@ class GraphExecutor:
             self._memo[vid] = expr
             return expr
 
-        return go(graph_id)
+        root = go(graph_id)
+        self._arm_concurrent(graph_id, root, graph)
+        return root
+
+    # ---------------------------------------------------- concurrent force
+
+    def _arm_concurrent(self, root_id: GraphId, root: Expression,
+                        graph: Graph) -> None:
+        """Hook the concurrent scheduler into ``root``'s force (or first
+        chunk drain), preserving laziness: nothing runs until the caller
+        forces the result, exactly as on the serial path. Wrapping is
+        idempotent per root; the on/off decision is read from the live
+        `ExecutionConfig` at force time so scoped overrides
+        (`dispatch_override`) behave."""
+        if root_id in self._concurrent_wrapped or root.is_forced:
+            return
+        self._concurrent_wrapped.add(root_id)
+
+        def prefetch():
+            if getattr(_sched_local, "active", False):
+                return  # a pool worker forcing this root: its ancestors
+                # are already ordered by the schedule that claimed it
+            cfg = execution_config()
+            if cfg.concurrent_dispatch and cfg.dispatch_workers > 1:
+                self._force_concurrent(root_id, graph, cfg.dispatch_workers)
+
+        chunks_thunk = getattr(root, "_chunks_thunk", None)
+        if chunks_thunk is not None:
+            def chunks(orig=chunks_thunk):
+                prefetch()
+                return orig()
+
+            root._chunks_thunk = chunks
+        elif root._thunk is not None:
+            def thunk(orig=root._thunk):
+                prefetch()
+                return orig()
+
+            root._thunk = thunk
+
+    def _schedule_plan(self, root_id: GraphId, graph: Graph):
+        """Partition the root's ancestor sub-DAG into worker tasks.
+
+        Returns ``(tasks, eff_deps)`` where ``tasks`` is the topo-ordered
+        list of vertices the pool must force and ``eff_deps[v]`` the set
+        of *tasks* that must complete first. Vertices are *deferred*
+        (absorbed into their consumer's task) when forcing them eagerly
+        would change semantics or defeat the overlap engine:
+
+          - already-forced expressions (nothing to do),
+          - a non-forced streaming expression with exactly one consumer
+            in scope — its chunks must keep draining lazily into that
+            consumer (fan-out streams ARE forced here, so two racing
+            consumers can never interleave `iter_chunks`),
+          - the root itself (the caller's force runs it).
+        """
+        from .analysis import linearize
+
+        order = [v for v in linearize(graph, root_id)
+                 if not isinstance(v, SourceId)]
+        scope = set(order)
+
+        def vertex_deps(v) -> List[GraphId]:
+            if isinstance(v, SinkId):
+                deps = [graph.get_sink_dependency(v)]
+            else:
+                deps = list(graph.get_dependencies(v))
+            return [d for d in dict.fromkeys(deps) if d in scope]
+
+        users: Dict[GraphId, int] = {}
+        for v in order:
+            for d in vertex_deps(v):
+                users[d] = users.get(d, 0) + 1
+
+        # Which vertices can yield a genuine multi-chunk stream? Most
+        # device stages are wrapped in StreamingDatasetExpression but
+        # materialize as ONE whole-value chunk — forcing those on the
+        # pool is free concurrency. Only a stage that may actually
+        # produce chunks (a stream origin: bucketed host dispatchers) or
+        # pass them through (chunkable, fed by a may-stream dep) must
+        # stay lazy so the overlap engine keeps draining it into its
+        # consumer chunk-by-chunk.
+        from ..analysis.hazards import _is_stream_origin
+
+        may_stream: Dict[GraphId, bool] = {}
+        for v in order:  # topo: deps resolved before dependents
+            if isinstance(v, SinkId):
+                may_stream[v] = any(
+                    may_stream.get(d, False) for d in vertex_deps(v))
+                continue
+            op = graph.get_operator(v)
+            cap = getattr(op, "may_consume_chunks",
+                          getattr(op, "chunkable", False))
+            may_stream[v] = _is_stream_origin(op) or (
+                bool(cap)
+                and any(may_stream.get(d, False) for d in vertex_deps(v))
+            )
+
+        deferred = set()
+        root_expr = self._memo.get(root_id)
+        for v in order:
+            expr = self._memo.get(v)
+            if expr is None or expr.is_forced:
+                deferred.add(v)
+            elif v == root_id or expr is root_expr:
+                # the caller forces the root (a sink shares its dep
+                # node's Expression object — both ARE the root); keeping
+                # it off the pool also keeps its span nesting serial
+                deferred.add(v)
+            elif isinstance(expr, StreamingDatasetExpression) \
+                    and users.get(v, 0) <= 1 and may_stream.get(v, False):
+                deferred.add(v)
+
+        eff_memo: Dict[GraphId, frozenset] = {}
+
+        def eff_deps(v) -> frozenset:
+            got = eff_memo.get(v)
+            if got is None:
+                out = set()
+                for d in vertex_deps(v):
+                    if d in deferred:
+                        out |= eff_deps(d)
+                    else:
+                        out.add(d)
+                got = eff_memo[v] = frozenset(out)
+            return got
+
+        tasks = [v for v in order if v not in deferred]
+        return tasks, {v: eff_deps(v) for v in tasks}
+
+    def _force_concurrent(self, root_id: GraphId, graph: Graph,
+                          workers: int) -> None:
+        """Force the root's ancestor tasks with a bounded worker pool in
+        topological order (see module docstring for the guarantees)."""
+        tasks, eff_deps = self._schedule_plan(root_id, graph)
+        if len(tasks) < 2:
+            return
+        # nested schedules never reach here: a pool worker re-entering a
+        # wrapped root skips its prefetch() (the _sched_local.active
+        # guard in _arm_concurrent), so forcing proceeds depth-first on
+        # that worker — concurrency already exists one level up.
+
+        from ..telemetry import counter, span
+
+        topo_index = {v: i for i, v in enumerate(tasks)}
+        indeg = {v: len(eff_deps[v]) for v in tasks}
+        dependents: Dict[GraphId, List[GraphId]] = {v: [] for v in tasks}
+        for v in tasks:
+            for d in eff_deps[v]:
+                dependents[d].append(v)
+
+        cond = threading.Condition()
+        ready = sorted((v for v in tasks if indeg[v] == 0),
+                       key=topo_index.__getitem__)
+        outstanding = len(tasks)
+        failures: List[Tuple[int, BaseException]] = []
+        stop = False
+
+        def worker():
+            nonlocal outstanding, stop
+            _sched_local.active = True
+            try:
+                while True:
+                    with cond:
+                        while not ready and outstanding and not stop:
+                            cond.wait()
+                        if not ready or stop:
+                            return
+                        v = ready.pop(0)
+                    err = None
+                    try:
+                        self._memo[v].get
+                    except BaseException as e:  # recorded, raised in order
+                        err = e
+                    with cond:
+                        outstanding -= 1
+                        if err is not None:
+                            failures.append((topo_index[v], err))
+                            stop = True  # serial would not run past here
+                        else:
+                            for u in dependents[v]:
+                                indeg[u] -= 1
+                                if indeg[u] == 0:
+                                    ready.append(u)
+                            ready.sort(key=topo_index.__getitem__)
+                        cond.notify_all()
+            finally:
+                _sched_local.active = False
+
+        counter("dispatch.scheduler_runs").inc()
+        counter("dispatch.scheduled_tasks").inc(len(tasks))
+        n = min(workers, len(tasks))
+        with span("dispatch.schedule", cat="phase", tasks=len(tasks),
+                  workers=n):
+            threads = [
+                threading.Thread(target=worker,
+                                 name=f"keystone-dispatch-{i}", daemon=True)
+                for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if failures:
+            # deterministic across worker counts for a single failing
+            # vertex; with several, the earliest scheduled failure wins —
+            # the vertex a depth-first serial force reaches first
+            raise min(failures, key=lambda f: f[0])[1]
 
     def execute_stream(self, graph_id: GraphId):
         """Execute up to ``graph_id``, yielding ``(indices, payload)``

@@ -1,34 +1,326 @@
 """Automatic stage-fusion rule — a TPU-native optimizer pass with no
 reference analog (Spark streams partition iterators, so per-node
 materialization is free there; on TPU every node boundary is an HBM
-round-trip).
+round-trip AND a ~65-95 ms tunnel RTT — programs, not bytes, bound the
+headline path; see PERF.md round 4).
 
-`NodeFusionRule` finds maximal linear chains of adjacent transformer
-nodes that declare themselves XLA-traceable (``fusable = True``) and
-replaces each chain with one `FusedBatchTransformer`, so the whole chain
-compiles into a single microbatched XLA program (see
-nodes/util/fusion.py).
+`NodeFusionRule` finds maximal linear chains of adjacent nodes that can
+compile into one XLA program and replaces each chain with a single
+operator:
+
+  - transformer nodes that declare themselves XLA-traceable
+    (``fusable = True``) fuse into one `FusedBatchTransformer`
+    (nodes/util/fusion.py) exactly as before;
+  - with ``fuse_apply`` (default on), chains additionally extend through
+    *fan-out-free estimator apply boundaries*: a `DelegatingOperator`
+    whose estimator declares ``fusable_fit = True`` (its fit always
+    yields a traceable transformer — scalers, least-squares mappers)
+    joins the chain as a `_FitSlot`. The chain lowers to a
+    `FusedChainOperator` whose extra dependencies are the estimator
+    expressions; at force time the fitted transformers are captured as
+    fused closure *params* (exactly what `run_fused` does by hand for
+    CIFAR) and the whole chain runs as one program;
+  - also with ``fuse_apply``, fusable ``Pipeline.gather`` diamonds
+    (N traceable branches over one source + VectorCombiner) collapse
+    into one `_GatherConcatStage` program (`_fuse_gathers`).
+
+A node with two children terminates the chain (fusing across fan-out
+would duplicate work for one consumer and starve the other's memo), and
+chain discovery walks up to the chain head from ANY member, so the result
+is independent of node-id iteration order.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Sequence
 
 from .analysis import children
+from .expressions import (
+    DatasetExpression,
+    DatumExpression,
+    Expression,
+    StreamingDatasetExpression,
+    TransformerExpression,
+)
 from .graph import Graph, NodeId
+from .operators import (
+    DelegatingOperator,
+    EstimatorOperator,
+    ExpressionOperator,
+    Operator,
+    _overlap_enabled,
+    _streamed_batch,
+)
 from .optimizer import Plan, Rule
 
 
-class NodeFusionRule(Rule):
-    def __init__(self, microbatch: int = 2048):
+class _FitSlot:
+    """Placeholder in a fused chain's stage list: 'the transformer fitted
+    by estimator dependency ``index``' (resolved at force time)."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __repr__(self) -> str:
+        return f"fit:{self.index}"
+
+
+class FusedChainOperator(Operator):
+    """A fused linear chain that crosses estimator `apply` boundaries.
+
+    Dependencies: ``(est_0, ..., est_{k-1}, data)`` — the estimator
+    expressions whose fitted transformers fill the chain's `_FitSlot`s,
+    then the single data input. Forcing the output forces the fits
+    (fit-once still holds: the shared TransformerExpressions memoize) and
+    composes the fully-fitted stage list into one microbatched XLA
+    program via `FusedBatchTransformer`; if a fit unexpectedly yields a
+    non-traceable transformer the chain degrades to a sequential
+    `TransformerChain` — same values, per-stage dispatch.
+
+    The data input keeps PR-1 overlap semantics: under the overlap engine
+    the output is a `StreamingDatasetExpression` whose thunk routes
+    through `_streamed_batch`, so a chunk-streaming upstream keeps
+    draining chunk-by-chunk through the fused chain when every fitted
+    stage is ``chunkable``.
+    """
+
+    may_consume_chunks = True
+
+    def __init__(self, stage_specs: Sequence, microbatch: int = 2048):
+        self.stage_specs = list(stage_specs)
         self.microbatch = microbatch
 
+    @property
+    def n_fits(self) -> int:
+        return sum(1 for s in self.stage_specs if isinstance(s, _FitSlot))
+
+    @property
+    def estimator_positions(self) -> tuple:
+        """Dependency indices that consume estimator outputs (KP003)."""
+        return tuple(range(self.n_fits))
+
+    @property
+    def label(self) -> str:
+        return "Fused[" + " >> ".join(
+            repr(s) if isinstance(s, _FitSlot) else s.label
+            for s in self.stage_specs) + "]"
+
+    def materialize(self, fitted: Sequence):
+        """Resolve `_FitSlot`s against ``fitted`` (one TransformerOperator
+        per estimator dependency, in order) and build the runnable fused
+        transformer. Shared by force-time execution and `Pipeline.fit`'s
+        estimator substitution."""
+        from ..nodes.util.fusion import FusedBatchTransformer
+        from .pipeline import TransformerChain
+
+        stages = [fitted[s.index] if isinstance(s, _FitSlot) else s
+                  for s in self.stage_specs]
+        if all(getattr(s, "fusable", False) for s in stages):
+            return FusedBatchTransformer(stages, microbatch=self.microbatch)
+        return TransformerChain(stages)
+
+    def abstract_eval(self, in_specs: List) -> object:
+        from ..analysis.specs import (
+            UNKNOWN,
+            DataSpec,
+            SpecMismatchError,
+            TransformerSpec,
+            is_known,
+            trace_element,
+        )
+
+        if len(in_specs) != self.n_fits + 1:
+            raise SpecMismatchError(
+                f"fused chain expects {self.n_fits} estimator "
+                f"dependency(ies) plus data, got {len(in_specs)}",
+                rule="KP002")
+        t_specs, data_spec = in_specs[:-1], in_specs[-1]
+        for i, ts in enumerate(t_specs):
+            if isinstance(ts, DataSpec):
+                raise SpecMismatchError(
+                    f"fused-chain dependency {i} produces data, not a "
+                    "transformer", rule="KP004")
+        if isinstance(data_spec, TransformerSpec):
+            raise SpecMismatchError(
+                "a transformer output is consumed as the fused chain's "
+                "data input (fit-before-use)", rule="KP003")
+        if not isinstance(data_spec, DataSpec):
+            return UNKNOWN
+
+        elem = data_spec.element
+        for s in self.stage_specs:
+            if not is_known(elem):
+                elem = UNKNOWN
+                break
+            if isinstance(s, _FitSlot):
+                ts = t_specs[s.index]
+                elem = (ts.apply_element(elem)  # may raise mismatch
+                        if isinstance(ts, TransformerSpec) else UNKNOWN)
+            else:
+                elem = trace_element(
+                    lambda x, s=s: s.single_transform([x]), (elem,))
+
+        # chunk capability of the fitted slots is only provable when the
+        # estimator's spec declares it — conservative otherwise
+        chunk_ok = all(
+            getattr(s, "chunkable", False) if not isinstance(s, _FitSlot)
+            else (isinstance(t_specs[s.index], TransformerSpec)
+                  and t_specs[s.index].chunkable)
+            for s in self.stage_specs)
+        return DataSpec(
+            element=elem,
+            count=data_spec.count if data_spec.kind == "dataset" else None,
+            kind=data_spec.kind,
+            on_device=data_spec.on_device,
+            streaming=(data_spec.kind == "dataset" and data_spec.streaming
+                       and chunk_ok),
+        )
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        deps = list(deps)
+        if len(deps) != self.n_fits + 1:
+            raise ValueError(
+                f"{self.label} expects {self.n_fits} estimator "
+                f"dependency(ies) plus one data dependency, got {len(deps)}")
+        t_exprs, data = deps[:-1], deps[-1]
+        for t in t_exprs:
+            if not isinstance(t, TransformerExpression):
+                raise ValueError(
+                    f"{self.label}: estimator dependency did not produce a "
+                    "transformer expression")
+
+        def make():
+            # forcing the fits happens HERE, inside the chain's own force
+            # — identical laziness to the DelegatingOperator path
+            return self.materialize([t.get for t in t_exprs])
+
+        if isinstance(data, DatumExpression):
+            return DatumExpression(lambda: make().single_transform([data.get]))
+        if _overlap_enabled():
+            return StreamingDatasetExpression(
+                lambda: _streamed_batch(make(), data))
+        return DatasetExpression(lambda: make().batch_transform([data.get]))
+
+
+class NodeFusionRule(Rule):
+    def __init__(self, microbatch: int = 2048, fuse_apply: bool = True):
+        self.microbatch = microbatch
+        #: PR-4 expanded coverage: fuse through fan-out-free estimator
+        #: apply boundaries AND collapse fusable gather/combiner
+        #: diamonds; the dispatch-count bench's "legacy" plan turns this
+        #: off to reproduce the PR-3 optimizer exactly
+        self.fuse_apply = fuse_apply
+
+    # ------------------------------------------------------ chain predicate
+
     @staticmethod
-    def _fusable(graph: Graph, node: NodeId) -> bool:
+    def _est_fusable(graph: Graph, dep) -> bool:
+        """Will this delegate's estimator dependency produce a traceable
+        (fusable) transformer? Provable for estimators that declare
+        ``fusable_fit`` and for already-forced saved state."""
+        if not isinstance(dep, NodeId):
+            return False
+        op = graph.get_operator(dep)
+        if isinstance(op, EstimatorOperator):
+            return bool(getattr(op, "fusable_fit", False))
+        if isinstance(op, ExpressionOperator):
+            e = op.expression
+            return (isinstance(e, TransformerExpression) and e.is_forced
+                    and bool(getattr(e.get, "fusable", False)))
+        return False
+
+    def _fusable(self, graph: Graph, node: NodeId) -> bool:
         op = graph.get_operator(node)
-        return getattr(op, "fusable", False) and len(graph.get_dependencies(node)) == 1
+        deps = graph.get_dependencies(node)
+        if getattr(op, "fusable", False) and len(deps) == 1:
+            return True
+        return (
+            self.fuse_apply
+            and isinstance(op, DelegatingOperator)
+            and len(deps) == 2
+            and self._est_fusable(graph, deps[0])
+        )
+
+    @staticmethod
+    def _data_dep(graph: Graph, node: NodeId):
+        """The chain-forming (data) dependency of a fusable node."""
+        deps = graph.get_dependencies(node)
+        if isinstance(graph.get_operator(node), DelegatingOperator):
+            return deps[1]
+        return deps[0]
+
+    # ------------------------------------------------------------ rewrite
+
+    def _fuse_gathers(self, plan: Plan) -> Plan:
+        """Collapse a fusable ``Pipeline.gather`` diamond — N single-dep
+        fusable branches over ONE source, zipped by a
+        GatherTransformerOperator whose sole consumer is a
+        VectorCombiner — into one `FusedBatchTransformer` wrapping a
+        `_GatherConcatStage`. The branch fan-out, the zip, and the
+        concat all become one XLA program; the linear pass below can
+        then chain it with whatever follows (MnistRandomFFT's whole
+        apply path collapses to a single program)."""
+        from ..nodes.util.basic import VectorCombiner
+        from ..nodes.util.fusion import FusedBatchTransformer, _GatherConcatStage
+        from .operators import GatherTransformerOperator
+
+        graph, prefixes = plan
+        gathers = [n for n in sorted(graph.operators, key=lambda n: n.id)
+                   if isinstance(graph.get_operator(n),
+                                 GatherTransformerOperator)]
+        for g in gathers:
+            if g not in graph.operators:
+                continue
+            deps = graph.get_dependencies(g)
+            if not deps or not all(isinstance(d, NodeId) for d in deps):
+                continue
+            srcs = set()
+            ok = True
+            for b in deps:
+                op = graph.get_operator(b)
+                bdeps = graph.get_dependencies(b)
+                if not (getattr(op, "fusable", False) and len(bdeps) == 1
+                        and set(children(graph, b)) == {g}):
+                    ok = False
+                    break
+                srcs.add(bdeps[0])
+            if not ok or len(srcs) != 1:
+                continue
+            kids = children(graph, g)
+            if len(kids) != 1:
+                continue
+            (kid,) = kids
+            if not isinstance(kid, NodeId) or not isinstance(
+                    graph.get_operator(kid), VectorCombiner):
+                continue
+            if graph.get_dependencies(kid) != (g,):
+                continue
+            (src,) = srcs
+            stage = _GatherConcatStage([graph.get_operator(b) for b in deps])
+            graph = graph.set_operator(
+                kid, FusedBatchTransformer([stage], microbatch=self.microbatch))
+            graph = graph.set_dependencies(kid, (src,))
+            graph = graph.remove_node(g)
+            prefixes.pop(g, None)
+            for b in dict.fromkeys(deps):
+                graph = graph.remove_node(b)
+                prefixes.pop(b, None)
+        return graph, prefixes
 
     def apply(self, plan: Plan) -> Plan:
+        plan = self._fuse_linear(plan)
+        if self.fuse_apply:
+            # gather diamonds need the linear pass FIRST (each branch
+            # collapses to one node over the shared source), and another
+            # linear pass AFTER so the collapsed combiner chains with
+            # its downstream neighbors (delegates, argmax)
+            plan = self._fuse_gathers(plan)
+            plan = self._fuse_linear(plan)
+        return plan
+
+    def _fuse_linear(self, plan: Plan) -> Plan:
         from ..nodes.util.fusion import FusedBatchTransformer
 
         graph, prefixes = plan
@@ -37,10 +329,11 @@ class NodeFusionRule(Rule):
         for node in sorted(graph.operators, key=lambda n: n.id):
             if node in visited or not self._fusable(graph, node):
                 continue
-            # walk up to the chain head
+            # walk up to the chain head (any member finds the same head,
+            # so discovery is independent of iteration order)
             head = node
             while True:
-                dep = graph.get_dependencies(head)[0]
+                dep = self._data_dep(graph, head)
                 if (
                     isinstance(dep, NodeId)
                     and self._fusable(graph, dep)
@@ -49,7 +342,7 @@ class NodeFusionRule(Rule):
                     head = dep
                 else:
                     break
-            # walk down collecting the chain
+            # walk down collecting the chain; a fan-out terminates it
             chain = [head]
             cur = head
             while True:
@@ -57,7 +350,14 @@ class NodeFusionRule(Rule):
                 if len(kids) != 1:
                     break
                 (kid,) = kids
-                if isinstance(kid, NodeId) and self._fusable(graph, kid):
+                if (
+                    isinstance(kid, NodeId)
+                    and self._fusable(graph, kid)
+                    # the child must consume cur as its DATA input — a
+                    # delegate whose *estimator* feeds from cur is a fit
+                    # boundary, not a chain link
+                    and self._data_dep(graph, kid) == cur
+                ):
                     chain.append(kid)
                     cur = kid
                 else:
@@ -69,15 +369,30 @@ class NodeFusionRule(Rule):
         for chain in chains:
             if any(n not in graph.operators for n in chain):
                 continue  # already rewritten by an overlapping chain
-            stages = [graph.get_operator(n) for n in chain]
-            fused = FusedBatchTransformer(stages, microbatch=self.microbatch)
-            head_dep = graph.get_dependencies(chain[0])
+            head_data_dep = self._data_dep(graph, chain[0])
+            est_deps: List = []
+            stage_specs: List = []
+            for n in chain:
+                op = graph.get_operator(n)
+                if isinstance(op, DelegatingOperator):
+                    stage_specs.append(_FitSlot(len(est_deps)))
+                    est_deps.append(graph.get_dependencies(n)[0])
+                else:
+                    stage_specs.append(op)
+            if est_deps:
+                fused: Operator = FusedChainOperator(
+                    stage_specs, microbatch=self.microbatch)
+                new_deps = tuple(est_deps) + (head_data_dep,)
+            else:
+                fused = FusedBatchTransformer(
+                    stage_specs, microbatch=self.microbatch)
+                new_deps = (head_data_dep,)
             graph = graph.set_operator(chain[0], fused)
             # rewire users of the tail to the head, then drop the rest
             graph = graph.replace_dependency(chain[-1], chain[0])
-            # the head now (wrongly) depends on itself via the rewire if the
-            # chain's second node pointed at head — restore true deps
-            graph = graph.set_dependencies(chain[0], head_dep)
+            # the head now (wrongly) depends on itself via the rewire if
+            # the chain's second node pointed at head — restore true deps
+            graph = graph.set_dependencies(chain[0], new_deps)
             for n in reversed(chain[1:]):
                 graph = graph.set_dependencies(n, ())
                 graph = graph.remove_node(n)

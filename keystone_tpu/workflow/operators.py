@@ -337,6 +337,14 @@ class DelegatingOperator(Operator):
     (Operator.scala:136-163). Forcing the transformer expression is the
     moment an estimator's fit actually happens."""
 
+    #: Dependency indices that legitimately consume an estimator output
+    #: (KP003 fit-before-use exempts these; see analysis.propagate).
+    estimator_positions: tuple = (0,)
+    #: The fitted transformer may be chunk-capable — unknowable until the
+    #: fit runs, so the concurrent scheduler must keep a streaming input
+    #: lazy rather than materialize it ahead of this node.
+    may_consume_chunks: bool = True
+
     def abstract_eval(self, in_specs: List[Any]) -> Any:
         from ..analysis.specs import (
             UNKNOWN,

@@ -253,7 +253,7 @@ class DefaultOptimizer(Optimizer):
     once) plus the TPU-native stage-fusion pass (see fusion_rule.py)."""
 
     def __init__(self, samples_per_shard: int = 3, fuse: bool = True,
-                 fusion_microbatch: int = 2048):
+                 fusion_microbatch: int = 2048, fuse_apply: bool = True):
         from .fusion_rule import NodeFusionRule
 
         self._batches = [
@@ -264,7 +264,11 @@ class DefaultOptimizer(Optimizer):
             Batch("cse", [EquivalentNodeMergeRule()], max_iterations=10),
         ]
         if fuse:
-            self._batches.append(Batch("fuse", [NodeFusionRule(fusion_microbatch)]))
+            # fuse_apply=False reproduces the PR-3 plan (transformer
+            # chains only, no fusion through estimator apply boundaries)
+            # — the dispatch-count bench's "legacy" baseline
+            self._batches.append(Batch("fuse", [
+                NodeFusionRule(fusion_microbatch, fuse_apply=fuse_apply)]))
         self._batches.append(Batch("node-opt", [NodeOptimizationRule(samples_per_shard)]))
 
     @property

@@ -244,11 +244,14 @@ class Pipeline(Chainable):
         """Eagerly fit every estimator, substitute the fitted transformers,
         prune the training branches, and return a serializable
         `FittedPipeline` (Pipeline.scala:38-65)."""
+        from .fusion_rule import FusedChainOperator
+
         plan = PipelineEnv.get().get_optimizer().execute(self.graph)
         g, prefixes = plan
         fit_exec = GraphExecutor(g, plan=plan)
         for node in sorted(g.operators, key=lambda n: n.id):
-            if isinstance(g.get_operator(node), DelegatingOperator):
+            op = g.get_operator(node)
+            if isinstance(op, DelegatingOperator):
                 deps = g.get_dependencies(node)
                 est_dep = deps[0]
                 fitted = fit_exec.execute(est_dep).get  # forces the fit NOW
@@ -257,6 +260,21 @@ class Pipeline(Chainable):
                         f"estimator produced {type(fitted).__name__}, expected a Transformer"
                     )
                 g = g.set_operator(node, fitted).set_dependencies(node, deps[1:])
+            elif isinstance(op, FusedChainOperator):
+                # a fused chain crossing estimator apply boundaries:
+                # force each estimator dependency, bake the fitted
+                # transformers into the chain, keep only the data dep
+                deps = g.get_dependencies(node)
+                fitted_ops = []
+                for est_dep in deps[:-1]:
+                    fitted = fit_exec.execute(est_dep).get
+                    if not isinstance(fitted, TransformerOperator):
+                        raise TypeError(
+                            f"estimator produced {type(fitted).__name__}, "
+                            "expected a Transformer")
+                    fitted_ops.append(fitted)
+                g = g.set_operator(node, op.materialize(fitted_ops))
+                g = g.set_dependencies(node, deps[-1:])
         from .optimizer import UnusedBranchRemovalRule
 
         g, _ = UnusedBranchRemovalRule().apply((g, {}))

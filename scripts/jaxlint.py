@@ -28,6 +28,16 @@ system cannot (see ANALYSIS.md for the full catalog):
          histograms) must use ``time.perf_counter()``. Genuine
          wall-clock timestamps (trace epoch anchors, file-mtime
          comparisons) suppress with the standard comment.
+  KJ005  blocking-host-pull (under ``workflow/`` and ``nodes/``): a
+         ``.block_until_ready()`` call, or ``np.asarray(...)`` over a
+         device value (a ``jnp.*`` call result, or a dataset payload
+         attribute ``.array``/``.data``), in a hot path. Both serialize
+         the async dispatch queue — `block_until_ready` is additionally
+         a NO-OP through the axon tunnel, so it doesn't even fence
+         honestly. Pulls that must happen route through
+         ``data.dataset.sync_pull`` (one-element transfer) or
+         ``Dataset.sync()``; sanctioned drains (the overlap engine's
+         in-order result pulls) carry the suppression comment.
 
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
@@ -53,6 +63,8 @@ RULES = {
              "donate_argnums",
     "KJ004": "time.time() used where a duration is measured (use "
              "time.perf_counter())",
+    "KJ005": "blocking host pull on a device value in a hot path "
+             "(route through data.dataset.sync_pull / Dataset.sync)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -222,6 +234,50 @@ def _check_wall_clock_duration(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "must use time.perf_counter()")
 
 
+#: dataset-payload attribute names whose np.asarray() is a device pull.
+_DEVICE_PAYLOAD_ATTRS = {"array", "data"}
+
+
+def _check_blocking_host_pull(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ005: `.block_until_ready()` anywhere (it serializes dispatch
+    and is a no-op through the axon tunnel), and `np.asarray(...)` whose
+    argument is provably device-resident — a direct ``jnp.*`` call
+    result or a dataset payload attribute (``.array`` / ``.data``).
+    Heuristic by design: a plain ``np.asarray(x)`` over host items stays
+    legal, while the two patterns that reliably mean "pull a device
+    value mid-pipeline" are flagged."""
+
+    def _device_arg(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                    and _attr_root(sub.func) in _JNP_NAMES:
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in _DEVICE_PAYLOAD_ATTRS \
+                    and isinstance(sub.ctx, ast.Load):
+                return True
+        return False
+
+    for sub in ast.walk(tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+            yield Finding(
+                path, sub.lineno, "KJ005",
+                "block_until_ready() serializes async dispatch and is a "
+                "no-op through the axon tunnel; fence with "
+                "data.dataset.sync_pull / Dataset.sync() instead")
+        elif isinstance(func, ast.Attribute) and func.attr == "asarray" \
+                and _attr_root(func) in _NUMPY_NAMES and sub.args \
+                and _device_arg(sub.args[0]):
+            yield Finding(
+                path, sub.lineno, "KJ005",
+                "np.asarray over a device value blocks the dispatch "
+                "queue mid-pipeline; pull through data.dataset.sync_pull "
+                "or defer to the overlap engine's in-order drain")
+
+
 def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
     for fn in ast.walk(tree):
         if not isinstance(fn, ast.FunctionDef):
@@ -252,10 +308,13 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
     findings: List[Finding] = []
     findings.extend(_check_numpy_in_jit(tree, rel))
     findings.extend(_check_wall_clock_duration(tree, rel))
-    if "nodes/" in rel.replace("\\", "/") + "/":
+    posix = rel.replace("\\", "/") + "/"
+    if "nodes/" in posix:
         findings.extend(_check_loop_accumulation(tree, rel))
-    if "nodes/learning" in rel.replace("\\", "/"):
+    if "nodes/learning" in posix:
         findings.extend(_check_missing_donate(tree, rel))
+    if "workflow/" in posix or "nodes/" in posix:
+        findings.extend(_check_blocking_host_pull(tree, rel))
 
     # nested loops make ast.walk revisit inner statements: keep one
     # finding per (line, rule)

@@ -46,4 +46,35 @@ print(f"telemetry smoke: {len(events)} events OK")
 PY
 JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
 
+echo "== dispatch smoke (example pipeline under the concurrent scheduler) =="
+DISPATCH_TRACE="$(mktemp /tmp/keystone_dispatch_smoke.XXXXXX.json)"
+trap 'rm -f "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
+JAX_PLATFORMS=cpu KEYSTONE_TRACE="$DISPATCH_TRACE" KEYSTONE_CONCURRENT_DISPATCH=1 \
+python - <<'PY'
+# One example pipeline (the dispatch-bench MnistRandomFFT instance) run
+# end-to-end under the concurrent DAG scheduler with tracing armed: the
+# trace must parse and the run must have executed (and counted) real
+# XLA programs through dispatch.programs_executed.
+import json, os
+from keystone_tpu.dispatch_bench import measure_example
+
+res = measure_example("MnistRandomFFT", "optimized")
+assert res["fit_run_programs"] > 0 and res["apply_run_programs"] > 0, res
+
+import keystone_tpu.telemetry.spans as spans
+from keystone_tpu.telemetry.export import write_trace
+tracer = spans.current_tracer()
+assert tracer is not None, "KEYSTONE_TRACE did not arm the ambient tracer"
+write_trace(tracer, os.environ["KEYSTONE_TRACE"])
+
+trace = json.load(open(os.environ["KEYSTONE_TRACE"]))
+assert trace["traceEvents"], "empty traceEvents"
+programs = (trace["keystone"]["metrics"]["counters"]
+            .get("dispatch.programs_executed", {}).get("value", 0))
+assert programs > 0, "programs_executed not counted"
+print(f"dispatch smoke: {int(programs)} program(s), "
+      f"{res['apply_run_programs']} on the apply run OK")
+PY
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
+
 echo "lint: OK"

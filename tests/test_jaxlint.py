@@ -62,6 +62,53 @@ def test_seeded_violations_are_caught(tmp_path):
     assert rules == ["KJ001", "KJ002", "KJ003"]
 
 
+def test_kj005_flags_blocking_host_pulls(tmp_path):
+    """KJ005: block_until_ready and np.asarray-over-device-values in
+    workflow/ and nodes/ hot paths are flagged; a plain np.asarray over
+    host items is not."""
+    jl = _jaxlint()
+    bad = tmp_path / "workflow" / "bad_pull.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def force(data, x):\n"
+        "    jax.block_until_ready(x)\n"                      # KJ005
+        "    a = np.asarray(jnp.take(x, 0))\n"                # KJ005
+        "    b = np.asarray(data.array)\n"                    # KJ005
+        "    c = np.asarray([1, 2, 3])\n"                     # host: ok
+        "    return a, b, c\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ005", "KJ005", "KJ005"]
+    assert sorted(f.line for f in findings) == [7, 8, 9]
+
+    # outside workflow/ and nodes/, the rule does not apply
+    elsewhere = tmp_path / "loaders" / "ok_pull.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj005_suppression(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "nodes" / "sanctioned.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def drain(x):\n"
+        "    return np.asarray(jnp.ravel(x))  "
+        "# keystone: ignore[KJ005]\n"
+    )
+    assert jl.lint_file(f) == []
+
+
 def test_suppression_comment_honored(tmp_path):
     jl = _jaxlint()
     f = tmp_path / "nodes" / "ok.py"

@@ -1,0 +1,476 @@
+"""Concurrent DAG scheduler + expanded-fusion correctness suite.
+
+Covers the dispatch-bounded execution contract:
+  - deterministic results across worker counts 1/2/4 (and vs serial);
+  - exception propagation identical to the serial recursive force;
+  - memo/prefix single-force guarantee under concurrency;
+  - overlap-engine streaming still active inside fused chains;
+  - fusion never crosses a fan-out; chain discovery is insensitive to
+    node-id iteration order;
+  - the acceptance gate: ≥2× programs-per-run reduction on at least two
+    example pipelines, outputs allclose-identical to the serial unfused
+    path (keystone_tpu.dispatch_bench, the `dispatch_count` bench tier);
+  - the nodes/stats chunkable audit (every elementwise stats transformer
+    declares it).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from keystone_tpu import Dataset, HostDataset, Pipeline, PipelineEnv, Transformer
+from keystone_tpu.telemetry import counter
+from keystone_tpu.workflow import Estimator
+from keystone_tpu.workflow.env import dispatch_override, overlap_override
+from keystone_tpu.workflow.optimizer import DefaultOptimizer
+
+
+# --------------------------------------------------------------------------
+# determinism across worker counts
+
+
+def _gather_pipeline(width=4):
+    branches = [
+        Transformer.from_function((lambda k: lambda x: x * (k + 1.0))(i),
+                                  name=f"scale{i}")
+        for i in range(width)
+    ]
+    from keystone_tpu.nodes.util import VectorCombiner
+
+    return Pipeline.gather(branches) >> VectorCombiner()
+
+
+def test_deterministic_across_worker_counts():
+    ds = Dataset.from_numpy(
+        np.arange(32, dtype=np.float32).reshape(8, 4))
+    pipe = _gather_pipeline()
+    with dispatch_override(False):
+        reference = pipe(ds).get().numpy()
+    for workers in (1, 2, 4):
+        PipelineEnv.reset()
+        with dispatch_override(True, workers=workers):
+            out = pipe(ds).get().numpy()
+        np.testing.assert_array_equal(out, reference)
+
+
+def test_scheduler_actually_ran():
+    PipelineEnv.reset()
+    ds = Dataset.from_numpy(np.ones((8, 4), np.float32))
+    runs = counter("dispatch.scheduler_runs")
+    before = runs.value
+    with dispatch_override(True, workers=4):
+        _gather_pipeline()(ds).get()
+    assert runs.value > before
+
+
+# --------------------------------------------------------------------------
+# exception propagation
+
+
+class _Boom(Transformer):
+    def apply(self, x):
+        raise RuntimeError("boom at force time")
+
+
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_exception_propagation_matches_serial(workers):
+    ds = Dataset.from_numpy(np.ones((8, 4), np.float32))
+    from keystone_tpu.nodes.util import VectorCombiner
+
+    pipe = Pipeline.gather([
+        Transformer.from_function(lambda x: x, name="ok"),
+        _Boom().to_pipeline(),
+    ]) >> VectorCombiner()
+
+    with dispatch_override(False):
+        with pytest.raises(RuntimeError, match="boom at force time"):
+            pipe(ds).get()
+
+    PipelineEnv.reset()
+    with dispatch_override(True, workers=workers):
+        res = pipe(ds)
+        with pytest.raises(RuntimeError, match="boom at force time"):
+            res.get()
+        # retry semantics identical to serial: the failing expression
+        # stays unforced, a second force re-raises
+        with pytest.raises(RuntimeError, match="boom at force time"):
+            res.get()
+
+
+# --------------------------------------------------------------------------
+# memo/prefix single-force guarantee under concurrency
+
+
+class _CountingEstimator(Estimator):
+    def __init__(self):
+        self.fits = 0
+        self._lock = threading.Lock()
+
+    def fit(self, data):
+        with self._lock:
+            self.fits += 1
+        mu = float(np.mean(data.numpy()))
+        return Transformer.from_function(lambda x: x - mu, name="center")
+
+
+def test_single_force_and_fit_once_under_concurrency():
+    """A CSE-shared featurize node with two consumers is forced exactly
+    once; re-applying the pipeline never refits (prefix reuse), all with
+    the worker pool on."""
+    forces = []
+    lock = threading.Lock()
+
+    shared = Transformer.from_function(lambda x: x * 2.0, name="shared")
+    orig_batch = shared.batch_transform
+
+    def counting_batch(inputs):
+        with lock:
+            forces.append(threading.get_ident())
+        return orig_batch(inputs)
+
+    shared.batch_transform = counting_batch
+
+    est = _CountingEstimator()
+    train = Dataset.from_numpy(np.ones((8, 2), np.float32))
+    # both gather branches route through the SAME transformer instance:
+    # CSE merges them into one node with two consumers
+    from keystone_tpu.nodes.util import VectorCombiner
+
+    featurize = Pipeline.gather([
+        shared.to_pipeline() >> Transformer.from_function(
+            lambda x: x + 1.0, name="a"),
+        shared.to_pipeline() >> Transformer.from_function(
+            lambda x: x + 2.0, name="b"),
+    ]) >> VectorCombiner()
+    pipe = featurize.and_then(est, train)
+
+    with dispatch_override(True, workers=4):
+        out1 = pipe(train).get().numpy()
+        assert len(forces) == 1, "shared node forced more than once"
+        assert est.fits == 1
+        out2 = pipe(train).get().numpy()  # fresh executor, same prefixes
+    assert est.fits == 1, "prefix reuse failed: estimator refit"
+    np.testing.assert_array_equal(out1, out2)
+
+
+# --------------------------------------------------------------------------
+# overlap streaming stays active inside fused chains
+
+
+class _ChunkProducer(Transformer):
+    """A bucketed host-batch stage that yields per-chunk results (the
+    SIFT/grid-descriptor pattern)."""
+
+    def apply(self, x):
+        return np.asarray(x, np.float32) * 2.0
+
+    def apply_batch_stream(self, data):
+        from keystone_tpu.utils import batching
+
+        return batching.map_host_batched_stream(
+            data.items, lambda xb: np.asarray(xb) * 2.0, chunk=2)
+
+
+def test_streaming_flows_through_fused_chain():
+    """NormalizeRows >> SignedHellingerMapper fuses into one
+    FusedBatchTransformer; fed by a chunk-producing host stage it must
+    keep yielding multiple index-carrying chunks (no silent
+    materialization at the fusion boundary), with values identical to
+    the serial unfused path."""
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+
+    rng = np.random.default_rng(0)
+    items = [rng.normal(size=(6,)).astype(np.float32) for _ in range(8)]
+    pipe = (_ChunkProducer().to_pipeline()
+            >> NormalizeRows() >> SignedHellingerMapper())
+
+    with overlap_override(False):
+        PipelineEnv.get().set_optimizer(DefaultOptimizer(fuse=False))
+        serial = pipe(HostDataset(items)).get()
+    PipelineEnv.reset()
+
+    with overlap_override(True, prefetch_depth=1), \
+            dispatch_override(True, workers=4):
+        res = pipe(HostDataset(items))
+        # the optimized plan fused the two elementwise stages
+        fused_labels = [
+            op.label for op in res.executor.optimized_graph.operators.values()
+            if op.label.startswith("Fused[")
+        ]
+        assert any("NormalizeRows" in l and "SignedHellingerMapper" in l
+                   for l in fused_labels), fused_labels
+        seen = {}
+        n_chunks = 0
+        for idxs, payload in res.stream():
+            assert idxs is not None, "stream materialized at the fused stage"
+            n_chunks += 1
+            for i, item in zip(idxs, payload):
+                seen[i] = item
+        assert n_chunks >= 2, "producer chunks were collapsed"
+    for i in range(len(items)):
+        np.testing.assert_allclose(
+            np.asarray(serial.items[i]), np.asarray(seen[i]), rtol=1e-5)
+
+
+def test_fused_batch_transformer_chunkable_property():
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+    from keystone_tpu.nodes.util.basic import Densify
+    from keystone_tpu.nodes.util.fusion import FusedBatchTransformer
+
+    assert FusedBatchTransformer(
+        [NormalizeRows(), SignedHellingerMapper()]).chunkable
+    assert not FusedBatchTransformer(
+        [NormalizeRows(), Densify()]).chunkable
+
+
+# --------------------------------------------------------------------------
+# fusion rule regressions
+
+
+def _fusable_fn(name):
+    class _F(Transformer):
+        fusable = True
+
+        def __init__(self):
+            self._name = name
+
+        @property
+        def label(self):
+            return self._name
+
+        def apply(self, x):
+            return x + 1.0
+
+    return _F()
+
+
+def test_fusion_never_crosses_fanout():
+    """A node with two children terminates the chain: [A, B] fuses, the
+    two fan-out consumers C and D stay separate."""
+    from keystone_tpu.workflow.fusion_rule import NodeFusionRule
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    g = Graph()
+    g, data = g.add_node(
+        DatasetOperator(Dataset.from_numpy(np.ones((4, 2), np.float32))), [])
+    g, a = g.add_node(_fusable_fn("A"), [data])
+    g, b = g.add_node(_fusable_fn("B"), [a])
+    g, c = g.add_node(_fusable_fn("C"), [b])
+    g, d = g.add_node(_fusable_fn("D"), [b])
+    g, _ = g.add_sink(c)
+    g, _ = g.add_sink(d)
+
+    g2, _ = NodeFusionRule().apply((g, {}))
+    labels = sorted(op.label for op in g2.operators.values()
+                    if not op.label.startswith("Dataset"))
+    assert labels == ["C", "D", "Fused[A >> B]"], labels
+
+
+def test_chain_discovery_insensitive_to_id_order():
+    """The same logical chain built with ascending and with descending
+    node ids must fuse identically (discovery walks to the head from any
+    member)."""
+    from keystone_tpu.workflow.fusion_rule import NodeFusionRule
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    ds = Dataset.from_numpy(np.ones((4, 2), np.float32))
+
+    def fused_labels(g):
+        g2, _ = NodeFusionRule().apply((g, {}))
+        return sorted(op.label for op in g2.operators.values()
+                      if op.label.startswith("Fused["))
+
+    # ascending ids along the chain
+    g = Graph()
+    g, data = g.add_node(DatasetOperator(ds), [])
+    g, a = g.add_node(_fusable_fn("A"), [data])
+    g, b = g.add_node(_fusable_fn("B"), [a])
+    g, c = g.add_node(_fusable_fn("C"), [b])
+    g, _ = g.add_sink(c)
+    forward = fused_labels(g)
+
+    # descending ids: C gets the smallest node id, A the largest
+    g = Graph()
+    g, data = g.add_node(DatasetOperator(ds), [])
+    g, c = g.add_node(_fusable_fn("C"), [data])  # deps fixed up below
+    g, b = g.add_node(_fusable_fn("B"), [data])
+    g, a = g.add_node(_fusable_fn("A"), [data])
+    g = g.set_dependencies(b, [a]).set_dependencies(c, [b])
+    g, _ = g.add_sink(c)
+    reverse = fused_labels(g)
+
+    assert forward == reverse == ["Fused[A >> B >> C]"]
+
+
+def test_fused_chain_fit_produces_clean_fitted_pipeline():
+    """Pipeline.fit() resolves FusedChainOperator nodes: the fitted
+    pipeline carries the baked fused transformer, applies identically to
+    the unfitted pipeline, and contains no estimator machinery."""
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+    from keystone_tpu.nodes.stats import StandardScaler
+    from keystone_tpu.nodes.util import MaxClassifier
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(16, 5)).astype(np.float32)
+    Y = (2.0 * np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)] - 1.0)
+    train = Dataset.from_numpy(X)
+
+    pipe = (Transformer.from_function(lambda x: x * 1.0, name="ident")
+            .to_pipeline()
+            .and_then(StandardScaler(), train)
+            .and_then(LinearMapEstimator(0.1), train, Dataset.from_numpy(Y))
+            >> MaxClassifier())
+    lazy = pipe(train).get().numpy()
+    fitted = pipe.fit()
+    out = fitted(train).numpy()
+    np.testing.assert_array_equal(lazy, out)
+
+
+# --------------------------------------------------------------------------
+# acceptance gate: programs-per-run reduction + output identity
+
+
+@pytest.mark.parametrize("example", ["RandomPatchCifar", "MnistRandomFFT"])
+def test_dispatch_reduction_at_least_2x(example):
+    """dispatch.programs_executed for the example's apply run drops ≥2×
+    under the new optimizer plan vs the PR-3 baseline plan (and a
+    fortiori vs the serial unfused path), with outputs
+    allclose-identical to the serial unfused path (ISSUE 4 acceptance;
+    the bench's `dispatch_count` tier records the same numbers)."""
+    from keystone_tpu.dispatch_bench import measure_example
+
+    base = measure_example(example, "serial_unfused")
+    legacy = measure_example(example, "legacy")
+    opt = measure_example(example, "optimized")
+    assert opt["apply_run_programs"] > 0
+    for name, ref in (("serial unfused", base), ("PR-3 legacy", legacy)):
+        ratio = ref["apply_run_programs"] / opt["apply_run_programs"]
+        assert ratio >= 2.0, (
+            f"{example} vs {name}: {ref['apply_run_programs']} -> "
+            f"{opt['apply_run_programs']} programs ({ratio:.2f}x)")
+    # fit run must shrink too, never grow
+    assert opt["fit_run_programs"] <= base["fit_run_programs"]
+    np.testing.assert_allclose(
+        opt["train_pred"], base["train_pred"], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        opt["test_pred"], base["test_pred"], rtol=1e-5, atol=1e-5)
+
+
+def test_fused_chain_masks_padded_rows():
+    """Padded-row regression (review finding): a fused chain containing
+    a masking stage (StandardScalerModel) feeding a mask-less
+    normal-equations fit must produce the same model as the unfused
+    path when count is NOT a multiple of the device count (43 on the
+    8-device mesh → 5 padded rows)."""
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+    from keystone_tpu.nodes.stats import NormalizeRows, StandardScaler
+    from keystone_tpu.nodes.util import ClassLabelIndicatorsFromInt
+
+    rng = np.random.default_rng(7)
+    n, d, k = 43, 6, 3
+    X = np.abs(rng.normal(size=(n, d))).astype(np.float32) + 1.0
+    y = rng.integers(0, k, n).astype(np.int32)
+
+    def run(fuse):
+        PipelineEnv.reset()
+        PipelineEnv.get().set_optimizer(DefaultOptimizer(fuse=fuse))
+        train = Dataset.from_numpy(X)
+        labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+        pipe = (NormalizeRows().to_pipeline()
+                .and_then(StandardScaler(), train)
+                .and_then(LinearMapEstimator(0.1), train, labels))
+        out = pipe(train).get().numpy()
+        PipelineEnv.reset()
+        return out
+
+    with overlap_override(False), dispatch_override(False):
+        reference = run(fuse=False)
+    fused = run(fuse=True)
+    np.testing.assert_allclose(fused, reference, rtol=1e-5, atol=1e-6)
+
+
+def test_gather_diamond_fuses_to_one_program():
+    """The MnistRandomFFT-shaped gather diamond collapses: branches +
+    zip + VectorCombiner become one Gather[...] program, values
+    identical to the unfused path (including a padded count)."""
+    from keystone_tpu.nodes.stats import LinearRectifier, RandomSignNode
+    from keystone_tpu.nodes.util import VectorCombiner
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(21, 8)).astype(np.float32)  # 21: padded to 24
+    pipe = Pipeline.gather([
+        RandomSignNode(8, seed=i).to_pipeline() >> LinearRectifier(0.0)
+        for i in range(3)
+    ]) >> VectorCombiner()
+
+    with overlap_override(False), dispatch_override(False):
+        PipelineEnv.get().set_optimizer(DefaultOptimizer(fuse=False))
+        reference = pipe(Dataset.from_numpy(X)).get().numpy()
+    PipelineEnv.reset()
+    res = pipe(Dataset.from_numpy(X))
+    labels = [op.label
+              for op in res.executor.optimized_graph.operators.values()]
+    assert any("Gather[" in l for l in labels), labels
+    np.testing.assert_allclose(res.get().numpy(), reference, rtol=1e-6)
+
+
+def test_legacy_plan_matches_serial_outputs():
+    """The PR-3-shaped legacy plan (fuse_apply=False) remains available
+    and numerically identical — the bench tier's middle column."""
+    from keystone_tpu.dispatch_bench import measure_example
+
+    base = measure_example("RandomPatchCifar", "serial_unfused")
+    legacy = measure_example("RandomPatchCifar", "legacy")
+    assert legacy["apply_run_programs"] <= base["apply_run_programs"]
+    np.testing.assert_allclose(
+        legacy["test_pred"], base["test_pred"], rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# nodes/stats chunkable audit (lint-style)
+
+
+def test_stats_transformers_declare_chunkable():
+    """Every elementwise transformer in nodes/stats/ must declare
+    ``chunkable = True`` (PR 2 found ColumnSampler missing it; this
+    pins the sweep). A new stats transformer must be classified here —
+    elementwise or whole-dataset — or this test fails."""
+    import inspect
+
+    from keystone_tpu.nodes import stats as stats_pkg
+    from keystone_tpu.nodes.stats import (
+        normalization, random_features, scalers)
+    from keystone_tpu.workflow.pipeline import Transformer
+
+    ELEMENTWISE = {
+        "NormalizeRows", "SignedHellingerMapper", "ColumnSampler",
+        "CosineRandomFeatures", "RandomSignNode", "PaddedFFT",
+        "LinearRectifier", "StandardScalerModel",
+    }
+    WHOLE_DATASET = {"Sampler"}  # reshapes the example axis: not chunkable
+
+    found = set()
+    for mod in (normalization, random_features, scalers):
+        for name, cls in inspect.getmembers(mod, inspect.isclass):
+            if not issubclass(cls, Transformer) or cls is Transformer:
+                continue
+            if cls.__module__ != mod.__name__:
+                continue
+            found.add(name)
+            if name in ELEMENTWISE:
+                assert getattr(cls, "chunkable", False), (
+                    f"{name} is elementwise but does not declare "
+                    "chunkable = True (KP302: streams silently "
+                    "materialize at this stage)")
+            elif name in WHOLE_DATASET:
+                assert not getattr(cls, "chunkable", False), (
+                    f"{name} reshapes the example axis; chunkable would "
+                    "be wrong")
+            else:
+                raise AssertionError(
+                    f"unclassified stats transformer {name}: add it to "
+                    "ELEMENTWISE or WHOLE_DATASET in this test")
+    assert ELEMENTWISE | WHOLE_DATASET <= found | {"ColumnSampler"}
