@@ -93,6 +93,8 @@ def run_child(args, timeout_s: float):
         cmd += ["--skip-overlap-tier"]
     if args.skip_dispatch_tier:
         cmd += ["--skip-dispatch-tier"]
+    if args.skip_compile_tier:
+        cmd += ["--skip-compile-tier"]
     if args.cifar_dir:
         cmd += ["--cifar-dir", args.cifar_dir]
     if args.train_path:
@@ -182,14 +184,15 @@ def emit(record):
 # krr_tier-ranked checkpoint holding every measured tier).
 PROGRESS_RANK = {"headline": 1, "staged": 2, "flagship": 3,
                  "featurize_tier": 4, "krr_tier": 5, "overlap_tier": 6,
-                 "dispatch_tier": 7, "complete": 8}
+                 "dispatch_tier": 7, "compile_tier": 8, "complete": 9}
 
 # The tier payload keys a child detail may carry. finalize_record's
 # error scan is restricted to exactly these: a future informational
 # payload that happens to contain an "error" field (e.g. a north_star
 # sub-dict) must not silently block persistence.
 TIER_KEYS = ("flagship_bcd_d8192", "flagship_featurize", "flagship_krr",
-             "featurize_overlap", "dispatch_count", "fused")
+             "featurize_overlap", "dispatch_count", "compile_count",
+             "fused")
 
 
 def progress_rank(detail) -> int:
@@ -277,6 +280,7 @@ def main():
     p.add_argument("--overlap-chunk", type=int, default=2048)
     p.add_argument("--skip-overlap-tier", action="store_true")
     p.add_argument("--skip-dispatch-tier", action="store_true")
+    p.add_argument("--skip-compile-tier", action="store_true")
     p.add_argument("--liveness-timeout", type=float, default=90.0)
     p.add_argument("--run-timeout", type=float, default=1500.0)
     p.add_argument("--phase-timeout", type=float, default=900.0,
@@ -1016,6 +1020,42 @@ def child_main(args):
             "seconds", dispatch_fn)
     detail.update({"progress": "dispatch_tier",
                    "dispatch_count": dispatch_tier})
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
+    # Compile-count tier: cold-vs-warm compiles + wall clock for the
+    # example pipelines against a fresh persistent-cache dir, plus the
+    # host ragged-tail microbench. The warm run must perform 0 cold
+    # compiles and beat the cold run end-to-end, with outputs identical
+    # at multiple AND ragged counts (ISSUE 5 acceptance).
+    def compile_fn():
+        import time as _t
+
+        from keystone_tpu.compile_bench import compile_count_report
+
+        t0 = _t.perf_counter()
+        rep = compile_count_report()
+        rep["seconds"] = round(_t.perf_counter() - t0, 2)
+        problems = []
+        if not rep["all_warm_runs_zero_compiles"]:
+            problems.append("a warm run performed cold compiles")
+        if not rep["all_warm_beats_cold"]:
+            problems.append("a warm run did not beat the cold wall clock")
+        if not rep["all_apply_compiles_bounded"]:
+            problems.append("apply-run compiles exceed plan programs")
+        if not rep["host_tail_padding_saves_programs"]:
+            problems.append("chunk padding failed to remove the "
+                            "ragged-tail program")
+        if problems:
+            rep["error"] = "; ".join(problems)
+        return rep
+
+    compile_tier = None
+    if not args.skip_compile_tier:
+        compile_tier = run_tier(
+            "compile_count", "compile_tier", "compile_tier_done",
+            "seconds", compile_fn)
+    detail.update({"progress": "compile_tier",
+                   "compile_count": compile_tier})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     # Fused tier LAST: the SAME training run as one XLA program (the

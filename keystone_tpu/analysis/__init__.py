@@ -35,7 +35,12 @@ from .diagnostics import (
     ValidationReport,
 )
 from .hazards import hazard_pass
-from .memory import DEFAULT_CHUNK_ROWS, MemoryEstimate, memory_pass
+from .memory import (
+    DEFAULT_CHUNK_ROWS,
+    MemoryEstimate,
+    memory_pass,
+    resolve_chunk_rows,
+)
 from .propagate import spec_pass, structural_pass, toposort
 from .specs import (
     UNKNOWN,
@@ -59,7 +64,7 @@ def validate_graph(
     level: str = "full",
     ignore: Iterable[str] = (),
     hbm_budget_bytes: Optional[int] = None,
-    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    chunk_rows: Optional[int] = None,
 ) -> ValidationReport:
     """Run the analyzer tiers up to ``level`` over a lowered graph.
 
@@ -120,6 +125,7 @@ __all__ = [
     "element_nbytes",
     "hazard_pass",
     "memory_pass",
+    "resolve_chunk_rows",
     "shape_struct",
     "spec_of",
     "spec_pass",

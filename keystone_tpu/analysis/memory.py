@@ -25,9 +25,24 @@ from .diagnostics import Diagnostic, Severity
 from .propagate import _label, toposort
 from .specs import DataSpec, element_nbytes, is_known
 
-#: Default chunk row-count assumed for streaming stages — matches
-#: `utils.batching.map_host_batched`'s default ``chunk=256``.
+#: Historical default chunk row-count, kept as the documented fallback
+#: for callers that pin an explicit number. The LIVE default is
+#: `ExecutionConfig.chunk_size` (env ``KEYSTONE_CHUNK_SIZE``) — the same
+#: knob `utils.batching.map_host_batched` dispatches with, resolved per
+#: pass by `resolve_chunk_rows`, so this model can never assume a chunk
+#: the runtime doesn't execute.
 DEFAULT_CHUNK_ROWS = 256
+
+
+def resolve_chunk_rows(chunk_rows: Optional[int]) -> int:
+    """An explicit ``chunk_rows`` wins; None reads the execution
+    config's ``chunk_size`` — one number for the runtime dispatcher and
+    the static memory model."""
+    if chunk_rows is not None:
+        return chunk_rows
+    from ..workflow.env import execution_config
+
+    return execution_config().chunk_size
 
 
 def _fmt_bytes(n: Optional[int]) -> str:
@@ -75,13 +90,14 @@ def memory_pass(
     specs: Dict[GraphId, Any],
     *,
     hbm_budget_bytes: Optional[int] = None,
-    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    chunk_rows: Optional[int] = None,
     prefetch_depth: Optional[int] = None,
     overlap: Optional[bool] = None,
 ) -> Tuple[MemoryEstimate, List[Diagnostic]]:
     from ..workflow.env import execution_config
 
     cfg = execution_config()
+    chunk_rows = resolve_chunk_rows(chunk_rows)
     if prefetch_depth is None:
         prefetch_depth = cfg.prefetch_depth
     if overlap is None:

@@ -41,6 +41,21 @@ def _pad_to(x, target: int):
     return jnp.pad(x, pad_widths)
 
 
+def leaf_sharding(mesh, shape) -> NamedSharding:
+    """The sharding `Dataset` placement assigns a leaf of this shape:
+    2-D (n, d) leaves shard their feature axis over 'model' when the
+    mesh has one (the VectorSplitter analog), everything else is
+    data-sharded on the leading axis. One function, used both by
+    `Dataset.__init__`'s placement and by AOT plan warmup
+    (`FusedBatchTransformer.warmup`) — the compiled-ahead executable
+    must be lowered with exactly the shardings the runtime will pass."""
+    if len(shape) == 2:
+        feat = meshlib.feature_sharding(mesh, shape[1])
+        if feat is not None:
+            return feat
+    return NamedSharding(mesh, P(meshlib.DATA_AXIS))
+
+
 def sync_pull(leaf) -> None:
     """THE scalar-pull sync idiom, in one place: transfer one element of
     a (device) array to host. `jax.block_until_ready` does not actually
@@ -85,17 +100,11 @@ class Dataset:
             # their feature axis over 'model' — the library-level analog
             # of the reference's VectorSplitter feature blocking. Other
             # ranks (images, label vectors of odd widths) stay data-only
-            # and replicate over the model axis.
-            row_sh = NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
-
-            def place(x):
-                feat_sh = (
-                    meshlib.feature_sharding(self.mesh, x.shape[1])
-                    if x.ndim == 2 else None
-                )
-                return jax.device_put(x, feat_sh if feat_sh is not None else row_sh)
-
-            self.data = jax.tree_util.tree_map(place, data)
+            # and replicate over the model axis (see `leaf_sharding`).
+            self.data = jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    x, leaf_sharding(self.mesh, x.shape)),
+                data)
 
     # ------------------------------------------------------------- factories
 
@@ -127,10 +136,19 @@ class Dataset:
     @property
     def mask(self):
         """Boolean validity mask over the padded leading axis (cached:
-        eager re-dispatch per access costs a device round trip)."""
+        eager re-dispatch per access costs a device round trip). Placed
+        with the same leading-axis sharding as the data so programs
+        consuming (data, mask) compile against ONE deterministic input
+        layout — what AOT warmup lowers against."""
         m = self.__dict__.get("_mask_cache")
         if m is None:
             m = jnp.arange(self.padded_count) < self.count
+            sh = NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
+            if sh.is_fully_addressable:
+                # multi-host meshes keep the uncommitted mask (a host
+                # array can't device_put to a cross-process sharding);
+                # AOT-warmed programs just fall back to the jit path
+                m = jax.device_put(m, sh)
             self.__dict__["_mask_cache"] = m
         return m
 

@@ -197,7 +197,8 @@ class LinearChainCRFTagger:
             return jnp.concatenate(
                 [first[None], rest], axis=0).swapaxes(0, 1)  # (B, L)
 
-        fn = jax.jit(decode)
+        # memoized per pad-len bucket below: one compile per bucket
+        fn = jax.jit(decode)  # keystone: ignore[KJ006]
         self._decoders[pad_len] = fn
         return fn
 

@@ -16,6 +16,7 @@ recover it with scan-over-chunks inside `shard_map`.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -214,6 +215,38 @@ def _stage_fuse(stage: Transformer):
 # recompiles the featurizer.
 _PROGRAM_CACHE: dict = {}
 
+# key -> Future of an in-flight AOT warmup compile (`warmup`), so a
+# force that arrives mid-warmup waits for THAT compile instead of
+# racing a duplicate one. Entries are removed when the future resolves.
+_WARMUP_PENDING: dict = {}
+_WARMUP_LOCK = threading.Lock()
+
+
+class _AotProgram:
+    """A program cache entry carrying both the jit wrapper and an
+    ahead-of-time compiled executable for the warmed-up input avals.
+    Calls dispatch straight into the compiled executable; if the live
+    arguments disagree with the warmed avals (sharding drift, an
+    unexpected layout) the entry degrades permanently to the jit path —
+    correct either way, and with the persistent compilation cache on the
+    jit path still retrieves the warmup's executable warm instead of
+    recompiling."""
+
+    __slots__ = ("_jitted", "_compiled")
+
+    def __init__(self, jitted, compiled):
+        self._jitted = jitted
+        self._compiled = compiled
+
+    def __call__(self, flat, xs, ms):
+        compiled = self._compiled
+        if compiled is not None:
+            try:
+                return compiled(flat, xs, ms)
+            except Exception:
+                self._compiled = None
+        return self._jitted(flat, xs, ms)
+
 
 def _contains_opaque(key) -> bool:
     """True when a (possibly nested — composed FusedChain keys) static
@@ -313,6 +346,40 @@ class FusedBatchTransformer(Transformer):
 
         return (("FusedChain",) + statics, params, fn, _MASK_AWARE)
 
+    def _decompose(self):
+        """The chain's fused decomposition plus the flattened params:
+        (statics, flat_params, treedef, fns). Shared by `apply_batch`
+        and `warmup` so both derive the SAME program cache key."""
+        fused = [_stage_fuse(s) for s in _peephole(self.stages)]
+        statics = tuple(f[0] for f in fused)
+        params = tuple(f[1] for f in fused)
+        fns = tuple(f[2] for f in fused)
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        return statics, flat, treedef, fns
+
+    def _program_key(self, statics, flat, treedef, array_shape, dtype_name,
+                     padded_count, n_shards, mesh):
+        return (
+            statics,
+            treedef,
+            tuple((tuple(p.shape), jnp.asarray(p).dtype.name) for p in flat),
+            tuple(array_shape),
+            dtype_name,
+            padded_count,
+            n_shards,
+            min(self.microbatch, padded_count // n_shards),
+            mesh,
+        )
+
+    def _program_cache(self, statics):
+        """Opaque stages are keyed on object identity: caching those
+        globally would pin the stage (and its captured arrays) forever
+        and make the id-keyed entry unsafe after GC reuses the id. Keep
+        such programs on THIS instance instead."""
+        if _contains_opaque(statics):
+            return self.__dict__.setdefault("_instance_programs", {})
+        return _PROGRAM_CACHE
+
     def apply_batch(self, data):
         if not isinstance(data, Dataset):
             # host/object datasets: run the stages' own batch paths
@@ -320,45 +387,101 @@ class FusedBatchTransformer(Transformer):
                 data = s.apply_batch(data)
             return data
 
-        fused = [_stage_fuse(s) for s in _peephole(self.stages)]
-        statics = tuple(f[0] for f in fused)
-        params = tuple(f[1] for f in fused)
-        fns = tuple(f[2] for f in fused)
-        flat, treedef = jax.tree_util.tree_flatten(params)
-        key = (
-            statics,
-            treedef,
-            tuple((tuple(p.shape), jnp.asarray(p).dtype.name) for p in flat),
-            tuple(data.array.shape),
-            data.array.dtype.name,
-            data.padded_count,
-            data.n_shards,
-            min(self.microbatch, data.padded_count // data.n_shards),
-            data.mesh,
-        )
-        # Opaque stages are keyed on object identity: caching those
-        # globally would pin the stage (and its captured arrays) forever
-        # and make the id-keyed entry unsafe after GC reuses the id. Keep
-        # such programs on THIS instance instead.
-        opaque = _contains_opaque(statics)
-        cache = (
-            self.__dict__.setdefault("_instance_programs", {})
-            if opaque
-            else _PROGRAM_CACHE
-        )
+        statics, flat, treedef, fns = self._decompose()
+        key = self._program_key(
+            statics, flat, treedef, data.array.shape, data.array.dtype.name,
+            data.padded_count, data.n_shards, data.mesh)
+        cache = self._program_cache(statics)
         program = cache.get(key)
         if program is None:
-            program = self._build_program(data, treedef, fns)
+            # an in-flight AOT warmup for this very program? Wait for it
+            # instead of compiling the same thing twice concurrently.
+            with _WARMUP_LOCK:
+                pending = _WARMUP_PENDING.get(key)
+            if pending is not None:
+                try:
+                    pending.result()
+                except Exception:
+                    pass  # warmup died: compile inline as if it never ran
+                program = cache.get(key)
+        if program is None:
+            program = self._build_program(
+                data.mesh, data.n_shards, data.padded_count, treedef, fns)
             cache[key] = program
         from ...telemetry import record_dispatch
 
         record_dispatch()  # the whole chain is ONE executed program
         return data.with_data(program(flat, data.array, data.mask))
 
-    def _build_program(self, data: Dataset, treedef, fns):
-        mesh = data.mesh
-        shards = data.n_shards
-        local_n = data.padded_count // shards
+    def warmup(self, element, count: int, mesh=None) -> Optional[str]:
+        """AOT-compile this chain's batch program from a static spec —
+        no data touched. ``element`` is the per-item
+        `jax.ShapeDtypeStruct` the analyzer propagated; ``count`` the
+        dataset's example count. Lowers with the exact input avals and
+        shardings `apply_batch` will pass (Dataset leaf placement + the
+        row-sharded mask) and installs an `_AotProgram` under the same
+        cache key, so the first force dispatches into a warm executable.
+        With the persistent compilation cache armed the compile also
+        lands on disk, warming every later process. Returns "cached" /
+        "compiled" / None (spec not warmable — pytree elements, unknown
+        shapes)."""
+        if not (hasattr(element, "shape") and hasattr(element, "dtype")):
+            return None
+        mesh = mesh or meshlib.current_mesh()
+        shards = mesh.shape.get(meshlib.DATA_AXIS, 1)
+        count = int(count)
+        if count <= 0:
+            return None
+        padded = -(-count // shards) * shards
+        array_shape = (padded,) + tuple(element.shape)
+        dtype = jnp.dtype(element.dtype)
+        statics, flat, treedef, fns = self._decompose()
+        key = self._program_key(
+            statics, flat, treedef, array_shape, dtype.name,
+            padded, shards, mesh)
+        cache = self._program_cache(statics)
+        if key in cache:
+            return "cached"
+        with _WARMUP_LOCK:
+            if key in _WARMUP_PENDING:
+                return "cached"
+            import concurrent.futures
+
+            fut = concurrent.futures.Future()
+            _WARMUP_PENDING[key] = fut
+        try:
+            from ...data.dataset import leaf_sharding
+            from ...telemetry import span
+
+            with span("aot_warmup", cat="compile", label=self.label,
+                      rows=padded):
+                jitted = self._build_program(mesh, shards, padded,
+                                             treedef, fns)
+                xs_aval = jax.ShapeDtypeStruct(
+                    array_shape, dtype,
+                    sharding=leaf_sharding(mesh, array_shape))
+                ms_aval = jax.ShapeDtypeStruct(
+                    (padded,), jnp.bool_,
+                    sharding=NamedSharding(mesh, P(meshlib.DATA_AXIS)))
+                flat_avals = [
+                    jax.ShapeDtypeStruct(jnp.shape(p),
+                                         jnp.asarray(p).dtype)
+                    for p in flat
+                ]
+                compiled = jitted.lower(
+                    flat_avals, xs_aval, ms_aval).compile()
+                cache[key] = _AotProgram(jitted, compiled)
+            fut.set_result(key)
+            return "compiled"
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            with _WARMUP_LOCK:
+                _WARMUP_PENDING.pop(key, None)
+
+    def _build_program(self, mesh, shards, padded_count, treedef, fns):
+        local_n = padded_count // shards
         chunk = min(self.microbatch, local_n)
         n_chunks = -(-local_n // chunk)
         padded_local = n_chunks * chunk
@@ -401,4 +524,7 @@ class FusedBatchTransformer(Transformer):
                 )
         else:
             fn = per_shard
-        return jax.jit(fn)
+        # every caller stores the result in a program cache keyed on the
+        # chain's structure (_PROGRAM_CACHE / _instance_programs), so
+        # this fresh closure compiles once per key, not once per call
+        return jax.jit(fn)  # keystone: ignore[KJ006]

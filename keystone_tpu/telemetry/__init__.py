@@ -44,6 +44,7 @@ from .spans import (
 )
 from .export import (
     aggregate_spans,
+    compile_summary,
     dispatch_summary,
     load_trace,
     self_times,
@@ -52,6 +53,14 @@ from .export import (
     write_trace,
 )
 from .instrument import estimate_bytes, instrument_node_force, record_dispatch
+from .compile_events import compiles_snapshot, install_compile_listeners
+
+# Compile accounting is armed with the package: the monitoring hooks are
+# passive (they fire only inside jax's own compile path), and installing
+# here means no compile anywhere in the process escapes
+# `dispatch.programs_compiled` — the same always-on discipline as
+# `record_dispatch`.
+install_compile_listeners()
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -59,7 +68,9 @@ __all__ = [
     "SpanRecord", "Tracer", "capabilities", "current_tracer",
     "record_capability", "set_tracer", "span", "telemetry_active",
     "trace_run",
-    "aggregate_spans", "dispatch_summary", "load_trace", "self_times",
+    "aggregate_spans", "compile_summary", "dispatch_summary",
+    "load_trace", "self_times",
     "summarize", "to_chrome_trace", "write_trace",
     "estimate_bytes", "instrument_node_force", "record_dispatch",
+    "compiles_snapshot", "install_compile_listeners",
 ]

@@ -149,6 +149,30 @@ def dispatch_summary(trace: Dict[str, Any]) -> Optional[str]:
             f"{int(sched)}x over {int(tasks)} task(s))")
 
 
+def compile_summary(trace: Dict[str, Any]) -> Optional[str]:
+    """One-line compile digest from a trace's metrics snapshot: cold
+    compiles vs persistent-cache hits and their wall-clock totals, or
+    None when the trace predates compile accounting. The accounting
+    layer pre-registers its counters when the hooks install
+    (`compile_events.install_compile_listeners`), so a fully warm run's
+    "0 cold" reports instead of vanishing — that zero IS the headline
+    number. Shared by the trace CLI and `scripts/perf_table.py`."""
+    metrics = trace.get("keystone", {}).get("metrics", {})
+    counters = metrics.get("counters", {})
+    hists = metrics.get("histograms", {})
+    if ("dispatch.programs_compiled" not in counters
+            and "dispatch.compile_cache_hits" not in counters):
+        return None  # pre-accounting trace
+    cold_n = int(counters.get(
+        "dispatch.programs_compiled", {}).get("value", 0))
+    hits = int(counters.get(
+        "dispatch.compile_cache_hits", {}).get("value", 0))
+    cold_s = hists.get("compile.cold_secs", {}).get("total", 0.0)
+    warm_s = hists.get("compile.warm_secs", {}).get("total", 0.0)
+    return (f"programs compiled: {cold_n} cold ({cold_s:.3f}s) + "
+            f"{hits} cache hit(s) ({warm_s:.3f}s retrieval)")
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -198,9 +222,13 @@ def summarize(trace: Dict[str, Any], top: int = 15) -> str:
                 f"{int(wait['count'])} get(s) (max {wait['max']:.4f}s)")
     counters = ks.get("metrics", {}).get("counters", {})
     dispatch = dispatch_summary(trace)
-    if dispatch:
+    compiles = compile_summary(trace)
+    if dispatch or compiles:
         lines.append("\n== dispatch ==")
-        lines.append(dispatch)
+        if dispatch:
+            lines.append(dispatch)
+        if compiles:
+            lines.append(compiles)
     moved = counters.get("overlap.bytes_pulled", {}).get("value")
     if moved:
         lines.append(f"\nbytes pulled off device: {_fmt_bytes(moved)}")

@@ -22,6 +22,14 @@ contract documented in OBSERVABILITY.md:
                                              instrument.record_dispatch)
   dispatch.scheduler_runs / scheduled_tasks (counters; concurrent DAG
                                              scheduler activity)
+  dispatch.programs_compiled                (counter; one per COLD XLA
+                                             backend compile — see
+                                             compile_events)
+  dispatch.compile_cache_hits               (counter; persistent-cache
+                                             retrievals, i.e. warm
+                                             compiles)
+  compile.cold_secs / warm_secs             (histograms, seconds of
+                                             compile / retrieval wall)
 
 Thread-safety: one process lock guards mutation — producer threads
 (overlap engine) and the main thread share these. Updates are

@@ -32,6 +32,18 @@ on):
 Single-chunk inputs fall back to the serial path (there is nothing to
 overlap). `prefetch_iterator` is the same bounded producer-thread
 pattern over any generator, reused by the archive/CIFAR loaders.
+
+Shape-stable dispatch (`ExecutionConfig.pad_chunks`, default on): every
+distinct stacked leading dim is a distinct XLA program, so a bucket's
+ragged tail (`bucket_size % chunk`) used to compile its own program per
+residue — pure compile tax. Tails are now zero-padded up to the chunk
+size (power-of-two ladder below it, `_pad_target`), the batch fn runs at
+the padded width, and `_split_result` slices the phantom rows off before
+anything downstream sees them, so a stage executes ONE compiled program
+per bucket shape regardless of item count. Both dispatch paths share the
+stack/split helpers, so the (indices, results) chunk contract — union of
+indices == range(len(items)), no phantoms — holds identically serial and
+overlapped.
 """
 
 from __future__ import annotations
@@ -148,41 +160,80 @@ def prefetch_iterator(
 # Chunk planning (shared by the serial and overlapped paths)
 
 
+def _pad_target(n: int, chunk: Optional[int], bucket_n: int) -> int:
+    """Leading-dim a chunk of ``n`` items pads to under shape-stable
+    dispatch. A ragged tail of a bucket that fills at least one whole
+    chunk rounds up to the chunk size, so every chunk of that bucket
+    shares ONE compiled program; a bucket smaller than the chunk rounds
+    up a power-of-two ladder (1, 2, 4, ... chunk) instead, so tiny
+    buckets neither pay full-chunk padding waste nor compile one
+    program per distinct item count."""
+    if chunk is None or n == chunk:
+        return n
+    if bucket_n >= chunk:
+        return chunk
+    return min(chunk, 1 << max(0, n - 1).bit_length())
+
+
 def _plan_chunks(
-    items: Sequence, chunk: Optional[int]
-) -> List[List[int]]:
-    """Bucket item indices by shape, then split each bucket into chunks.
-    Dispatch count is Σ_buckets ceil(bucket_size / chunk), independent of
-    item count within a chunk."""
+    items: Sequence, chunk: Optional[int], pad: bool = False
+) -> List[Tuple[List[int], int]]:
+    """Bucket item indices by shape, then split each bucket into
+    ``(indices, pad_to)`` chunks. Dispatch count is
+    Σ_buckets ceil(bucket_size / chunk), independent of item count
+    within a chunk; with ``pad`` the pad target additionally makes the
+    stacked leading dim shape-stable (`_pad_target` — the bucket size
+    decides tail-of-full-bucket vs tiny-bucket-ladder, which is why the
+    target is computed here, where the bucket structure is still
+    known)."""
     buckets: dict = {}
     for i, x in enumerate(items):
         shape = x.shape if hasattr(x, "shape") else np.asarray(x).shape
         buckets.setdefault(shape, []).append(i)
-    plan: List[List[int]] = []
+    plan: List[Tuple[List[int], int]] = []
     for idxs in buckets.values():
         step = chunk or len(idxs)
         for start in range(0, len(idxs), step):
-            plan.append(idxs[start : start + step])
+            part = idxs[start : start + step]
+            pad_to = (_pad_target(len(part), chunk, len(idxs)) if pad
+                      else len(part))
+            plan.append((part, pad_to))
     return plan
 
 
-def _stack_chunk(items: Sequence, part: List[int]) -> np.ndarray:
-    return np.stack([np.asarray(items[i], np.float32) for i in part])
+def _stack_chunk(
+    items: Sequence, part: List[int], pad_to: Optional[int] = None
+) -> np.ndarray:
+    """Stack a chunk's items, zero-padding the leading axis up to
+    ``pad_to`` (shape-stable dispatch: a ragged tail reuses the full
+    chunk's compiled program instead of compiling its own). Zero rows
+    follow the `Dataset` padding convention; `_split_result` slices them
+    off before any consumer sees them, so the validity contract is
+    positional — rows [0, len(part)) are real, the rest are phantoms."""
+    stacked = np.stack([np.asarray(items[i], np.float32) for i in part])
+    if pad_to is not None and pad_to > len(part):
+        widths = [(0, pad_to - len(part))] + [(0, 0)] * (stacked.ndim - 1)
+        stacked = np.pad(stacked, widths)
+    return stacked
 
 
 def _split_result(res, part: List[int]) -> Tuple[List[int], List]:
     res = np.asarray(res)  # the blocking device→host pull
     counter("overlap.bytes_pulled").inc(float(res.nbytes))
+    # slice padded phantom rows off HERE, in the one place both dispatch
+    # paths share: the indices/results yielded downstream always cover
+    # exactly the chunk's real items
     return part, [res[j] for j in range(len(part))]
 
 
 def _stream_serial(items, plan, batch_fn) -> Iterator[Tuple[List[int], List]]:
     """Pre-overlap behavior: stack → dispatch → blocking pull, one chunk
     at a time."""
-    for i, part in enumerate(plan):
+    for i, (part, pad_to) in enumerate(plan):
         with span("chunk_serial", cat="chunk", idx=i, rows=len(part)):
             record_dispatch()  # one program per (shape, chunk) dispatch
-            out = _split_result(batch_fn(_stack_chunk(items, part)), part)
+            out = _split_result(
+                batch_fn(_stack_chunk(items, part, pad_to)), part)
         yield out
 
 
@@ -242,10 +293,10 @@ def _stream_overlapped(
             staged_count[0] += d
 
     def _stage(idx_part):
-        i, part = idx_part
+        i, (part, pad_to) = idx_part
         _bump_staged(1)
         with span("chunk_stage", cat="chunk", idx=i, rows=len(part)):
-            return part, _device_put_host(_stack_chunk(items, part))
+            return part, _device_put_host(_stack_chunk(items, part, pad_to))
 
     staged = prefetch_iterator(
         (_stage(ip) for ip in enumerate(plan)), depth,
@@ -267,10 +318,10 @@ def _stream_overlapped(
 
     try:
         drained = 0
-        for part, chunk in staged:
+        for part, staged_chunk in staged:
             _bump_staged(-1)  # chunk left the producer side
             # async dispatch: returns immediately, device queues the work
-            inflight.append((part, batch_fn(chunk)))
+            inflight.append((part, batch_fn(staged_chunk)))
             dispatched.inc()
             record_dispatch()  # one program per dispatched chunk
             _note_residency()
@@ -284,22 +335,41 @@ def _stream_overlapped(
         staged.close()  # early exit / batch_fn failure cancels the producer
 
 
+#: sentinel: "use `ExecutionConfig.chunk_size`" — distinct from None,
+#: which keeps its historical meaning of one chunk per shape bucket.
+USE_CONFIG_CHUNK = object()
+
+
+def _resolve_chunk(chunk):
+    if chunk is USE_CONFIG_CHUNK:
+        from ..workflow.env import execution_config
+
+        return execution_config().chunk_size
+    return chunk
+
+
 def map_host_batched_stream(
     items: Sequence,
     batch_fn: Callable,
-    chunk: Optional[int] = 256,
+    chunk=USE_CONFIG_CHUNK,
 ) -> Iterator[Tuple[List[int], List]]:
     """Streaming form of `map_host_batched`: yields ``(indices, results)``
     per drained chunk, in dispatch (bucket-major) order. ``indices`` are
     positions in the original item order; the union over all chunks is
-    exactly ``range(len(items))``. Consumers that only need the final
+    exactly ``range(len(items))`` — with shape-stable dispatch on
+    (``ExecutionConfig.pad_chunks``) a ragged tail executes at the full
+    padded width, but its phantom rows never leave this module. The
+    chunk size defaults to `ExecutionConfig.chunk_size`
+    (``KEYSTONE_CHUNK_SIZE``); pass an int to pin it, or None for one
+    chunk per shape bucket. Consumers that only need the final
     collection should use `map_host_batched`; chunk-capable pipeline
     stages consume this directly so downstream host work starts before
     the last chunk is off the device."""
-    plan = _plan_chunks(items, chunk)
+    chunk = _resolve_chunk(chunk)
     from ..workflow.env import execution_config
 
     cfg = execution_config()
+    plan = _plan_chunks(items, chunk, pad=cfg.pad_chunks)
     if cfg.overlap and len(plan) > 1:
         return _stream_overlapped(items, plan, batch_fn, cfg.prefetch_depth)
     return _stream_serial(items, plan, batch_fn)
@@ -308,17 +378,24 @@ def map_host_batched_stream(
 def map_host_batched(
     items: Sequence,
     batch_fn: Callable,
-    chunk: Optional[int] = 256,
+    chunk=USE_CONFIG_CHUNK,
 ) -> List[np.ndarray]:
     """Apply a batched (leading-axis) function to variable-shape items.
 
     Items are bucketed by shape; each bucket is stacked and dispatched
-    through ``batch_fn`` in chunks of ``chunk`` (bounding peak host+device
-    memory). Results come back in the original item order. With the
-    overlap engine on (the default), stacking/upload of chunk k+1, device
+    through ``batch_fn`` in chunks of ``chunk`` (default
+    `ExecutionConfig.chunk_size`; bounds peak host+device memory).
+    Results come back in the original item order. With the overlap
+    engine on (the default), stacking/upload of chunk k+1, device
     compute on chunk k, and the result pull of chunk k−depth all proceed
     concurrently; the serial path (single chunk, or overlap disabled)
-    computes the identical result one blocking chunk at a time.
+    computes the identical result one blocking chunk at a time. With
+    ``ExecutionConfig.pad_chunks`` (default on) each bucket's ragged
+    tail is zero-padded to the chunk size (power-of-two ladder below
+    it), so a stage compiles one XLA program per bucket shape no matter
+    the item count — ``batch_fn`` must be per-item along the leading
+    axis (the documented contract), making the padded rows dead weight
+    that is sliced off before results surface.
     """
     out: List = [None] * len(items)
     for part, results in map_host_batched_stream(items, batch_fn, chunk):

@@ -75,6 +75,36 @@ class ExecutionConfig:
     ``dispatch_workers`` bounds the scheduler's pool (env
     ``KEYSTONE_DISPATCH_WORKERS``, default 4; values <= 1 force the
     serial path).
+
+    ``chunk_size`` is the library-wide host-batching chunk row count
+    (`utils.batching.map_host_batched`'s dispatch granularity AND the
+    static memory model's streaming-chunk assumption — one number, read
+    by both, so the analyzer can never model a different chunking than
+    the runtime executes). Env ``KEYSTONE_CHUNK_SIZE``, default 256.
+
+    ``pad_chunks`` (default on; env ``KEYSTONE_PAD_CHUNKS=0`` disables)
+    turns on shape-stable chunk dispatch: each shape bucket's ragged
+    tail chunk is zero-padded up to the chunk size (tiny buckets round
+    up a power-of-two ladder instead), so a stage compiles ONE program
+    per bucket shape regardless of item count — without it every
+    distinct ``bucket_size % chunk`` residue compiles its own XLA
+    program. Padded rows are sliced off before any consumer sees them,
+    so outputs are identical either way.
+
+    ``aot_warmup`` (default on; env ``KEYSTONE_AOT_WARMUP=0`` disables)
+    compiles the optimized plan's fused programs ahead of time: at
+    execute time the static analyzer's propagated specs are lowered via
+    ``jit(...).lower(abstract).compile()`` on a background pool, so the
+    first chunk dispatches into a warm executable instead of blocking on
+    a cold compile while the loaders sit idle.
+
+    ``compile_cache_dir`` arms jax's persistent compilation cache
+    (``jax_compilation_cache_dir``) so repeated *processes* skip XLA
+    compilation entirely. Env ``KEYSTONE_COMPILE_CACHE``: unset → a
+    repo-local default (``<repo>/.keystone_compile_cache``); a path →
+    that path; ``0``/``off``/``false`` → disabled. Compile activity is
+    measured either way (``dispatch.programs_compiled``, see
+    `keystone_tpu.telemetry.compile_events`).
     """
 
     overlap: bool = True
@@ -83,9 +113,79 @@ class ExecutionConfig:
     trace_path: Optional[str] = None
     concurrent_dispatch: bool = True
     dispatch_workers: int = 4
+    chunk_size: int = 256
+    pad_chunks: bool = True
+    aot_warmup: bool = True
+    compile_cache_dir: Optional[str] = None
 
 
 _exec_config: Optional[ExecutionConfig] = None
+
+_OFF = ("0", "false", "off")
+
+
+def _default_compile_cache_dir() -> str:
+    """Repo-local persistent-cache default: next to the package, so the
+    cache survives across runs of the same checkout without polluting
+    the user's home directory."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        ".keystone_compile_cache",
+    )
+
+
+def _env_compile_cache_dir() -> Optional[str]:
+    raw = os.environ.get("KEYSTONE_COMPILE_CACHE")
+    if raw is None or raw == "":
+        return _default_compile_cache_dir()
+    if raw.lower() in _OFF:
+        return None
+    return raw
+
+
+_compile_cache_applied: Optional[str] = None
+
+
+def _sync_compile_cache(cfg: ExecutionConfig) -> None:
+    """Point jax's persistent compilation cache at the configured dir
+    (idempotent; None disables it).
+    The min-compile-time / min-entry-size floors are zeroed so the
+    sub-second CPU programs this library dispatches get cached too;
+    without that only multi-second TPU compiles would persist and the
+    warm-run == 0-compiles contract would silently not hold on the CPU
+    tier-1 path."""
+    global _compile_cache_applied
+    path = cfg.compile_cache_dir
+    if path == _compile_cache_applied:
+        return
+    _compile_cache_applied = path
+    try:
+        import jax
+
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+            except Exception:
+                pass  # knob absent on older jax: size floor stays default
+        jax.config.update("jax_compilation_cache_dir", path)
+        # jax's cache object binds its directory at first use; after a
+        # dir change it must be reset or writes keep landing in the old
+        # (possibly deleted) directory
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+    except Exception:
+        # an unwritable dir or an ancient jax must never break execution;
+        # compiles simply stay cold (and the accounting shows it)
+        _compile_cache_applied = None
 
 
 def execution_config() -> ExecutionConfig:
@@ -93,7 +193,7 @@ def execution_config() -> ExecutionConfig:
     if _exec_config is None:
         _exec_config = ExecutionConfig(
             overlap=os.environ.get("KEYSTONE_OVERLAP", "1").lower()
-            not in ("0", "false", "off"),
+            not in _OFF,
             prefetch_depth=max(
                 1, int(os.environ.get("KEYSTONE_PREFETCH_DEPTH", "2"))
             ),
@@ -105,11 +205,20 @@ def execution_config() -> ExecutionConfig:
             trace_path=os.environ.get("KEYSTONE_TRACE") or None,
             concurrent_dispatch=os.environ.get(
                 "KEYSTONE_CONCURRENT_DISPATCH", "1").lower()
-            not in ("0", "false", "off"),
+            not in _OFF,
             dispatch_workers=max(
                 1, int(os.environ.get("KEYSTONE_DISPATCH_WORKERS", "4"))
             ),
+            chunk_size=max(
+                1, int(os.environ.get("KEYSTONE_CHUNK_SIZE", "256"))
+            ),
+            pad_chunks=os.environ.get("KEYSTONE_PAD_CHUNKS", "1").lower()
+            not in _OFF,
+            aot_warmup=os.environ.get("KEYSTONE_AOT_WARMUP", "1").lower()
+            not in _OFF,
+            compile_cache_dir=_env_compile_cache_dir(),
         )
+        _sync_compile_cache(_exec_config)
     return _exec_config
 
 
@@ -117,6 +226,8 @@ def set_execution_config(config: Optional[ExecutionConfig]) -> None:
     """Install ``config`` process-wide; None re-derives from the env."""
     global _exec_config
     _exec_config = config
+    if config is not None:
+        _sync_compile_cache(config)
 
 
 @contextmanager
@@ -150,6 +261,25 @@ def dispatch_override(enabled: bool, workers: Optional[int] = None):
         yield cfg
     finally:
         _exec_config = prev
+
+
+@contextmanager
+def config_override(**fields):
+    """Scoped override of arbitrary `ExecutionConfig` fields — the
+    compile bench and tests flip chunk padding / AOT warmup / the cache
+    dir without touching process env state. The persistent-cache config
+    is re-synced on entry AND exit so a scoped ``compile_cache_dir``
+    never leaks into later runs."""
+    global _exec_config
+    prev = _exec_config
+    cfg = replace(execution_config(), **fields)
+    _exec_config = cfg
+    _sync_compile_cache(cfg)
+    try:
+        yield cfg
+    finally:
+        _exec_config = prev
+        _sync_compile_cache(execution_config())
 
 
 @dataclass(frozen=True)

@@ -103,7 +103,7 @@ def _add_data_vertex(g: Graph, data: Any) -> Tuple[Graph, NodeOrSourceId]:
 def _validate(graph, source_specs, *, level: str = "full", ignore=(),
               hbm_budget_bytes=None, chunk_rows=None, raise_on_error=True):
     """Shared implementation of `Pipeline.validate` and friends."""
-    from ..analysis import DEFAULT_CHUNK_ROWS, validate_graph
+    from ..analysis import validate_graph
 
     report = validate_graph(
         graph,
@@ -111,7 +111,8 @@ def _validate(graph, source_specs, *, level: str = "full", ignore=(),
         level=level,
         ignore=ignore,
         hbm_budget_bytes=hbm_budget_bytes,
-        chunk_rows=chunk_rows if chunk_rows is not None else DEFAULT_CHUNK_ROWS,
+        # None → ExecutionConfig.chunk_size, resolved inside memory_pass
+        chunk_rows=chunk_rows,
     )
     if raise_on_error:
         report.raise_for_errors()

@@ -39,6 +39,16 @@ system cannot (see ANALYSIS.md for the full catalog):
          ``Dataset.sync()``; sanctioned drains (the overlap engine's
          in-order result pulls) carry the suppression comment.
 
+  KJ006  fresh-jit-per-call (under ``workflow/`` and ``nodes/``):
+         ``jax.jit`` applied to a freshly constructed closure or lambda
+         inside a loop or per-call scope. jit caches by function-object
+         identity, so each call constructs a new callable, misses the
+         cache, and silently re-traces + recompiles — the exact compile
+         tax the compile-bounded execution work (ISSUE 5) eliminates.
+         Cache the jitted fn at module level, on the instance
+         (``self.__dict__['_jitted']``), or in an explicit program
+         cache keyed on structure (``nodes/util/fusion``).
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -65,6 +75,9 @@ RULES = {
              "time.perf_counter())",
     "KJ005": "blocking host pull on a device value in a hot path "
              "(route through data.dataset.sync_pull / Dataset.sync)",
+    "KJ006": "jax.jit of a freshly constructed closure/lambda in a loop "
+             "or per-call scope (recompiles every call; cache the "
+             "jitted fn)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -278,6 +291,79 @@ def _check_blocking_host_pull(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "or defer to the overlap engine's in-order drain")
 
 
+def _is_jit_call(func: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` as a CALL (decorators live in
+    decorator_list and are evaluated once at def time — not flagged)."""
+    if isinstance(func, ast.Name):
+        return func.id == "jit"
+    return (isinstance(func, ast.Attribute) and func.attr == "jit"
+            and _attr_root(func) == "jax")
+
+
+def _check_fresh_jit(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ006: jit caches compiled executables by FUNCTION OBJECT
+    identity, so ``jax.jit`` over a freshly constructed callable — a
+    lambda, or a function defined in the same (per-call) scope — misses
+    that cache on every call and silently re-traces + recompiles each
+    time. Two patterns are flagged in ``workflow/``/``nodes/``:
+
+      (a) any ``jax.jit(...)`` call inside a ``for``/``while`` body —
+          one compile per iteration, the worst case;
+      (b) ``jax.jit(<lambda or same-scope def>)`` inside a function
+          body — one compile per CALL of the enclosing function.
+
+    The sanctioned fixes are module-level jits, instance-memoized jits
+    (the ``self.__dict__['_jitted']`` idiom — its argument is a call
+    expression, so it is not flagged), or an explicit program cache
+    (``nodes/util/fusion._PROGRAM_CACHE``, which suppresses)."""
+    # (a) jit calls under a loop
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Call) and _is_jit_call(sub.func):
+                yield Finding(
+                    path, sub.lineno, "KJ006",
+                    "jax.jit inside a loop body compiles a fresh program "
+                    "every iteration; hoist and cache the jitted fn")
+
+    # (b) jit of a lambda / same-scope def inside a function body
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        local_fns: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not fn:
+                local_fns.add(sub.name)
+            elif isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Lambda):
+                local_fns.update(
+                    t.id for t in sub.targets if isinstance(t, ast.Name))
+        # one aliasing hop: `g = local_def; ... jax.jit(g)`
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in local_fns:
+                local_fns.update(
+                    t.id for t in sub.targets if isinstance(t, ast.Name))
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call) and _is_jit_call(call.func)
+                    and call.args):
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Lambda) or (
+                    isinstance(arg, ast.Name) and arg.id in local_fns):
+                name = ("lambda" if isinstance(arg, ast.Lambda)
+                        else arg.id)
+                yield Finding(
+                    path, call.lineno, "KJ006",
+                    f"jax.jit over per-call-scope callable `{name}` in "
+                    f"`{fn.name}` recompiles on every call; cache the "
+                    "jitted fn (module level, instance memo, or an "
+                    "explicit program cache)")
+
+
 def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
     for fn in ast.walk(tree):
         if not isinstance(fn, ast.FunctionDef):
@@ -315,6 +401,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_missing_donate(tree, rel))
     if "workflow/" in posix or "nodes/" in posix:
         findings.extend(_check_blocking_host_pull(tree, rel))
+        findings.extend(_check_fresh_jit(tree, rel))
 
     # nested loops make ast.walk revisit inner statements: keep one
     # finding per (line, rule)

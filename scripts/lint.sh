@@ -77,4 +77,48 @@ print(f"dispatch smoke: {int(programs)} program(s), "
 PY
 JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
 
+echo "== compile smoke (warm second run performs 0 cold compiles) =="
+COMPILE_CACHE="$(mktemp -d /tmp/keystone_compile_smoke.XXXXXX)"
+COMPILE_TRACE="$(mktemp /tmp/keystone_compile_smoke.XXXXXX.json)"
+trap 'rm -f "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
+JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$COMPILE_CACHE" \
+KEYSTONE_TRACE="$COMPILE_TRACE" python - <<'PY'
+# One example pipeline run TWICE against a fresh persistent-cache dir
+# with tracing armed: the second (rebuilt-from-scratch) run must perform
+# zero cold compiles — everything served warm from the persistent cache
+# or the in-process program caches — and the trace must parse and carry
+# the compile accounting.
+import json, os
+from keystone_tpu.dispatch_bench import measure_example
+from keystone_tpu.telemetry import compiles_snapshot
+from keystone_tpu.workflow.executor import drain_warmups
+
+measure_example("MnistRandomFFT", "optimized")
+drain_warmups()  # background AOT compiles count against THIS run
+first = compiles_snapshot()
+measure_example("MnistRandomFFT", "optimized")
+drain_warmups()
+second = compiles_snapshot()
+new_cold = second["programs_compiled"] - first["programs_compiled"]
+assert new_cold == 0, (
+    f"second identical run performed {new_cold} cold compile(s): "
+    f"{first} -> {second}")
+
+import keystone_tpu.telemetry.spans as spans
+from keystone_tpu.telemetry.export import compile_summary, write_trace
+tracer = spans.current_tracer()
+assert tracer is not None, "KEYSTONE_TRACE did not arm the ambient tracer"
+write_trace(tracer, os.environ["KEYSTONE_TRACE"])
+
+trace = json.load(open(os.environ["KEYSTONE_TRACE"]))
+assert trace["traceEvents"], "empty traceEvents"
+counters = trace["keystone"]["metrics"]["counters"]
+assert "dispatch.programs_compiled" in counters, sorted(counters)
+line = compile_summary(trace)
+assert line is not None, "trace carries no compile digest"
+print(f"compile smoke: run1 {first['programs_compiled']} cold / "
+      f"{first['compile_cache_hits']} hits; run2 +0 cold — {line} OK")
+PY
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
+
 echo "lint: OK"

@@ -34,12 +34,16 @@ def trace_table(path, top=15):
             print(f"| {name} | {a['self_s']:.4f} | {a['total_s']:.4f} | "
                   f"{int(a['count'])} | {a['bytes'] / 1e6:.1f} |")
         print()
-    from keystone_tpu.telemetry import dispatch_summary
+    from keystone_tpu.telemetry import compile_summary, dispatch_summary
 
     dispatch = dispatch_summary(trace)
     if dispatch:
         print(f"**Dispatch**: {dispatch} — serial-vs-concurrent runs "
               "diff on this line\n")
+    compiles = compile_summary(trace)
+    if compiles:
+        print(f"**Compiles**: {compiles} — a warm (persistent-cache / "
+              "AOT-warmed) run holds the cold count at 0\n")
     hist = trace.get("keystone", {}).get("metrics", {}).get("histograms", {})
     stall = hist.get("prefetch.producer_stall_s")
     wait = hist.get("prefetch.consumer_wait_s")

@@ -175,7 +175,20 @@ def test_host_batch_dispatch_scales_with_buckets(monkeypatch):
     ext = LCSExtractor(stride=8)
     out = ext.apply_batch(HostDataset(items))
     assert len(calls) == 2, calls  # two shape buckets, seven items
-    assert {c[0] for c in calls} == {4, 3}
+    # shape-stable dispatch pads tiny buckets up the power-of-two ladder
+    # (3 → 4), so BOTH buckets execute the same leading dim — one
+    # compiled program shape instead of one per item count
+    assert {c[0] for c in calls} == {4}, calls
     # order-preserving and identical to the per-item path
     for got, img in zip(out.items, items):
         np.testing.assert_allclose(got, np.asarray(ext.apply(img)), atol=1e-5)
+
+    # with padding off the raw bucket sizes dispatch as-is
+    from keystone_tpu.workflow.env import config_override
+
+    calls.clear()
+    with config_override(pad_chunks=False):
+        out2 = ext.apply_batch(HostDataset(items))
+    assert {c[0] for c in calls} == {4, 3}, calls
+    for a, b in zip(out.items, out2.items):
+        np.testing.assert_allclose(a, b, atol=1e-6)

@@ -206,6 +206,86 @@ def test_wall_clock_duration_caught(tmp_path):
     assert jl.lint_file(good) == []
 
 
+def test_kj006_flags_fresh_jit_per_call(tmp_path):
+    """KJ006: jit of a lambda / same-scope def inside a function body,
+    and ANY jit call inside a loop, are flagged in workflow/ and
+    nodes/; the instance-memoized idiom (jit over a call expression)
+    and module-level jits pass."""
+    jl = _jaxlint()
+    bad = tmp_path / "workflow" / "bad_jit.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def per_call(x):\n"
+        "    f = jax.jit(lambda v: v * 2.0)\n"              # KJ006
+        "    def step(v):\n"
+        "        return v + 1.0\n"
+        "    g = jax.jit(step)\n"                           # KJ006
+        "    h = step\n"
+        "    k = jax.jit(h)\n"                              # KJ006 (alias)
+        "    return k(g(f(x)))\n"
+        "\n"
+        "\n"
+        "def looped(xs):\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        out.append(jax.jit(jnp.ravel)(x))\n"       # KJ006 (loop)
+        "    return out\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ006"] * 4, findings
+    assert sorted(f.line for f in findings) == [6, 9, 11, 18]
+
+    good = tmp_path / "workflow" / "good_jit.py"
+    good.write_text(
+        "import jax\n"
+        "\n"
+        "_module_jit = jax.jit(lambda v: v * 2.0)\n"  # once per import: ok
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def _fn(self):\n"
+        "        return lambda v: v + 1.0\n"
+        "\n"
+        "    def apply(self, x):\n"
+        "        f = self.__dict__.get('_jitted')\n"
+        "        if f is None:\n"
+        "            f = jax.jit(self._fn())\n"  # memoized idiom: ok
+        "            self.__dict__['_jitted'] = f\n"
+        "        return f(x)\n"
+    )
+    assert jl.lint_file(good) == []
+
+    # outside workflow/ and nodes/, the rule does not apply
+    elsewhere = tmp_path / "loaders" / "ok_jit.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj006_suppression(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "nodes" / "cached_jit.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "import jax\n"
+        "\n"
+        "_CACHE = {}\n"
+        "\n"
+        "\n"
+        "def program(key):\n"
+        "    def fn(v):\n"
+        "        return v * 2.0\n"
+        "    if key not in _CACHE:\n"
+        "        _CACHE[key] = jax.jit(fn)  # keystone: ignore[KJ006]\n"
+        "    return _CACHE[key]\n"
+    )
+    assert jl.lint_file(f) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""
