@@ -1008,9 +1008,15 @@ def child_main(args):
         t0 = _t.perf_counter()
         rep = dispatch_count_report()
         rep["seconds"] = round(_t.perf_counter() - t0, 2)
+        problems = []
         if not rep["all_outputs_match"]:
-            rep["error"] = ("optimized/legacy plan predictions diverged "
-                            "from the serial unfused path")
+            problems.append("optimized/legacy/megafused plan predictions "
+                            "diverged from the serial unfused path")
+        if rep.get("examples_at_one_program", 0) < 2:
+            problems.append("megafusion did not reach 1 program/apply run "
+                            "on at least two example pipelines")
+        if problems:
+            rep["error"] = "; ".join(problems)
         return rep
 
     dispatch_tier = None

@@ -94,8 +94,12 @@ def validate_graph(
     if tier >= 3:
         from ..workflow.env import execution_config
 
-        diags.extend(hazard_pass(
-            graph, specs, overlap=execution_config().overlap))
+        cfg = execution_config()
+        diags.extend(hazard_pass(graph, specs, overlap=cfg.overlap))
+        if cfg.megafusion:
+            from .hazards import megafusion_pass
+
+            diags.extend(megafusion_pass(graph))
 
     report = ValidationReport(diags, specs=specs, memory=memory, level=level)
     return report.filter(ignore) if ignore else report

@@ -39,6 +39,8 @@ RULES = {
              "HBM budget",
     "KP203": "overlap-amplification: prefetch depth multiplies a streaming "
              "stage's resident footprint",
+    "KP204": "megafused-scan-live-set: the in-program chunk loop's per-trip "
+             "carry rides on top of stacked-input + output residency",
     # hazard tier
     "KP301": "donation-reuse: a buffer donated by one consumer is still "
              "reachable by another sink",
@@ -46,6 +48,9 @@ RULES = {
              "non-chunkable operator, silently materializing the stream",
     "KP303": "cache-on-stream: a cache node on a streaming stage "
              "materializes the stream and defeats overlap",
+    "KP401": "megafusion-fallback: a stage keeps this plan from collapsing "
+             "to one XLA program (fan-out, host code, or a streaming "
+             "origin); the per-program dispatch path remains",
 }
 
 

@@ -131,3 +131,27 @@ def _is_stream_origin(op) -> bool:
 
     fn = getattr(type(op), "apply_batch_stream", None)
     return fn is not None and fn is not Transformer.apply_batch_stream
+
+
+def megafusion_pass(graph: Graph) -> List[Diagnostic]:
+    """KP401 (info): why this plan cannot collapse to ONE XLA program.
+
+    Simulates the optimizer's node-fusion pass (a pure, data-free graph
+    rewrite) and asks `workflow.fusion_rule.megafusion_blockers` which
+    remaining stages interrupt an otherwise-fusable chain — fan-out,
+    host-code stages, stream origins, unfusable estimator fits. Those
+    plans fall back cleanly to the per-program dispatch path at run
+    time; this pass is how ``validate()`` says why."""
+    try:
+        from ..workflow.fusion_rule import megafusion_blockers
+
+        blockers = megafusion_blockers(graph)
+    except Exception:
+        return []  # diagnosis must never break validation
+    return [
+        Diagnostic(
+            "KP401", Severity.INFO,
+            f"megafusion fallback: {reason}",
+            vertex=vid, label=label)
+        for vid, label, reason in blockers
+    ]

@@ -139,6 +139,30 @@ def memory_pass(
                             f"/chunk = {_fmt_bytes(chunk_bytes)} resident "
                             f"(prefetch_depth={prefetch_depth})",
                             vertex=vid, label=_label(graph, vid)))
+        # Megafused scan live-set: a whole-plan program holds its stacked
+        # input (the dep's residency, priced at the producer) plus the
+        # scan's per-trip carry — one chunk's largest stage boundary —
+        # INSTEAD of materialized intermediates, which no longer exist as
+        # graph nodes. The operator knows its own stage trail; price it.
+        scan_hook = getattr(op, "scan_live_nbytes", None)
+        if scan_hook is not None and full is not None:
+            try:
+                dep_specs = [specs.get(d)
+                             for d in graph.get_dependencies(vid)]
+                scan_live = scan_hook(dep_specs, chunk_rows)
+            except Exception:
+                scan_live = None
+            if scan_live:
+                resident += int(scan_live)
+                if hbm_budget_bytes and scan_live > hbm_budget_bytes // 20:
+                    diags.append(Diagnostic(
+                        "KP204", Severity.INFO,
+                        f"megafused scan live-set: "
+                        f"{_fmt_bytes(int(scan_live))} of in-program "
+                        f"per-trip carry (chunk_rows={chunk_rows}) rides "
+                        "on top of the stacked input and output "
+                        "residency",
+                        vertex=vid, label=_label(graph, vid)))
         est.resident[vid] = resident
 
         if hbm_budget_bytes and full > hbm_budget_bytes:

@@ -55,46 +55,55 @@ def _delta(before: Dict, after: Dict) -> Dict:
     return {k: round(after[k] - before[k], 4) for k in before}
 
 
-def _run_example(name: str, ragged_test: bool):
+def _run_example(name: str, ragged_test: bool, plan: str = "megafused"):
     """One cold-start pipeline run (fresh PipelineEnv, pipeline rebuilt
     from scratch): returns (seconds, compile-delta, fit_pred, test_pred,
-    apply_programs_executed, apply_compile_delta)."""
+    apply_programs_executed, apply_compile_delta). ``plan`` picks the
+    optimizer plan (``megafused`` — the default plan — or
+    ``optimized``, the PR-4/5 plan, for breakdown rows)."""
+    from .dispatch_bench import _plan_context
     from .telemetry import counter
-    from .workflow.env import PipelineEnv
+    from .workflow.env import PipelineEnv, config_override
 
+    optimizer, _, _, megafuse_on = _plan_context(plan)
     PipelineEnv.reset()
     try:
-        predictor, train, test = EXAMPLES[name]()
-        if ragged_test:
-            # a non-multiple example count: shrink the held-out set's
-            # count so the padded-row masking machinery is live in the
-            # measured run (Dataset re-slices + re-pads internally)
-            from .data.dataset import Dataset
+        with config_override(megafusion=megafuse_on):
+            PipelineEnv.get().set_optimizer(optimizer)
+            predictor, train, test = EXAMPLES[name]()
+            if ragged_test:
+                # a non-multiple example count: shrink the held-out
+                # set's count so the padded-row masking machinery is
+                # live in the measured run (Dataset re-slices + re-pads
+                # internally)
+                from .data.dataset import Dataset
 
-            n = test.count - max(1, test.n_shards // 2) - 1
-            test = Dataset(test.numpy(), count=n)
-        execd = counter("dispatch.programs_executed")
-        t0 = time.perf_counter()
-        before = _snapshot()
-        train_pred = np.asarray(predictor(train).get().numpy())
-        mid = _snapshot()
-        e_before = execd.value
-        test_pred = np.asarray(predictor(test).get().numpy())
-        seconds = time.perf_counter() - t0
-        after = _snapshot()
-        return {
-            "seconds": round(seconds, 4),
-            "compiles": _delta(before, after),
-            "apply_compiles": _delta(mid, after),
-            "apply_programs_executed": int(execd.value - e_before),
-            "train_pred": train_pred,
-            "test_pred": test_pred,
-        }
+                n = test.count - max(1, test.n_shards // 2) - 1
+                test = Dataset(test.numpy(), count=n)
+            execd = counter("dispatch.programs_executed")
+            t0 = time.perf_counter()
+            before = _snapshot()
+            train_pred = np.asarray(predictor(train).get().numpy())
+            mid = _snapshot()
+            e_before = execd.value
+            test_pred = np.asarray(predictor(test).get().numpy())
+            seconds = time.perf_counter() - t0
+            after = _snapshot()
+            return {
+                "plan": plan,
+                "seconds": round(seconds, 4),
+                "compiles": _delta(before, after),
+                "apply_compiles": _delta(mid, after),
+                "apply_programs_executed": int(execd.value - e_before),
+                "train_pred": train_pred,
+                "test_pred": test_pred,
+            }
     finally:
         PipelineEnv.reset()
 
 
-def measure_example_compiles(name: str, ragged_test: bool = False) -> Dict:
+def measure_example_compiles(name: str, ragged_test: bool = False,
+                             plan: str = "megafused") -> Dict:
     """Cold run vs warm rebuild of one example pipeline against a fresh
     persistent-cache dir. The warm run rebuilds the whole pipeline (new
     closures — jax's in-memory jit caches miss), so every avoided cold
@@ -104,14 +113,15 @@ def measure_example_compiles(name: str, ragged_test: bool = False) -> Dict:
 
     with tempfile.TemporaryDirectory(prefix="keystone-compile-bench-") as d:
         with config_override(compile_cache_dir=d):
-            cold = _run_example(name, ragged_test)
-            warm = _run_example(name, ragged_test)
+            cold = _run_example(name, ragged_test, plan=plan)
+            warm = _run_example(name, ragged_test, plan=plan)
     np.testing.assert_allclose(
         warm["train_pred"], cold["train_pred"], rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(
         warm["test_pred"], cold["test_pred"], rtol=1e-5, atol=1e-5)
     return {
         "example": name,
+        "plan": plan,
         "ragged_test": ragged_test,
         "cold_run": {k: cold[k] for k in
                      ("seconds", "compiles", "apply_compiles",
@@ -179,12 +189,32 @@ def compile_count_report(
     host-chunk ragged-tail microbench. The acceptance gate: every
     example's warm run performs 0 cold compiles and beats the cold run's
     end-to-end wall clock, with outputs allclose-identical throughout."""
-    out: Dict = {"examples": {}}
+    out: Dict = {"examples": {}, "plan": "megafused",
+                 "plan_breakdown": []}
     for name in examples:
         out["examples"][name] = {
             "multiple": measure_example_compiles(name, ragged_test=False),
             "ragged": measure_example_compiles(name, ragged_test=True),
         }
+
+        def breakdown_row(rep):
+            # the per-plan breakdown row (satellite of the megafusion
+            # PR): what the warm serving path executes and compiles,
+            # per plan — rendered next to the dispatch breakdown
+            return {
+                "example": name,
+                "plan": rep["plan"],
+                "warm_apply_programs_executed":
+                    rep["warm_run"]["apply_programs_executed"],
+                "warm_apply_cold_compiles":
+                    rep["warm_run"]["apply_compiles"]["programs_compiled"],
+            }
+
+        out["plan_breakdown"].append(
+            breakdown_row(out["examples"][name]["multiple"]))
+        out["plan_breakdown"].append(breakdown_row(
+            measure_example_compiles(name, ragged_test=False,
+                                     plan="optimized")))
     out["host_chunk"] = measure_host_chunk_compiles()
     runs = [r for e in out["examples"].values() for r in e.values()]
     # per-example: an example counts only when BOTH its runs (multiple

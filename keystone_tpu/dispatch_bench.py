@@ -12,17 +12,22 @@ plans and checks the outputs are identical:
     boundary degenerates to;
   - ``legacy`` — the PR-3 optimizer exactly (transformer-chain fusion
     only, ``NodeFusionRule(fuse_apply=False)``, serial dispatch);
-  - ``optimized`` — the current default plan: expanded fusable coverage,
-    fusion through fan-out-free estimator apply boundaries
-    (`FusedChainOperator`), concurrent DAG dispatch.
+  - ``optimized`` — the PR-4/5 plan: expanded fusable coverage, fusion
+    through fan-out-free estimator apply boundaries
+    (`FusedChainOperator`), concurrent DAG dispatch, megafusion OFF;
+  - ``megafused`` — the current default plan: ``optimized`` plus
+    whole-plan megafusion (`MegafusionRule`): the entire apply path,
+    chunk loop included, collapses into ONE scan-bodied program.
 
 Each measurement reports the *fit run* (first application: estimator
 fits + train apply) and the *apply run* (re-applying the fitted
 pipeline to held-out data — the serving path) separately; the apply run
 is the headline programs-per-run number the `dispatch_count` bench tier
-records. Used by ``bench.py --child`` (the ``dispatch_count`` tier) and
-by tests/test_scheduler.py (the ≥2× acceptance gate + allclose identity
-against the serial unfused path).
+records, and the report carries a per-plan breakdown row per example so
+the 2→1 reduction shows up in ``perf_table.py --trace`` directly. Used
+by ``bench.py --child`` (the ``dispatch_count`` tier) and by
+tests/test_scheduler.py + tests/test_megafusion.py (the acceptance
+gates + allclose identity against the serial unfused path).
 """
 
 from __future__ import annotations
@@ -31,7 +36,7 @@ from typing import Callable, Dict, List, Tuple
 
 import numpy as np
 
-PLANS = ("serial_unfused", "legacy", "optimized")
+PLANS = ("serial_unfused", "legacy", "optimized", "megafused")
 
 
 # ---------------------------------------------------------------- examples
@@ -157,15 +162,19 @@ EXAMPLES: Dict[str, Callable] = {
 
 
 def _plan_context(plan: str):
-    """(optimizer, overlap_on, concurrent_on) for a named plan."""
+    """(optimizer, overlap_on, concurrent_on, megafusion_on) for a
+    named plan. ``optimized`` pins megafusion OFF so it remains the
+    PR-4/5 plan bit for bit; ``megafused`` is the library default."""
     from .workflow.optimizer import DefaultOptimizer
 
     if plan == "serial_unfused":
-        return DefaultOptimizer(fuse=False), False, False
+        return DefaultOptimizer(fuse=False), False, False, False
     if plan == "legacy":
-        return DefaultOptimizer(fuse_apply=False), True, False
+        return DefaultOptimizer(fuse_apply=False), True, False, False
     if plan == "optimized":
-        return DefaultOptimizer(), True, True
+        return DefaultOptimizer(megafuse=False), True, True, False
+    if plan == "megafused":
+        return DefaultOptimizer(), True, True, True
     raise ValueError(f"unknown plan {plan!r}; expected one of {PLANS}")
 
 
@@ -173,14 +182,20 @@ def measure_example(name: str, plan: str) -> Dict:
     """Run one example under one plan from a clean `PipelineEnv`,
     returning program counts and the (host) predictions of both runs."""
     from .telemetry import counter
-    from .workflow.env import PipelineEnv, dispatch_override, overlap_override
+    from .workflow.env import (
+        PipelineEnv,
+        config_override,
+        dispatch_override,
+        overlap_override,
+    )
 
-    optimizer, overlap_on, concurrent_on = _plan_context(plan)
+    optimizer, overlap_on, concurrent_on, megafuse_on = _plan_context(plan)
     PipelineEnv.reset()
     try:
         PipelineEnv.get().set_optimizer(optimizer)
         with overlap_override(overlap_on), \
-                dispatch_override(concurrent_on):
+                dispatch_override(concurrent_on), \
+                config_override(megafusion=megafuse_on):
             predictor, train, test = EXAMPLES[name]()
             c = counter("dispatch.programs_executed")
             before = c.value
@@ -191,6 +206,18 @@ def measure_example(name: str, plan: str) -> Dict:
             apply_programs = c.value - before
     finally:
         PipelineEnv.reset()
+    from .telemetry import current_tracer
+
+    tracer = current_tracer()
+    if tracer is not None:
+        # per-plan breakdown in the trace metadata: perf_table.py
+        # --trace and the telemetry CLI render the 2→1 reduction from
+        # here (rows accumulate across measure_example calls)
+        meta = tracer.metadata.setdefault(
+            "dispatch_plans",
+            {"plans": list(PLANS), "apply_run_programs": {}})
+        meta["apply_run_programs"].setdefault(name, {})[plan] = int(
+            apply_programs)
     return {
         "plan": plan,
         "fit_run_programs": int(fit_programs),
@@ -206,17 +233,24 @@ def dispatch_count_report(
     check_outputs: bool = True,
 ) -> Dict:
     """The `dispatch_count` bench-tier payload: per-example programs per
-    run under each plan, reduction ratios (apply run, the serving path),
-    and an output-identity verdict against the serial unfused path."""
-    out: Dict = {"examples": {}, "plans": list(PLANS)}
+    run under each plan (an explicit per-plan breakdown row per
+    example), reduction ratios (apply run, the serving path — headline
+    plan is ``megafused``), and an output-identity verdict against the
+    serial unfused path. When a tracer is active the breakdown is also
+    embedded in the trace metadata, so ``perf_table.py --trace`` and the
+    telemetry CLI render the 2→1 reduction without spelunking the raw
+    trace."""
+    out: Dict = {"examples": {}, "plans": list(PLANS),
+                 "plan_breakdown": []}
     reductions: List[float] = []
+    mega_one = 0
     for name in examples:
         runs = {plan: measure_example(name, plan) for plan in PLANS}
         base = runs["serial_unfused"]
-        opt = runs["optimized"]
+        mega = runs["megafused"]
         outputs_match = True
         if check_outputs:
-            for r in (runs["legacy"], opt):
+            for r in (runs["legacy"], runs["optimized"], mega):
                 try:
                     np.testing.assert_allclose(
                         r["train_pred"], base["train_pred"],
@@ -226,9 +260,10 @@ def dispatch_count_report(
                         rtol=1e-5, atol=1e-5)
                 except AssertionError:
                     outputs_match = False
-        apply_ratio = (base["apply_run_programs"] / opt["apply_run_programs"]
-                       if opt["apply_run_programs"] else float("inf"))
+        apply_ratio = (base["apply_run_programs"] / mega["apply_run_programs"]
+                       if mega["apply_run_programs"] else float("inf"))
         reductions.append(apply_ratio)
+        mega_one += int(mega["apply_run_programs"] == 1)
         out["examples"][name] = {
             "apply_run_programs": {
                 p: runs[p]["apply_run_programs"] for p in PLANS},
@@ -237,12 +272,23 @@ def dispatch_count_report(
             "reduction_vs_serial_unfused": round(apply_ratio, 2),
             "reduction_vs_legacy": round(
                 runs["legacy"]["apply_run_programs"]
-                / max(1, opt["apply_run_programs"]), 2),
+                / max(1, mega["apply_run_programs"]), 2),
+            "reduction_vs_optimized": round(
+                runs["optimized"]["apply_run_programs"]
+                / max(1, mega["apply_run_programs"]), 2),
             "outputs_match_serial_unfused": bool(outputs_match),
         }
+        # the per-plan breakdown row: one flat record per example, the
+        # shape perf_table.py / the trace CLI print verbatim
+        out["plan_breakdown"].append({
+            "example": name,
+            **{p: runs[p]["apply_run_programs"] for p in PLANS},
+        })
     reductions.sort(reverse=True)
-    # the acceptance gate: at least two example pipelines drop >= 2x
+    # the acceptance gates: at least two example pipelines drop >= 2x,
+    # and (megafusion) at least two run their apply in ONE program
     out["examples_at_or_above_2x"] = int(sum(1 for r in reductions if r >= 2.0))
+    out["examples_at_one_program"] = int(mega_one)
     out["top2_min_reduction"] = round(min(reductions[:2]), 2) if len(
         reductions) >= 2 else None
     out["all_outputs_match"] = all(

@@ -28,6 +28,16 @@ class NormalizeRows(Transformer):
         norm = jnp.linalg.norm(x)
         return x / jnp.maximum(norm, self.eps)
 
+    def fuse(self):
+        # eps rides as a traced scalar; the batch form normalizes each
+        # ITEM (all axes but the leading) — identical to vmap(apply)
+        def fn(p, xb):
+            axes = tuple(range(1, xb.ndim))
+            norms = jnp.sqrt(jnp.sum(xb * xb, axis=axes, keepdims=True))
+            return xb / jnp.maximum(norms, p[0])
+
+        return (("NormalizeRows",), (jnp.float32(self.eps),), fn)
+
 
 class SignedHellingerMapper(Transformer):
 
@@ -36,6 +46,10 @@ class SignedHellingerMapper(Transformer):
 
     def apply(self, x):
         return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+    def fuse(self):
+        return (("SignedHellingerMapper",), (),
+                lambda p, x: jnp.sign(x) * jnp.sqrt(jnp.abs(x)))
 
 
 class Sampler(Transformer):

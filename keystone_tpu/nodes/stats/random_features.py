@@ -97,6 +97,13 @@ class RandomSignNode(Transformer):
     def apply(self, x):
         return x * self.signs
 
+    def fuse(self):
+        # signs ride as a traced param: every RandomSignNode of one dim
+        # shares ONE compiled program (and fused programs containing
+        # this stage keep a structural — not id-keyed — cache key)
+        return (("RandomSignNode",), (self.signs,),
+                lambda p, x: x * p[0])
+
 
 class PaddedFFT(Transformer):
     """Zero-pad to the next power of two and return the real part of the
@@ -109,6 +116,16 @@ class PaddedFFT(Transformer):
         n = x.shape[-1]
         padded = 1 << max(int(np.ceil(np.log2(n))), 0)
         return jnp.fft.rfft(x, n=padded).real[..., : padded // 2]
+
+    def fuse(self):
+        # shape-only state: the pad width derives from the traced input
+        # shape, so one static key serves every instance
+        def fn(p, x):
+            n = x.shape[-1]
+            padded = 1 << max(int(np.ceil(np.log2(n))), 0)
+            return jnp.fft.rfft(x, n=padded).real[..., : padded // 2]
+
+        return (("PaddedFFT",), (), fn)
 
 
 class LinearRectifier(Transformer):
@@ -123,3 +140,10 @@ class LinearRectifier(Transformer):
 
     def apply(self, x):
         return jnp.maximum(self.max_val, x - self.alpha)
+
+    def fuse(self):
+        # thresholds ride as traced scalars: rectifiers with different
+        # values share one compiled program
+        return (("LinearRectifier",),
+                (jnp.float32(self.max_val), jnp.float32(self.alpha)),
+                lambda p, x: jnp.maximum(p[0], x - p[1]))

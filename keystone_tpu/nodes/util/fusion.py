@@ -480,6 +480,15 @@ class FusedBatchTransformer(Transformer):
             with _WARMUP_LOCK:
                 _WARMUP_PENDING.pop(key, None)
 
+    def _chunk_loop(self, chunk_fn, params, xs, ms):
+        """The in-program loop over the stacked (n_chunks, chunk, ...)
+        axis. Base form: `lax.map` (sequential chunks, bounded HBM);
+        `MegafusedBatchTransformer` overrides with an explicit
+        ``lax.scan`` whose carry stays empty (params are closure-
+        invariant — the KJ007 discipline) and whose stacked output is
+        XLA's own donated accumulation buffer."""
+        return lax.map(lambda xm: chunk_fn(params, xm[0], xm[1]), (xs, ms))
+
     def _build_program(self, mesh, shards, padded_count, treedef, fns):
         local_n = padded_count // shards
         chunk = min(self.microbatch, local_n)
@@ -501,7 +510,7 @@ class FusedBatchTransformer(Transformer):
             xs = xs.reshape((n_chunks, chunk) + xs.shape[1:])
             ms = ms.reshape((n_chunks, chunk))
             # sequential chunks: bounded HBM
-            ys = lax.map(lambda xm: chunk_fn(params, xm[0], xm[1]), (xs, ms))
+            ys = self._chunk_loop(chunk_fn, params, xs, ms)
             ys = ys.reshape((padded_local,) + ys.shape[2:])
             return ys[:local_n]
 
@@ -528,3 +537,65 @@ class FusedBatchTransformer(Transformer):
         # chain's structure (_PROGRAM_CACHE / _instance_programs), so
         # this fresh closure compiles once per key, not once per call
         return jax.jit(fn)  # keystone: ignore[KJ006]
+
+
+class MegafusedBatchTransformer(FusedBatchTransformer):
+    """A whole-plan fused chain whose chunk loop is an in-program
+    ``lax.scan`` — the single donated XLA program of the megafusion
+    optimizer pass (workflow/fusion_rule.MegafusionRule).
+
+    Differences from the base `FusedBatchTransformer`:
+
+      - the per-shard microbatch loop is an explicit ``lax.scan`` over
+        the padded chunk axis (shape-stable: PR 5's padding contract
+        guarantees every trip sees the same chunk shape). Fit state is
+        captured as scan-invariant closure params — never threaded
+        through the carry, so model buffers are not doubled per trip
+        (the KJ007 discipline) — and per-chunk masks ride the scanned
+        axis so ``fuse_masks_output`` stages keep padded rows exact;
+      - the scan's stacked output is XLA's own donated accumulation
+        buffer (`ys` is written in place per trip); the carry is empty;
+      - dispatches are telemetry-visible: the program span carries
+        ``megafused=true`` and the scan trip count, and the
+        ``megafusion.programs`` / ``megafusion.scan_trips`` counters
+        feed the trace CLI's dispatch digest.
+    """
+
+    #: trace/span marker — also how tests and the memory model recognize
+    #: the one-program apply path
+    megafused = True
+
+    def _n_trips(self, padded_count: int, n_shards: int) -> int:
+        local_n = max(1, padded_count // max(1, n_shards))
+        chunk = min(self.microbatch, local_n)
+        return -(-local_n // chunk)
+
+    def _program_key(self, *args, **kwargs):
+        # a scan-bodied program must never collide with the base class's
+        # lax.map form in the shared structural cache
+        return ("megafused", super()._program_key(*args, **kwargs))
+
+    def apply_batch(self, data):
+        if not isinstance(data, Dataset):
+            return super().apply_batch(data)
+        from ...telemetry import counter, span
+
+        trips = self._n_trips(data.padded_count, data.n_shards)
+        with span("megafused_program", cat="node", megafused=True,
+                  scan_trips=trips, rows=data.count, label=self.label):
+            out = super().apply_batch(data)
+        counter("megafusion.programs").inc()
+        counter("megafusion.scan_trips").inc(trips)
+        return out
+
+    def _chunk_loop(self, chunk_fn, params, xs, ms):
+        # params are scan-INVARIANT closure captures: model state is
+        # read by every trip but never carried (carry stays empty), so
+        # the scan cannot double O(model) buffers per trip; XLA writes
+        # each trip's rows into the preallocated (donated) ys buffer
+        def trip(carry, xm):
+            xb, mb = xm
+            return carry, chunk_fn(params, xb, mb)
+
+        _, ys = lax.scan(trip, (), (xs, ms))
+        return ys

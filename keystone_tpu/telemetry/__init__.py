@@ -45,6 +45,7 @@ from .spans import (
 from .export import (
     aggregate_spans,
     compile_summary,
+    dispatch_plan_breakdown,
     dispatch_summary,
     load_trace,
     self_times,
@@ -68,8 +69,8 @@ __all__ = [
     "SpanRecord", "Tracer", "capabilities", "current_tracer",
     "record_capability", "set_tracer", "span", "telemetry_active",
     "trace_run",
-    "aggregate_spans", "compile_summary", "dispatch_summary",
-    "load_trace", "self_times",
+    "aggregate_spans", "compile_summary", "dispatch_plan_breakdown",
+    "dispatch_summary", "load_trace", "self_times",
     "summarize", "to_chrome_trace", "write_trace",
     "estimate_bytes", "instrument_node_force", "record_dispatch",
     "compiles_snapshot", "install_compile_listeners",

@@ -144,9 +144,33 @@ def dispatch_summary(trace: Dict[str, Any]) -> Optional[str]:
     sched = counters.get("dispatch.scheduler_runs", {}).get("value", 0)
     tasks = counters.get("dispatch.scheduled_tasks", {}).get("value", 0)
     forces = counters.get("executor.node_forces", {}).get("value", 0)
-    return (f"programs executed: {int(programs)} "
+    line = (f"programs executed: {int(programs)} "
             f"(node forces {int(forces)}; concurrent scheduler ran "
             f"{int(sched)}x over {int(tasks)} task(s))")
+    mega = counters.get("megafusion.programs", {}).get("value", 0)
+    if mega:
+        trips = counters.get("megafusion.scan_trips", {}).get("value", 0)
+        line += (f"; megafused: {int(mega)} program(s), "
+                 f"{int(trips)} in-program scan trip(s)")
+    return line
+
+
+def dispatch_plan_breakdown(trace: Dict[str, Any]) -> List[str]:
+    """Per-plan apply-run program rows from the trace metadata the
+    dispatch bench embeds (``keystone.dispatch_plans``): one line per
+    example, ``serial_unfused/legacy/optimized/megafused`` columns — the
+    2→1 reduction readable straight off ``perf_table.py --trace`` / the
+    telemetry CLI. Empty when the trace predates the breakdown."""
+    plans_meta = trace.get("keystone", {}).get("dispatch_plans") or {}
+    per_example = plans_meta.get("apply_run_programs") or {}
+    plans = plans_meta.get("plans") or []
+    lines = []
+    for example in sorted(per_example):
+        row = per_example[example]
+        cols = " ".join(
+            f"{p}={row[p]}" for p in (plans or sorted(row)) if p in row)
+        lines.append(f"apply programs/run [{example}]: {cols}")
+    return lines
 
 
 def compile_summary(trace: Dict[str, Any]) -> Optional[str]:
@@ -223,10 +247,12 @@ def summarize(trace: Dict[str, Any], top: int = 15) -> str:
     counters = ks.get("metrics", {}).get("counters", {})
     dispatch = dispatch_summary(trace)
     compiles = compile_summary(trace)
-    if dispatch or compiles:
+    breakdown = dispatch_plan_breakdown(trace)
+    if dispatch or compiles or breakdown:
         lines.append("\n== dispatch ==")
         if dispatch:
             lines.append(dispatch)
+        lines.extend(breakdown)
         if compiles:
             lines.append(compiles)
     moved = counters.get("overlap.bytes_pulled", {}).get("value")

@@ -335,6 +335,154 @@ def _stream_overlapped(
         staged.close()  # early exit / batch_fn failure cancels the producer
 
 
+# --------------------------------------------------------------------------
+# Megafused host dispatch: one program per bucket, chunk loop in-program
+#
+# With shape-stable padding (PR 5) every chunk of a bucket shares ONE
+# leading-dim shape, so the per-chunk dispatch loop can move INSIDE the
+# program: stack the bucket's padded chunks into a (n_chunks, pad, ...)
+# array and run a single jitted `lax.scan` over the chunk axis. On a
+# high-RTT link that turns ceil(n/chunk) round trips into one. The
+# stacked input is freshly built here and owned by nobody else, so it IS
+# donated to XLA (on backends that honor donation). Ineligible cases —
+# single-chunk buckets, non-traceable (host-code) batch fns, padding off
+# — keep the overlapped host-staging path unchanged.
+
+#: id(batch_fn) -> (batch_fn strong ref, jitted scan program). Strong
+#: refs on purpose: an id-keyed entry must never outlive its function
+#: (GC id reuse would silently run the wrong program).
+_MEGAFUSED_SCANNERS: dict = {}
+
+#: id(batch_fn) -> batch_fn for fns whose scan trace failed once (host
+#: code behind a jit-like facade): permanently back on the per-chunk
+#: path. The strong ref pins the id so GC reuse can never exclude an
+#: unrelated (traceable) fn; membership is identity-checked.
+_MEGAFUSED_REJECTED: dict = {}
+
+#: Cap on chunks stacked into one scan program. Bounds the megafused
+#: path's residency at ~2 × trips × chunk rows (stacked input + scanned
+#: output) instead of a whole bucket — a 10⁵-item bucket still streams,
+#: it just does so 64 chunks per dispatch instead of one.
+_MEGAFUSED_MAX_TRIPS = 64
+
+
+def _megafused_scanner(batch_fn):
+    ent = _MEGAFUSED_SCANNERS.get(id(batch_fn))
+    if ent is not None and ent[0] is batch_fn:
+        return ent[1]
+    import jax
+    from jax import lax
+
+    def scan_all(stack):
+        return lax.scan(lambda c, xb: (c, batch_fn(xb)), (), stack)[1]
+
+    # CPU ignores donation (and warns); only donate where XLA honors it
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    # identity-memoized in _MEGAFUSED_SCANNERS: one compile per batch_fn
+    jitted = jax.jit(scan_all, donate_argnums=donate)  # keystone: ignore[KJ006]
+    if len(_MEGAFUSED_SCANNERS) >= 512:
+        # bound the cache: evict the oldest entries (a dropped scanner
+        # just re-jits next time, warm from the persistent cache)
+        for stale in list(_MEGAFUSED_SCANNERS)[:256]:
+            _MEGAFUSED_SCANNERS.pop(stale, None)
+    _MEGAFUSED_SCANNERS[id(batch_fn)] = (batch_fn, jitted)
+    return jitted
+
+
+def _megafusable_batch_fn(batch_fn) -> bool:
+    """Only jax-jitted callables (they expose ``lower``/``trace``) are
+    provably traceable under the scan; arbitrary host callables would
+    need a speculative trace whose side effects we cannot undo."""
+    return (hasattr(batch_fn, "lower")
+            and _MEGAFUSED_REJECTED.get(id(batch_fn)) is not batch_fn)
+
+
+def _megafused_groups(items, plan):
+    """Group plan entries into per-bucket stack runs: ``(entries,
+    stackable)`` where ``stackable`` means >= 2 chunks sharing one
+    padded width (the shape-stable contract megafusion scans over).
+    Bucket runs are split at ``_MEGAFUSED_MAX_TRIPS`` chunks so one
+    program never stacks an unbounded bucket (the residency cap)."""
+    def shape_of(i):
+        x = items[i]
+        return x.shape if hasattr(x, "shape") else np.asarray(x).shape
+
+    buckets: List[List] = []
+    by_shape: dict = {}
+    for part, pad_to in plan:
+        key = shape_of(part[0])
+        if key in by_shape:
+            by_shape[key].append((part, pad_to))
+        else:
+            by_shape[key] = [(part, pad_to)]
+            buckets.append(by_shape[key])
+    groups: List[Tuple[List, bool]] = []
+    for entries in buckets:
+        for i in range(0, len(entries), _MEGAFUSED_MAX_TRIPS):
+            run = entries[i:i + _MEGAFUSED_MAX_TRIPS]
+            groups.append(
+                (run, len(run) > 1 and len({p for _, p in run}) == 1))
+    return groups
+
+
+def _fallback_stream(items, entries, batch_fn):
+    """The pre-megafusion dispatch for a group of plan entries: the
+    overlapped host-staging path when the engine is on, serial
+    otherwise — exactly what `map_host_batched_stream` would have
+    chosen without megafusion."""
+    from ..workflow.env import execution_config
+
+    cfg = execution_config()
+    if cfg.overlap and len(entries) > 1:
+        return _stream_overlapped(items, entries, batch_fn,
+                                  cfg.prefetch_depth)
+    return _stream_serial(items, entries, batch_fn)
+
+
+def _stream_megafused(
+    items, groups, batch_fn
+) -> Iterator[Tuple[List[int], List]]:
+    """One scan-bodied program per stackable chunk-run; leftover
+    single-chunk runs dispatch on the ordinary path (they are already
+    one program each). Yields the standard ``(indices, results)`` chunk
+    contract — padded phantom rows never surface."""
+    for entries, stackable in groups:
+        # the rejection re-check matters mid-stream: a trace failure on
+        # an earlier group must not be retried on every later one
+        if not stackable or not _megafusable_batch_fn(batch_fn):
+            yield from _fallback_stream(items, entries, batch_fn)
+            continue
+        trips = len(entries)
+        rows = sum(len(part) for part, _ in entries)
+        with span("chunk_megafused", cat="chunk", megafused=True,
+                  scan_trips=trips, rows=rows):
+            try:
+                # the launch: trace refusals (host code behind a jit
+                # facade), stack failures, and launch-time errors all
+                # surface HERE, before anything is counted — the
+                # fallback re-dispatches with nothing double-counted
+                stack = np.stack([_stack_chunk(items, part, pad_to)
+                                  for part, pad_to in entries])
+                ys = _megafused_scanner(batch_fn)(_device_put_host(stack))
+            except Exception:
+                # permanently back to per-chunk for this fn, overlapped
+                # staging included
+                _MEGAFUSED_REJECTED[id(batch_fn)] = batch_fn
+                yield from _fallback_stream(items, entries, batch_fn)
+                continue
+            record_dispatch()  # the whole run is ONE launched program
+            # in-order drain of the single result — the sanctioned
+            # pull, exactly like _split_result's. A failure HERE is a
+            # genuine runtime failure of a launched program and
+            # propagates, exactly as the per-chunk path's pull would.
+            res = np.asarray(ys)  # keystone: ignore[KJ005]
+        counter("overlap.bytes_pulled").inc(float(res.nbytes))
+        counter("megafusion.programs").inc()
+        counter("megafusion.scan_trips").inc(trips)
+        for c, (part, _) in enumerate(entries):
+            yield part, [res[c, j] for j in range(len(part))]
+
+
 #: sentinel: "use `ExecutionConfig.chunk_size`" — distinct from None,
 #: which keeps its historical meaning of one chunk per shape bucket.
 USE_CONFIG_CHUNK = object()
@@ -370,6 +518,15 @@ def map_host_batched_stream(
 
     cfg = execution_config()
     plan = _plan_chunks(items, chunk, pad=cfg.pad_chunks)
+    if (cfg.megafusion and cfg.pad_chunks and len(plan) > 1
+            and _megafusable_batch_fn(batch_fn)):
+        groups = _megafused_groups(items, plan)
+        if any(s for _, s in groups):
+            # shape-stable multi-chunk runs + a traceable batch fn: the
+            # chunk loop moves in-program (one scan-bodied dispatch per
+            # run, residency capped at _MEGAFUSED_MAX_TRIPS chunks).
+            # Ineligible plans keep the overlapped staging path.
+            return _stream_megafused(items, groups, batch_fn)
     if cfg.overlap and len(plan) > 1:
         return _stream_overlapped(items, plan, batch_fn, cfg.prefetch_depth)
     return _stream_serial(items, plan, batch_fn)

@@ -105,6 +105,19 @@ class ExecutionConfig:
     that path; ``0``/``off``/``false`` → disabled. Compile activity is
     measured either way (``dispatch.programs_compiled``, see
     `keystone_tpu.telemetry.compile_events`).
+
+    ``megafusion`` (default on; env ``KEYSTONE_MEGAFUSION=0`` reverts to
+    the PR-4/5 plan) turns on whole-plan megafusion: when a fitted
+    pipeline's apply plan is a fan-out-free chain of fusable stages
+    whose chunks are shape-stable (the ``pad_chunks`` contract), the
+    optimizer's `MegafusionRule` collapses the ENTIRE apply path —
+    featurize → scale → linear → argmax, *including the chunk loop as an
+    in-program ``lax.scan``* — into one donated XLA program
+    (`MegafusedPlanOperator`), and the host batcher hands a bucket's
+    whole padded chunk stack to one scan-bodied program instead of
+    dispatching per chunk. Ineligible plans (streaming single-consumer
+    stages, host-code stages, fan-out) keep the per-program dispatch
+    path and `validate()` says why (KP401).
     """
 
     overlap: bool = True
@@ -117,6 +130,7 @@ class ExecutionConfig:
     pad_chunks: bool = True
     aot_warmup: bool = True
     compile_cache_dir: Optional[str] = None
+    megafusion: bool = True
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -217,6 +231,8 @@ def execution_config() -> ExecutionConfig:
             aot_warmup=os.environ.get("KEYSTONE_AOT_WARMUP", "1").lower()
             not in _OFF,
             compile_cache_dir=_env_compile_cache_dir(),
+            megafusion=os.environ.get("KEYSTONE_MEGAFUSION", "1").lower()
+            not in _OFF,
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

@@ -125,6 +125,13 @@ class GraphExecutor:
         self._static_recorded = False
         self._warmed = False
         self._concurrent_wrapped: set = set()
+        # AOT warmup re-arm state: fused-chain programs whose estimator
+        # slots had not resolved when the warm scan ran (see
+        # `_rearm_warmup`). Appended from the scan thread, drained from
+        # whichever thread notices the fits resolved.
+        self._warm_pending: List[dict] = []
+        self._warm_est_watch: set = set()
+        self._warm_lock = threading.Lock()
 
     @property
     def graph(self) -> Graph:
@@ -212,9 +219,12 @@ class GraphExecutor:
         (`analysis.propagate.spec_pass` — the data graph is bound, so
         DatasetOperators carry real shapes). Covered: fused transformer
         chains whose input spec is a known on-device dataset, and
-        `FusedChainOperator`s whose estimator slots already resolved to
-        forced saved state (the re-apply/serving path) — a chain whose
-        fits have not run yet has no stage params to compile against.
+        `FusedChainOperator`s / `MegafusedPlanOperator`s whose estimator
+        slots already resolved to forced saved state (the re-apply /
+        serving path). A chain whose fits have NOT run yet is parked in
+        ``_warm_pending`` and re-armed by `_rearm_warmup` the moment fit
+        substitution completes, so the serving path is warm on its first
+        force instead of being skipped for the executor's lifetime.
         Warmup must never break execution: every failure is swallowed
         (the force would just compile inline, exactly as without it)."""
         if self._warmed:
@@ -235,10 +245,11 @@ class GraphExecutor:
                 from .fusion_rule import FusedChainOperator
                 from .operators import ExpressionOperator
 
+                _PENDING = "pending"
+
                 def warm_target(op, deps):
-                    """(fused transformer, data dependency) or None."""
-                    if isinstance(op, FusedBatchTransformer):
-                        return (op, deps[0]) if len(deps) == 1 else None
+                    """('ready', transformer, data dep) |
+                    ('pending', chain op, est deps, data dep) | None."""
                     if isinstance(op, FusedChainOperator) and deps:
                         fitted = []
                         for est_dep in deps[:-1]:
@@ -247,33 +258,91 @@ class GraphExecutor:
                             eop = graph.get_operator(est_dep)
                             if not (isinstance(eop, ExpressionOperator)
                                     and eop.expression.is_forced):
-                                return None
+                                # fits unresolved at scan time: parked,
+                                # re-armed once the fits force
+                                return (_PENDING, op,
+                                        tuple(deps[:-1]), deps[-1])
                             fitted.append(eop.expression.get)
                         mat = op.materialize(fitted)
                         if isinstance(mat, FusedBatchTransformer):
-                            return mat, deps[-1]
+                            return ("ready", mat, deps[-1])
+                        return None
+                    if isinstance(op, FusedBatchTransformer):
+                        return ("ready", op, deps[0]) \
+                            if len(deps) == 1 else None
                     return None
 
-                targets = []
+                targets, parked = [], []
                 for vid in graph.operators:
                     t = warm_target(graph.get_operator(vid),
                                     graph.get_dependencies(vid))
-                    if t is not None:
-                        targets.append(t)
-                if not targets:
+                    if t is None:
+                        continue
+                    (targets if t[0] == "ready" else parked).append(t[1:])
+                if not targets and not parked:
                     return
                 specs, _ = spec_pass(graph, {})
-                for op, data_dep in targets:
+
+                def data_spec(data_dep):
                     s = specs.get(data_dep)
-                    if not (isinstance(s, DataSpec)
-                            and s.kind == "dataset" and s.on_device
-                            and is_known(s.element) and s.count):
+                    if (isinstance(s, DataSpec) and s.kind == "dataset"
+                            and s.on_device and is_known(s.element)
+                            and s.count):
+                        return s
+                    return None
+
+                for op, data_dep in targets:
+                    s = data_spec(data_dep)
+                    if s is not None:
+                        _submit_warmup(op, s.element, s.count)
+                for op, est_deps, data_dep in parked:
+                    s = data_spec(data_dep)
+                    if s is None:
                         continue
-                    _submit_warmup(op, s.element, s.count)
+                    with self._warm_lock:
+                        self._warm_pending.append({
+                            "op": op, "est_deps": est_deps,
+                            "element": s.element, "count": s.count,
+                        })
+                        self._warm_est_watch.update(est_deps)
             except Exception:
                 pass
 
         _spawn_warm_thread(scan_and_warm, "keystone-aot-warmup-scan")
+
+    def _rearm_warmup(self) -> None:
+        """Re-arm AOT warmup for fused-chain programs whose estimator
+        slots resolved AFTER the warm scan ran: once every watched fit
+        expression is forced, materialize the chain against the fitted
+        transformers and submit its compile — so a re-apply through this
+        executor (and the first force after concurrent fits complete)
+        dispatches into a warm executable. Cheap when nothing is
+        pending; never raises."""
+        if not self._warm_pending:
+            return
+        if not execution_config().aot_warmup:
+            return
+        from ..nodes.util.fusion import FusedBatchTransformer
+        from .expressions import TransformerExpression
+
+        with self._warm_lock:
+            pending, self._warm_pending = self._warm_pending, []
+        still: List[dict] = []
+        for ent in pending:
+            exprs = [self._memo.get(d) for d in ent["est_deps"]]
+            if all(isinstance(e, TransformerExpression) and e.is_forced
+                   for e in exprs):
+                try:
+                    mat = ent["op"].materialize([e.get for e in exprs])
+                    if isinstance(mat, FusedBatchTransformer):
+                        _submit_warmup(mat, ent["element"], ent["count"])
+                except Exception:
+                    pass
+            else:
+                still.append(ent)
+        if still:
+            with self._warm_lock:
+                self._warm_pending.extend(still)
 
     def execute(self, graph_id: GraphId) -> Expression:
         """Execute up to ``graph_id``, returning its lazy Expression
@@ -281,6 +350,7 @@ class GraphExecutor:
         graph, prefixes = self._optimized_plan()
         self._check_structure(graph)
         self._warm_plan(graph)
+        self._rearm_warmup()  # fits may have resolved since the scan
         env = PipelineEnv.get()
         profiler = getattr(env, "profiler", None)
         from ..telemetry import counter, current_tracer
@@ -491,6 +561,11 @@ class GraphExecutor:
                         self._memo[v].get
                     except BaseException as e:  # recorded, raised in order
                         err = e
+                    if err is None and v in self._warm_est_watch:
+                        # a watched fit just resolved: re-arm the parked
+                        # chain warmup so its compile overlaps the rest
+                        # of the schedule instead of the first force
+                        self._rearm_warmup()
                     with cond:
                         outstanding -= 1
                         if err is not None:

@@ -32,7 +32,7 @@ is independent of node-id iteration order.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 from .analysis import children
 from .expressions import (
@@ -101,24 +101,35 @@ class FusedChainOperator(Operator):
         """Dependency indices that consume estimator outputs (KP003)."""
         return tuple(range(self.n_fits))
 
+    #: display prefix + runnable class hook, overridden by
+    #: `MegafusedPlanOperator` (same fit-slot resolution and fallback,
+    #: different compiled form)
+    _label_prefix = "Fused"
+
+    def _fused_cls(self):
+        from ..nodes.util.fusion import FusedBatchTransformer
+
+        return FusedBatchTransformer
+
     @property
     def label(self) -> str:
-        return "Fused[" + " >> ".join(
+        return self._label_prefix + "[" + " >> ".join(
             repr(s) if isinstance(s, _FitSlot) else s.label
             for s in self.stage_specs) + "]"
 
     def materialize(self, fitted: Sequence):
         """Resolve `_FitSlot`s against ``fitted`` (one TransformerOperator
         per estimator dependency, in order) and build the runnable fused
-        transformer. Shared by force-time execution and `Pipeline.fit`'s
+        transformer; if a fit unexpectedly yielded a non-traceable
+        transformer, degrade to sequential per-stage dispatch — same
+        values. Shared by force-time execution and `Pipeline.fit`'s
         estimator substitution."""
-        from ..nodes.util.fusion import FusedBatchTransformer
         from .pipeline import TransformerChain
 
         stages = [fitted[s.index] if isinstance(s, _FitSlot) else s
                   for s in self.stage_specs]
         if all(getattr(s, "fusable", False) for s in stages):
-            return FusedBatchTransformer(stages, microbatch=self.microbatch)
+            return self._fused_cls()(stages, microbatch=self.microbatch)
         return TransformerChain(stages)
 
     def abstract_eval(self, in_specs: List) -> object:
@@ -202,6 +213,295 @@ class FusedChainOperator(Operator):
             return StreamingDatasetExpression(
                 lambda: _streamed_batch(make(), data))
         return DatasetExpression(lambda: make().batch_transform([data.get]))
+
+
+class MegafusedPlanOperator(FusedChainOperator):
+    """A whole plan collapsed to ONE donated XLA program.
+
+    Produced by `MegafusionRule` when the apply plan is a fan-out-free
+    chain of fusable members — `FusedBatchTransformer` stages,
+    `FusedChainOperator`s (their fit slots re-indexed into this
+    operator's combined estimator dependency list), bare fusable
+    transformers, and `Cacher` passthroughs (absorbed: inside one
+    program there is no intermediate to pin). Forcing materializes a
+    `MegafusedBatchTransformer`, whose chunk loop is an in-program
+    ``lax.scan`` over the shape-stable padded chunks (PR 5's contract)
+    with fit state as scan-invariant closure params — so the entire
+    apply run, *including the chunk loop*, is one executed program.
+    """
+
+    _label_prefix = "Megafused"
+
+    def _fused_cls(self):
+        from ..nodes.util.fusion import MegafusedBatchTransformer
+
+        return MegafusedBatchTransformer
+
+    def scan_live_nbytes(self, dep_specs: Sequence, chunk_rows: int):
+        """Static size of the scan's in-program live set: one chunk's
+        input plus its largest stage boundary — the carry-side residency
+        the KP2xx memory model prices INSTEAD of materialized
+        intermediates (which never exist inside the program). Returns
+        None when any boundary element is unknown."""
+        from ..analysis.specs import (
+            DataSpec,
+            TransformerSpec,
+            element_nbytes,
+            is_known,
+            trace_element,
+        )
+
+        if not dep_specs:
+            return None
+        t_specs, data_spec = dep_specs[:-1], dep_specs[-1]
+        if not isinstance(data_spec, DataSpec):
+            return None
+        elem = data_spec.element
+        boundary_nbytes = []
+        for s in self.stage_specs:
+            if not is_known(elem):
+                return None
+            per_item = element_nbytes(elem)
+            if per_item is None:
+                return None
+            boundary_nbytes.append(per_item)
+            try:
+                if isinstance(s, _FitSlot):
+                    ts = t_specs[s.index]
+                    if not isinstance(ts, TransformerSpec):
+                        return None
+                    elem = ts.apply_element(elem)
+                else:
+                    elem = trace_element(
+                        lambda x, s=s: s.single_transform([x]), (elem,))
+            except Exception:
+                return None
+        out_nbytes = element_nbytes(elem)
+        if out_nbytes is None:
+            return None
+        boundary_nbytes.append(out_nbytes)
+        # per trip: a chunk's input boundary + output boundary live at
+        # once; the largest adjacent pair bounds the in-scan live set
+        worst = max(
+            boundary_nbytes[i] + boundary_nbytes[i + 1]
+            for i in range(len(boundary_nbytes) - 1))
+        return int(worst * chunk_rows)
+
+
+class MegafusionRule(Rule):
+    """Whole-plan megafusion: collapse a fan-out-free chain of fused
+    members into one `MegafusedPlanOperator` (ONE executed program per
+    apply run — the whole-program-offload endpoint of arXiv 1810.09868).
+
+    Runs after `NodeFusionRule`, whose output plan is already maximally
+    node-fused: what remains are the chain of fused super-nodes the
+    earlier pass cannot merge (a `FusedBatchTransformer` followed by a
+    `FusedChainOperator`, optionally with `Cacher` passthroughs between
+    them). Members must consume each other as their single DATA input;
+    a fan-out, a host-code (non-fusable) stage, or a stream-producing
+    stage terminates the chain — those plans keep the PR-4/5 per-program
+    dispatch path, and `validate()`'s KP401 diagnostics say why.
+
+    `ExecutionConfig.megafusion` (env ``KEYSTONE_MEGAFUSION``, default
+    on) is read at optimization time; off reverts to the PR-4/5 plan
+    exactly.
+    """
+
+    def __init__(self, microbatch: int = 2048):
+        self.microbatch = microbatch
+
+    # ---------------------------------------------------- member predicate
+
+    @staticmethod
+    def _member_kind(graph: Graph, node: NodeId):
+        """'chain' (fit-slot carrier), 'stage' (plain fusable), 'cache'
+        (identity passthrough), or None (terminates megafusion)."""
+        from ..nodes.util.basic import Cacher
+        from .operators import TransformerOperator
+
+        op = graph.get_operator(node)
+        deps = graph.get_dependencies(node)
+        if isinstance(op, FusedChainOperator):
+            return "chain"
+        if isinstance(op, Cacher) and len(deps) == 1:
+            return "cache"
+        if isinstance(op, TransformerOperator) \
+                and getattr(op, "fusable", False) and len(deps) == 1:
+            return "stage"
+        return None
+
+    @staticmethod
+    def _data_dep(graph: Graph, node: NodeId):
+        deps = graph.get_dependencies(node)
+        if isinstance(graph.get_operator(node), FusedChainOperator):
+            return deps[-1]
+        return deps[0]
+
+    @staticmethod
+    def _is_plan_input(graph: Graph, dep) -> bool:
+        """True when ``dep`` is the plan's own input — an unbound
+        source, bound data, or spliced saved state — rather than a
+        mid-plan producer node. A single fused chain consuming the plan
+        input IS the whole apply path, so it is promoted to the
+        scan-bodied megafused form even with nothing left to merge."""
+        from .graph import SourceId
+        from .operators import DatasetOperator, DatumOperator
+
+        if isinstance(dep, SourceId):
+            return True
+        if not isinstance(dep, NodeId):
+            return False
+        op = graph.get_operator(dep)
+        return isinstance(
+            op, (DatasetOperator, DatumOperator, ExpressionOperator))
+
+    # ------------------------------------------------------------ rewrite
+
+    def apply(self, plan: Plan) -> Plan:
+        from .env import execution_config
+
+        if not execution_config().megafusion:
+            return plan  # kill switch: the PR-4/5 plan, bit for bit
+        graph, prefixes = plan
+        visited: set = set()
+        chains: List[List[NodeId]] = []
+        for node in sorted(graph.operators, key=lambda n: n.id):
+            if node in visited or self._member_kind(graph, node) is None:
+                continue
+            head = node
+            while True:
+                dep = self._data_dep(graph, head)
+                if (isinstance(dep, NodeId)
+                        and self._member_kind(graph, dep) is not None
+                        and len(children(graph, dep)) == 1):
+                    head = dep
+                else:
+                    break
+            chain = [head]
+            cur = head
+            while True:
+                kids = children(graph, cur)
+                if len(kids) != 1:
+                    break
+                (kid,) = kids
+                if (isinstance(kid, NodeId)
+                        and self._member_kind(graph, kid) is not None
+                        and self._data_dep(graph, kid) == cur):
+                    chain.append(kid)
+                    cur = kid
+                else:
+                    break
+            visited.update(chain)
+            # a merge of >= 2 PROGRAM-bearing members removes a
+            # dispatch; a [stage, Cacher] pair would only forfeit the
+            # cache point. A single fitted chain consuming the plan
+            # input is ALSO rewritten — it is the whole apply path, and
+            # promotion moves its chunk loop in-program (scan body).
+            kinds = [self._member_kind(graph, n) for n in chain]
+            programs = sum(1 for k in kinds if k != "cache")
+            whole_plan_single = (
+                len(chain) == 1 and kinds[0] == "chain"
+                and self._is_plan_input(
+                    graph, self._data_dep(graph, chain[0])))
+            if (len(chain) >= 2 and programs >= 2) or whole_plan_single:
+                chains.append(chain)
+
+        for chain in chains:
+            if any(n not in graph.operators for n in chain):
+                continue
+            head_data_dep = self._data_dep(graph, chain[0])
+            est_deps: List = []
+            stage_specs: List = []
+            for n in chain:
+                kind = self._member_kind(graph, n)
+                op = graph.get_operator(n)
+                if kind == "chain":
+                    base = len(est_deps)
+                    est_deps.extend(graph.get_dependencies(n)[:-1])
+                    for s in op.stage_specs:
+                        stage_specs.append(
+                            _FitSlot(base + s.index)
+                            if isinstance(s, _FitSlot) else s)
+                elif kind == "cache":
+                    continue  # identity inside one program: nothing to pin
+                else:
+                    stage_specs.append(op)
+            fused = MegafusedPlanOperator(
+                stage_specs, microbatch=self.microbatch)
+            graph = graph.set_operator(chain[0], fused)
+            graph = graph.replace_dependency(chain[-1], chain[0])
+            graph = graph.set_dependencies(
+                chain[0], tuple(est_deps) + (head_data_dep,))
+            for n in reversed(chain[1:]):
+                graph = graph.set_dependencies(n, ())
+                graph = graph.remove_node(n)
+            # EVERY member's saveable prefix goes, the head's included:
+            # the head node now holds the megafused operator, and saving
+            # the whole-chain output under the original head's prefix
+            # (e.g. an absorbed Cacher's) would hand later pipelines the
+            # wrong value through SavedStateLoadRule
+            for n in chain:
+                prefixes.pop(n, None)
+        return graph, prefixes
+
+
+def megafusion_blockers(graph: Graph) -> List[Tuple[NodeId, str, str]]:
+    """Why a plan cannot collapse to one program: ``(vertex, label,
+    reason)`` triples over the node-fused plan, reported only for
+    blockers ADJACENT to an otherwise-fusable member (the informative
+    fallbacks — a host-only pipeline is not megafusion's business).
+    Consumed by the analyzer's KP401 diagnostics so `validate()`
+    explains fallbacks."""
+    from ..analysis.hazards import _is_stream_origin
+    from .operators import TransformerOperator
+
+    fused_graph = NodeFusionRule().apply((graph, {}))[0]
+    kinds = {
+        n: MegafusionRule._member_kind(fused_graph, n)
+        for n in fused_graph.operators
+    }
+
+    def neighbors(node):
+        out = [d for d in fused_graph.get_dependencies(node)
+               if isinstance(d, NodeId)]
+        out.extend(u for u in children(fused_graph, node)
+                   if isinstance(u, NodeId))
+        return out
+
+    blockers: List[Tuple[NodeId, str, str]] = []
+    for node in sorted(fused_graph.operators, key=lambda n: n.id):
+        op = fused_graph.get_operator(node)
+        if kinds.get(node) is not None:
+            kids = [k for k in children(fused_graph, node)
+                    if isinstance(k, NodeId) and kinds.get(k) is not None]
+            all_kids = children(fused_graph, node)
+            if len(all_kids) > 1 and kids:
+                blockers.append((node, op.label, (
+                    f"fan-out ({len(all_kids)} consumers) terminates the "
+                    "megafused chain here; each branch dispatches its own "
+                    "program")))
+            continue
+        if not any(kinds.get(nb) is not None for nb in neighbors(node)):
+            continue  # not interrupting a fusable chain: not informative
+        if _is_stream_origin(op):
+            blockers.append((node, op.label, (
+                "stream-producing host stage stays on the overlapped "
+                "host-staging path; the single-program plan can only "
+                "start downstream of it")))
+        elif isinstance(op, DelegatingOperator):
+            deps = fused_graph.get_dependencies(node)
+            if deps and NodeFusionRule._est_fusable(fused_graph, deps[0]):
+                continue  # fusable fit, just nothing adjacent to merge
+            blockers.append((node, op.label, (
+                "estimator apply boundary is not provably fusable (the "
+                "estimator does not declare fusable_fit); the fitted "
+                "stage dispatches its own program")))
+        elif isinstance(op, TransformerOperator) \
+                and not getattr(op, "fusable", False):
+            blockers.append((node, op.label, (
+                "host-code stage (fusable=False) cannot enter a single "
+                "XLA program; the chain splits around it")))
+    return blockers
 
 
 class NodeFusionRule(Rule):

@@ -253,8 +253,9 @@ class DefaultOptimizer(Optimizer):
     once) plus the TPU-native stage-fusion pass (see fusion_rule.py)."""
 
     def __init__(self, samples_per_shard: int = 3, fuse: bool = True,
-                 fusion_microbatch: int = 2048, fuse_apply: bool = True):
-        from .fusion_rule import NodeFusionRule
+                 fusion_microbatch: int = 2048, fuse_apply: bool = True,
+                 megafuse: bool = True):
+        from .fusion_rule import MegafusionRule, NodeFusionRule
 
         self._batches = [
             Batch(
@@ -267,8 +268,17 @@ class DefaultOptimizer(Optimizer):
             # fuse_apply=False reproduces the PR-3 plan (transformer
             # chains only, no fusion through estimator apply boundaries)
             # — the dispatch-count bench's "legacy" baseline
-            self._batches.append(Batch("fuse", [
-                NodeFusionRule(fusion_microbatch, fuse_apply=fuse_apply)]))
+            fuse_rules: List[Rule] = [
+                NodeFusionRule(fusion_microbatch, fuse_apply=fuse_apply)]
+            if fuse_apply and megafuse:
+                # whole-plan megafusion rides AFTER node fusion: it
+                # merges the fused super-nodes the linear pass leaves
+                # behind into ONE scan-bodied program. Gated twice: the
+                # constructor flag builds the PR-4/5 optimizer exactly,
+                # and the rule itself reads `ExecutionConfig.megafusion`
+                # (KEYSTONE_MEGAFUSION) at optimization time.
+                fuse_rules.append(MegafusionRule(fusion_microbatch))
+            self._batches.append(Batch("fuse", fuse_rules))
         self._batches.append(Batch("node-opt", [NodeOptimizationRule(samples_per_shard)]))
 
     @property

@@ -48,6 +48,16 @@ system cannot (see ANALYSIS.md for the full catalog):
          Cache the jitted fn at module level, on the instance
          (``self.__dict__['_jitted']``), or in an explicit program
          cache keyed on structure (``nodes/util/fusion``).
+  KJ007  scan-carry-realloc (under ``workflow/`` and ``nodes/``): a
+         ``lax.scan``/``lax.fori_loop`` body that rebuilds a carried
+         buffer with an allocating/copying jnp call (``concatenate``,
+         ``stack``, ``pad``, ``tile``, ...) and no in-place update
+         pattern. XLA donates the scan carry between trips ONLY when
+         the body updates it in place (``lax.dynamic_update_slice``,
+         ``.at[...].set``) — a grow/copy carry silently doubles
+         O(model) state every trip, exactly what the megafused
+         single-program apply path must never do. Scan-invariant model
+         state belongs in the closure, not the carry.
 
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
@@ -78,6 +88,9 @@ RULES = {
     "KJ006": "jax.jit of a freshly constructed closure/lambda in a loop "
              "or per-call scope (recompiles every call; cache the "
              "jitted fn)",
+    "KJ007": "lax.scan/fori_loop carry rebuilt by an allocating jnp call "
+             "with no in-place update (dynamic_update_slice / .at[].set) "
+             "— the carry buffer reallocates O(model) state every trip",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -364,6 +377,134 @@ def _check_fresh_jit(tree: ast.AST, path: str) -> Iterator[Finding]:
                     "explicit program cache)")
 
 
+#: jnp calls that ALLOCATE a fresh (usually grown or copied) buffer —
+#: a carry rebuilt through one of these reallocates every scan trip.
+_CARRY_ALLOC_CALLS = {
+    "concatenate", "stack", "vstack", "hstack", "dstack", "append",
+    "pad", "tile", "repeat", "copy",
+}
+#: in-place carry-update spellings that let XLA donate the carry buffer
+#: between trips.
+_INPLACE_UPDATE_ATTRS = {
+    "dynamic_update_slice", "dynamic_update_index_in_dim", "set", "add",
+}
+
+
+def _scope_walk(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk a scope's own statements WITHOUT descending into nested
+    function/lambda bodies (the nested defs themselves are yielded, so
+    callers can collect them as this scope's local names)."""
+    stack = (list(scope.body)
+             if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Module))
+             else list(ast.iter_child_nodes(scope)))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scan_bodies(tree: ast.AST) -> Iterator:
+    """Yield ``(call_node, body_fn_node, carry_param_index)`` for every
+    ``lax.scan(body, ...)`` / ``lax.fori_loop(lo, hi, body, init)`` call
+    whose body resolves to a lambda or a ``def``/lambda bound in the
+    call's own scope (nearest-scope resolution — two solver steps may
+    both name their body ``body``)."""
+    scopes = [tree] + [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        own = list(_scope_walk(scope))
+        defs = {n.name: n for n in own if isinstance(n, ast.FunctionDef)}
+        lambdas = {}
+        for n in own:
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Lambda):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        lambdas[t.id] = n.value
+        for call in own:
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)):
+                continue
+            root = _attr_root(call.func)
+            attr = call.func.attr
+            if attr == "scan" and root in {"lax", "jax"}:
+                body_arg, carry_idx = (
+                    call.args[0] if call.args else None), 0
+            elif attr == "fori_loop" and root in {"lax", "jax"}:
+                body_arg, carry_idx = (
+                    call.args[2] if len(call.args) > 2 else None), 1
+            else:
+                continue
+            if isinstance(body_arg, ast.Lambda):
+                yield call, body_arg, carry_idx
+            elif isinstance(body_arg, ast.Name):
+                fn = defs.get(body_arg.id) or lambdas.get(body_arg.id)
+                if fn is not None:
+                    yield call, fn, carry_idx
+
+
+def _check_scan_carry_realloc(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ007: a scan/fori body whose carried value is rebuilt through an
+    allocating jnp call (``jnp.concatenate(carry, ...)`` and friends)
+    with no in-place update pattern anywhere in the body. XLA only
+    reuses the carry buffer across trips when the body writes it in
+    place; a grow/copy carry allocates a fresh O(carry) buffer per trip
+    — O(model) state silently doubled inside the one program the
+    megafused apply path is supposed to be."""
+    for call, body, carry_idx in _scan_bodies(tree):
+        # carry names: the carry parameter itself plus one unpacking hop
+        # (`a, b = carry` — the solver idiom)
+        args = body.args.args
+        if len(args) <= carry_idx:
+            continue
+        carry_names = {args[carry_idx].arg}
+        body_stmts = (body.body if isinstance(body.body, list)
+                      else [ast.Expr(body.body)])
+        for sub in ast.walk(ast.Module(body=body_stmts, type_ignores=[])):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in carry_names:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        carry_names.add(t.id)
+                    elif isinstance(t, ast.Tuple):
+                        carry_names.update(
+                            e.id for e in t.elts if isinstance(e, ast.Name))
+
+        has_inplace = False
+        offender = None
+        for sub in ast.walk(ast.Module(body=body_stmts, type_ignores=[])):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _INPLACE_UPDATE_ATTRS:
+                has_inplace = True
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr in _CARRY_ALLOC_CALLS \
+                    and _attr_root(func) in _JNP_NAMES:
+                touched = {
+                    n.id for n in ast.walk(sub)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                }
+                if touched & carry_names and offender is None:
+                    offender = (sub.lineno, func.attr)
+        if offender is not None and not has_inplace:
+            line, name = offender
+            yield Finding(
+                path, line, "KJ007",
+                f"scan/fori_loop carry rebuilt via jnp.{name} every trip "
+                "with no in-place update; use lax.dynamic_update_slice / "
+                ".at[].set so XLA donates the carry buffer (scan-invariant "
+                "model state belongs in the closure, not the carry)")
+
+
 def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
     for fn in ast.walk(tree):
         if not isinstance(fn, ast.FunctionDef):
@@ -402,6 +543,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
     if "workflow/" in posix or "nodes/" in posix:
         findings.extend(_check_blocking_host_pull(tree, rel))
         findings.extend(_check_fresh_jit(tree, rel))
+        findings.extend(_check_scan_carry_realloc(tree, rel))
 
     # nested loops make ast.walk revisit inner statements: keep one
     # finding per (line, rule)

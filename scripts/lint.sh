@@ -121,4 +121,48 @@ print(f"compile smoke: run1 {first['programs_compiled']} cold / "
 PY
 JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
 
+echo "== megafusion smoke (1-program apply run; warm repeat stays 0-cold) =="
+MEGA_CACHE="$(mktemp -d /tmp/keystone_mega_smoke.XXXXXX)"
+MEGA_TRACE="$(mktemp /tmp/keystone_mega_smoke.XXXXXX.json)"
+trap 'rm -f "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+JAX_PLATFORMS=cpu KEYSTONE_MEGAFUSION=1 KEYSTONE_COMPILE_CACHE="$MEGA_CACHE" \
+KEYSTONE_TRACE="$MEGA_TRACE" python - <<'PY'
+# One example apply run TWICE under megafusion against a fresh
+# persistent-cache dir with tracing armed: each apply run must execute
+# exactly ONE program (the whole-plan scan-bodied megafused program),
+# the warm second run must perform zero cold compiles, and the trace's
+# dispatch digest must carry the per-plan breakdown row showing it.
+import json, os
+from keystone_tpu.dispatch_bench import measure_example
+from keystone_tpu.telemetry import compiles_snapshot
+from keystone_tpu.workflow.executor import drain_warmups
+
+r1 = measure_example("MnistRandomFFT", "megafused")
+assert r1["apply_run_programs"] == 1, r1["apply_run_programs"]
+drain_warmups()  # background AOT compiles count against run 1
+first = compiles_snapshot()
+r2 = measure_example("MnistRandomFFT", "megafused")
+assert r2["apply_run_programs"] == 1, r2["apply_run_programs"]
+drain_warmups()
+second = compiles_snapshot()
+new_cold = second["programs_compiled"] - first["programs_compiled"]
+assert new_cold == 0, (
+    f"warm megafused run performed {new_cold} cold compile(s)")
+
+import keystone_tpu.telemetry.spans as spans
+from keystone_tpu.telemetry.export import (
+    dispatch_plan_breakdown, dispatch_summary, write_trace)
+tracer = spans.current_tracer()
+assert tracer is not None, "KEYSTONE_TRACE did not arm the ambient tracer"
+write_trace(tracer, os.environ["KEYSTONE_TRACE"])
+
+trace = json.load(open(os.environ["KEYSTONE_TRACE"]))
+rows = dispatch_plan_breakdown(trace)
+assert rows and "megafused=1" in rows[0], rows
+summary = dispatch_summary(trace)
+assert summary is not None and "megafused" in summary, summary
+print(f"megafusion smoke: {rows[0]}; run2 +0 cold OK")
+PY
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$MEGA_TRACE" >/dev/null
+
 echo "lint: OK"

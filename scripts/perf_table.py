@@ -40,6 +40,23 @@ def trace_table(path, top=15):
     if dispatch:
         print(f"**Dispatch**: {dispatch} — serial-vs-concurrent runs "
               "diff on this line\n")
+    # the per-plan breakdown the dispatch bench embeds: the 2→1
+    # megafusion reduction per example, readable without opening the
+    # raw trace (same metadata dispatch_plan_breakdown renders — the
+    # table form tolerates partial rows/plans the same way)
+    meta = trace.get("keystone", {}).get("dispatch_plans") or {}
+    per = meta.get("apply_run_programs") or {}
+    if per:
+        plans = meta.get("plans") or sorted(
+            {p for row in per.values() for p in row})
+        print("| Example | " + " | ".join(plans) + " |")
+        print("|---" * (1 + len(plans)) + "|")
+        for example in sorted(per):
+            row = per[example]
+            cells = " | ".join(
+                str(row[p]) if p in row else "—" for p in plans)
+            print(f"| {example} | {cells} |")
+        print()
     compiles = compile_summary(trace)
     if compiles:
         print(f"**Compiles**: {compiles} — a warm (persistent-cache / "

@@ -286,6 +286,109 @@ def test_kj006_suppression(tmp_path):
     assert jl.lint_file(f) == []
 
 
+def test_kj007_flags_carry_realloc(tmp_path):
+    """KJ007: a scan/fori body that rebuilds its carry with an
+    allocating jnp call (concatenate/pad/...) and no in-place update is
+    flagged in workflow/ and nodes/ — the megafused scan must never
+    double O(model) state per trip."""
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "bad_scan.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "\n"
+        "\n"
+        "def grow(xs):\n"
+        "    def body(carry, x):\n"
+        "        return jnp.concatenate([carry, x[None]]), x\n"  # KJ007
+        "    return lax.scan(body, jnp.zeros((0, 4)), xs)[0]\n"
+        "\n"
+        "\n"
+        "def widen(xs):\n"
+        "    def body(i, W):\n"
+        "        return jnp.pad(W, ((0, 0), (0, 0))) + i\n"      # KJ007
+        "    return lax.fori_loop(0, 8, body, jnp.zeros((8, 8)))\n"
+    )
+    rules = [f.rule for f in jl.lint_file(bad)]
+    assert rules == ["KJ007", "KJ007"], rules
+
+
+def test_kj007_inplace_and_output_patterns_pass(tmp_path):
+    """KJ007 negatives: dynamic_update_slice / .at[].set carries, the
+    empty-carry ys-output scan (the megafused program's own shape), an
+    arithmetic solver carry, and code outside workflow//nodes/."""
+    jl = _jaxlint()
+    good = tmp_path / "workflow" / "good_scan.py"
+    good.parent.mkdir(parents=True)
+    good.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "\n"
+        "\n"
+        "def fill(xs):\n"
+        "    def body(carry, ix):\n"
+        "        i, x = ix\n"
+        "        return lax.dynamic_update_slice(carry, x[None], (i, 0)), x\n"
+        "    return lax.scan(body, jnp.zeros((8, 4)), xs)[0]\n"
+        "\n"
+        "\n"
+        "def fill_at(xs):\n"
+        "    def body(i, carry):\n"
+        "        return carry.at[i].set(i * 1.0)\n"
+        "    return lax.fori_loop(0, 8, body, jnp.zeros((8,)))\n"
+        "\n"
+        "\n"
+        "def megafused_shape(xs, ms, chunk_fn):\n"
+        "    def trip(carry, xm):\n"
+        "        xb, mb = xm\n"
+        "        return carry, chunk_fn(xb, mb)\n"
+        "    return lax.scan(trip, (), (xs, ms))[1]\n"
+        "\n"
+        "\n"
+        "def solver(xs, W0):\n"
+        "    def step(W, x):\n"
+        "        return W + jnp.outer(x, x), ()\n"
+        "    return lax.scan(step, W0, xs)[0]\n"
+    )
+    assert jl.lint_file(good) == []
+
+    elsewhere = tmp_path / "scripts_like" / "good_scan.py"
+    elsewhere.parent.mkdir(parents=True)
+    bad_body = (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "\n"
+        "\n"
+        "def grow(xs):\n"
+        "    def body(carry, x):\n"
+        "        return jnp.concatenate([carry, x[None]]), x\n"
+        "    return lax.scan(body, jnp.zeros((0, 4)), xs)[0]\n"
+    )
+    elsewhere.write_text(bad_body)
+    assert jl.lint_file(elsewhere) == []  # scope: workflow/ + nodes/ only
+
+
+def test_kj007_suppression(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "nodes" / "suppressed_scan.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "\n"
+        "\n"
+        "def grow(xs):\n"
+        "    def body(carry, x):\n"
+        "        return jnp.concatenate([carry, x[None]]), x  "
+        "# keystone: ignore[KJ007]\n"
+        "    return lax.scan(body, jnp.zeros((0, 4)), xs)[0]\n"
+    )
+    assert jl.lint_file(f) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""

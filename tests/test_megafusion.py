@@ -1,0 +1,472 @@
+"""Whole-plan megafusion correctness suite (ISSUE 6).
+
+Covers the one-program apply-path contract:
+  - the optimizer's `MegafusionRule` collapses a fitted pipeline's whole
+    apply plan (featurize → scale → linear → argmax, chunk loop
+    included) into ONE `MegafusedPlanOperator` program, with outputs
+    allclose-identical to the serial unfused path at exact-multiple AND
+    ragged example counts;
+  - the host batcher hands a shape-stable bucket's padded chunk stack to
+    one scan-bodied program (43 items / chunk 16 → 1 executed program),
+    with the (indices, results) chunk contract intact;
+  - ineligible plans — streaming host stages, host-code stages, fan-out
+    — fall back cleanly to the PR-4/5 per-program path, and
+    ``validate()``'s KP401 diagnostics say why;
+  - `ExecutionConfig.megafusion` (KEYSTONE_MEGAFUSION) kill switch
+    reverts to the PR-4/5 plan with identical values;
+  - the acceptance gate: ``dispatch.programs_executed == 1`` on the
+    apply run of ≥2 example pipelines, and warm megafused runs perform
+    0 cold compiles;
+  - AOT warmup re-arms for chains whose estimator slots resolve after
+    the warm scan (the serving/re-apply path covers the megafused
+    program too);
+  - the KP2xx memory model prices the scan's in-program live set.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import Dataset, HostDataset, Pipeline, PipelineEnv, Transformer
+from keystone_tpu.telemetry import counter
+from keystone_tpu.utils import batching
+from keystone_tpu.workflow.env import (
+    config_override,
+    dispatch_override,
+    overlap_override,
+)
+from keystone_tpu.workflow.optimizer import DefaultOptimizer
+
+RAGGED_N, CHUNK = 43, 16
+
+
+def _reset():
+    PipelineEnv.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    _reset()
+    yield
+    _reset()
+
+
+# --------------------------------------------------------------------------
+# plan rewrite: one Megafused node, one executed program
+
+
+def _fitted_apply_pipeline(n_train=24, d=6, k=3, seed=3):
+    """featurize → scaler-fit → linear-fit → argmax over a device
+    Dataset: the canonical megafusable apply shape."""
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+    from keystone_tpu.nodes.stats import NormalizeRows, StandardScaler
+    from keystone_tpu.nodes.util import ClassLabelIndicatorsFromInt, MaxClassifier
+
+    rng = np.random.default_rng(seed)
+    X = np.abs(rng.normal(size=(n_train, d))).astype(np.float32) + 1.0
+    y = rng.integers(0, k, n_train).astype(np.int32)
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+    pipe = (NormalizeRows().to_pipeline()
+            .and_then(StandardScaler(), train)
+            .and_then(LinearMapEstimator(0.1), train, labels)
+            >> MaxClassifier())
+    return pipe, train
+
+
+@pytest.mark.parametrize("n_test", [24, RAGGED_N])  # multiple AND ragged
+def test_apply_plan_collapses_to_one_program(n_test):
+    """The apply run executes exactly ONE program (a Megafused node in
+    the plan), identical to the serial unfused path — including at a
+    ragged count, where padded-row masking must stay exact through the
+    in-program scan."""
+    rng = np.random.default_rng(11)
+    Xt = np.abs(rng.normal(size=(n_test, 6))).astype(np.float32) + 1.0
+
+    with overlap_override(False), dispatch_override(False), \
+            config_override(megafusion=False):
+        PipelineEnv.get().set_optimizer(DefaultOptimizer(fuse=False))
+        pipe, train = _fitted_apply_pipeline()
+        pipe(train).get()  # fit
+        reference = pipe(Dataset.from_numpy(Xt)).get().numpy()
+    _reset()
+
+    pipe, train = _fitted_apply_pipeline()
+    pipe(train).get()  # fit run (fan-out: not the megafused path)
+    c = counter("dispatch.programs_executed")
+    res = pipe(Dataset.from_numpy(Xt))
+    before = c.value
+    out = res.get().numpy()
+    assert int(c.value - before) == 1, "apply run was not one program"
+    labels = [op.label for op in res.executor.optimized_graph.operators.values()]
+    assert any(l.startswith("Megafused[") for l in labels), labels
+    np.testing.assert_allclose(out, reference, rtol=1e-5, atol=1e-6)
+
+
+def test_fit_bakes_megafused_transformer():
+    """`Pipeline.fit()` resolves the MegafusedPlanOperator: the fitted
+    pipeline carries the baked scan-bodied transformer and applies
+    identically to the lazy path."""
+    from keystone_tpu.nodes.util.fusion import MegafusedBatchTransformer
+
+    pipe, train = _fitted_apply_pipeline()
+    lazy = pipe(train).get().numpy()
+    fitted = pipe.fit()
+    baked = [op for op in fitted.graph.operators.values()
+             if isinstance(op, MegafusedBatchTransformer)]
+    assert baked, "fit() did not bake a MegafusedBatchTransformer"
+    np.testing.assert_array_equal(fitted(train).numpy(), lazy)
+
+
+# --------------------------------------------------------------------------
+# host batcher: the chunk loop moves in-program
+
+
+def _host_items(n=RAGGED_N, dim=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return [np.abs(rng.normal(size=(dim,)).astype(np.float32)) + 1.0
+            for _ in range(n)]
+
+
+def test_host_bucket_scans_as_one_program():
+    """43 same-shape items at chunk 16: ONE executed program for the
+    whole bucket (vs 3 with megafusion off), indices covering exactly
+    range(43), values identical to the per-chunk path."""
+    import jax
+
+    items = _host_items()
+    fn = jax.jit(lambda xb: xb * 2.0 + 1.0)
+    c = counter("dispatch.programs_executed")
+
+    with config_override(megafusion=True, pad_chunks=True):
+        before = c.value
+        seen = {}
+        for idxs, payload in batching.map_host_batched_stream(
+                items, fn, chunk=CHUNK):
+            assert idxs is not None
+            assert len(idxs) == len(payload) <= CHUNK
+            for i, row in zip(idxs, payload):
+                assert i not in seen
+                seen[i] = row
+        mega_programs = int(c.value - before)
+    assert mega_programs == 1, mega_programs
+    assert sorted(seen) == list(range(RAGGED_N))
+
+    with config_override(megafusion=False, pad_chunks=True):
+        before = c.value
+        reference = batching.map_host_batched(items, fn, chunk=CHUNK)
+        plain_programs = int(c.value - before)
+    assert plain_programs == 3  # ceil(43 / 16) per-chunk dispatches
+    for i in range(RAGGED_N):
+        np.testing.assert_allclose(np.asarray(seen[i]),
+                                   np.asarray(reference[i]), rtol=1e-6)
+
+
+def test_host_code_batch_fn_falls_back_per_chunk():
+    """A host (non-jitted) batch fn is not traceable under the scan: the
+    megafused path declines and the per-chunk contract is unchanged."""
+    items = _host_items()
+    shapes = []
+
+    def hostfn(xb):
+        shapes.append(xb.shape[0])
+        return np.asarray(xb) * 2.0
+
+    with config_override(megafusion=True, pad_chunks=True):
+        out = batching.map_host_batched(items, hostfn, chunk=CHUNK)
+    assert shapes == [CHUNK, CHUNK, CHUNK], shapes  # padded, per chunk
+    for i in range(RAGGED_N):
+        np.testing.assert_allclose(out[i], items[i] * 2.0, rtol=1e-6)
+
+
+def test_host_megafusion_residency_cap(monkeypatch):
+    """One scan program never stacks an unbounded bucket: runs split at
+    `_MEGAFUSED_MAX_TRIPS` chunks, so a huge bucket still streams —
+    capped chunks per dispatch — instead of materializing whole."""
+    import jax
+
+    monkeypatch.setattr(batching, "_MEGAFUSED_MAX_TRIPS", 4)
+    items = _host_items(n=40)  # 10 chunks of 4 at chunk=4
+    fn = jax.jit(lambda xb: xb * 2.0)
+    c = counter("dispatch.programs_executed")
+    with config_override(megafusion=True, pad_chunks=True):
+        before = c.value
+        out = batching.map_host_batched(items, fn, chunk=4)
+        programs = int(c.value - before)
+    assert programs == 3  # ceil(10 trips / cap 4) scan programs
+    for i in range(40):
+        np.testing.assert_allclose(np.asarray(out[i]), items[i] * 2.0,
+                                   rtol=1e-6)
+
+
+def test_pad_chunks_off_disables_host_megafusion():
+    """Shape-stable padding is the contract the in-program scan rides
+    on; with it off, the per-chunk dispatch path remains."""
+    import jax
+
+    items = _host_items()
+    fn = jax.jit(lambda xb: xb * 2.0)
+    c = counter("dispatch.programs_executed")
+    with config_override(megafusion=True, pad_chunks=False):
+        before = c.value
+        batching.map_host_batched(items, fn, chunk=CHUNK)
+        programs = int(c.value - before)
+    assert programs == 3  # ragged tail keeps its own dispatch
+
+
+# --------------------------------------------------------------------------
+# ineligible plans fall back (streaming stage, fan-out)
+
+
+class _ChunkProducer(Transformer):
+    """Bucketed host-batch stage streaming chunks (the SIFT pattern)."""
+
+    chunkable = True
+
+    def apply(self, x):
+        return np.asarray(x, np.float32) * 2.0
+
+    def apply_batch_stream(self, data):
+        return batching.map_host_batched_stream(
+            data.items, lambda xb: np.asarray(xb) * 2.0, chunk=4)
+
+
+def test_streaming_plan_keeps_chunk_flow():
+    """A plan headed by a stream-producing host stage does NOT megafuse:
+    chunks keep draining lazily through the fused elementwise chain
+    (no Megafused node, ≥2 index-carrying chunks)."""
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+
+    items = _host_items(n=12)
+    pipe = (_ChunkProducer().to_pipeline()
+            >> NormalizeRows() >> SignedHellingerMapper())
+    with overlap_override(True, prefetch_depth=1):
+        res = pipe(HostDataset(items))
+        labels = [op.label
+                  for op in res.executor.optimized_graph.operators.values()]
+        assert not any(l.startswith("Megafused[") for l in labels), labels
+        n_chunks = 0
+        seen = {}
+        for idxs, payload in res.stream():
+            assert idxs is not None, "stream materialized"
+            n_chunks += 1
+            for i, item in zip(idxs, payload):
+                seen[i] = item
+        assert n_chunks >= 2, "producer chunks were collapsed"
+    assert sorted(seen) == list(range(12))
+
+
+def _fusable_fn(name):
+    class _F(Transformer):
+        fusable = True
+
+        def __init__(self):
+            self._name = name
+
+        @property
+        def label(self):
+            return self._name
+
+        def apply(self, x):
+            return x + 1.0
+
+    return _F()
+
+
+def test_fanout_terminates_megafusion():
+    """A fan-out inside the chain keeps both branches as separate
+    programs — megafusion never duplicates work across consumers."""
+    from keystone_tpu.workflow.fusion_rule import MegafusionRule, NodeFusionRule
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    g = Graph()
+    g, data = g.add_node(
+        DatasetOperator(Dataset.from_numpy(np.ones((4, 2), np.float32))), [])
+    g, a = g.add_node(_fusable_fn("A"), [data])
+    g, b = g.add_node(_fusable_fn("B"), [a])
+    g, c = g.add_node(_fusable_fn("C"), [b])
+    g, d = g.add_node(_fusable_fn("D"), [b])
+    g, _ = g.add_sink(c)
+    g, _ = g.add_sink(d)
+
+    plan = NodeFusionRule().apply((g, {}))
+    plan = MegafusionRule().apply(plan)
+    labels = sorted(op.label for op in plan[0].operators.values()
+                    if not op.label.startswith("Dataset"))
+    assert labels == ["C", "D", "Fused[A >> B]"], labels
+
+
+def test_absorbed_cacher_prefix_not_poisoned():
+    """Review regression: a Cacher at the HEAD of a merged chain is
+    absorbed — its saveable prefix must be dropped with it, or the
+    whole-chain output gets saved under the Cacher's cross-pipeline
+    state key and a second pipeline sharing that head silently reads
+    the wrong value through SavedStateLoadRule."""
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+    from keystone_tpu.nodes.util import Cacher
+
+    rng = np.random.default_rng(21)
+    X = np.abs(rng.normal(size=(16, 5))).astype(np.float32) + 1.0
+    ds = Dataset.from_numpy(X)
+
+    shared = Cacher("c")
+    pipe1 = (shared.to_pipeline() >> NormalizeRows()
+             >> Cacher("mid") >> SignedHellingerMapper())
+    pipe2 = shared.to_pipeline() >> NormalizeRows()
+
+    pipe1(ds).get()  # saves whatever prefixes the plan kept
+    out2 = pipe2(ds).get().numpy()
+    expected = X / np.linalg.norm(X, axis=1, keepdims=True)
+    np.testing.assert_allclose(out2, expected, rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# kill switch parity
+
+
+def test_kill_switch_reverts_to_pr45_plan():
+    """`megafusion=False` (KEYSTONE_MEGAFUSION=0) reproduces the PR-4/5
+    two-program apply plan with identical predictions."""
+    from keystone_tpu.dispatch_bench import measure_example
+
+    mega = measure_example("MnistRandomFFT", "megafused")
+    pr45 = measure_example("MnistRandomFFT", "optimized")
+    assert mega["apply_run_programs"] == 1
+    assert pr45["apply_run_programs"] == 2  # the PR-4/5 floor
+    np.testing.assert_allclose(mega["test_pred"], pr45["test_pred"],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(mega["train_pred"], pr45["train_pred"],
+                               rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# acceptance gate: 1 program/apply run on ≥2 examples, identical outputs
+
+
+@pytest.mark.parametrize("example", ["MnistRandomFFT", "RandomPatchCifar"])
+def test_one_program_per_apply_run(example):
+    """ISSUE 6 acceptance: `dispatch.programs_executed == 1` on the
+    example's apply run under the megafused plan, outputs
+    allclose-identical to the serial unfused path."""
+    from keystone_tpu.dispatch_bench import measure_example
+
+    base = measure_example(example, "serial_unfused")
+    mega = measure_example(example, "megafused")
+    assert mega["apply_run_programs"] == 1, mega["apply_run_programs"]
+    assert mega["fit_run_programs"] <= base["fit_run_programs"]
+    np.testing.assert_allclose(
+        mega["train_pred"], base["train_pred"], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        mega["test_pred"], base["test_pred"], rtol=1e-5, atol=1e-5)
+
+
+def test_report_carries_plan_breakdown_rows():
+    """The dispatch report's per-plan breakdown row (satellite): one
+    flat record per example with all four plan columns, and the
+    megafused one-program gate counted."""
+    from keystone_tpu.dispatch_bench import PLANS, dispatch_count_report
+
+    rep = dispatch_count_report(examples=("MnistRandomFFT",))
+    assert rep["plans"] == list(PLANS)
+    (row,) = rep["plan_breakdown"]
+    assert row["example"] == "MnistRandomFFT"
+    assert all(p in row for p in PLANS)
+    assert row["megafused"] == 1
+    assert rep["examples_at_one_program"] == 1
+    assert rep["all_outputs_match"]
+
+
+def test_warm_megafused_run_zero_cold_compiles():
+    """ISSUE 6 acceptance: a rebuilt-from-scratch megafused run against
+    a warm persistent cache performs 0 cold compiles and still executes
+    the apply run as one program."""
+    from keystone_tpu.compile_bench import measure_example_compiles
+
+    rep = measure_example_compiles("MnistRandomFFT")
+    assert rep["plan"] == "megafused"
+    assert rep["warm_programs_compiled"] == 0, rep
+    assert rep["warm_run"]["apply_programs_executed"] == 1, rep
+    assert rep["outputs_match_cold"]
+
+
+# --------------------------------------------------------------------------
+# AOT warmup re-arm (satellite)
+
+
+def test_warmup_rearms_after_fit_resolution(monkeypatch):
+    """A fused-chain program whose estimator slots were unresolved when
+    the warm scan ran is re-armed once the fits force: the next
+    execute() on the same executor submits the chain's warmup (the
+    serving/re-apply path is warm on first force)."""
+    import keystone_tpu.workflow.executor as executor_mod
+
+    warmed = []
+
+    def fake_submit(op, element, count):
+        warmed.append((getattr(op, "label", str(op)), tuple(element.shape),
+                       int(count)))
+
+    monkeypatch.setattr(executor_mod, "_submit_warmup", fake_submit)
+
+    with config_override(aot_warmup=True):
+        pipe, train = _fitted_apply_pipeline()
+        res = pipe(train)
+        ex = res.executor
+        res.get()  # forces fits; warm scan saw unresolved estimator slots
+        executor_mod.drain_warmups()
+        with ex._warm_lock:
+            had_pending = bool(ex._warm_pending) or bool(warmed)
+        assert had_pending, "warm scan neither warmed nor parked the chain"
+        before = len(warmed)
+        ex._rearm_warmup()  # what the next execute()/scheduler tick runs
+        executor_mod.drain_warmups()
+    new = warmed[before:]
+    assert not ex._warm_pending or new, (ex._warm_pending, warmed)
+
+
+# --------------------------------------------------------------------------
+# validate() diagnostics + memory model
+
+
+def test_validate_explains_ineligible_plan():
+    """KP401: a stream-producing host stage in an otherwise fusable
+    chain shows up as an INFO diagnostic naming the fallback reason."""
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+
+    pipe = (_ChunkProducer().to_pipeline()
+            >> NormalizeRows() >> SignedHellingerMapper())
+    report = pipe.validate(level="full", raise_on_error=False)
+    kp401 = report.by_rule("KP401")
+    assert kp401, str(report)
+    assert any("host-staging" in d.message or "stream" in d.message
+               for d in kp401)
+
+
+def test_validate_fusable_plan_has_no_fallback_diags():
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+
+    pipe = (NormalizeRows().to_pipeline() >> SignedHellingerMapper())
+    report = pipe.validate(level="full", raise_on_error=False)
+    assert not report.by_rule("KP401"), str(report)
+
+
+def test_memory_model_prices_scan_live_set():
+    """`MegafusedPlanOperator.scan_live_nbytes`: the in-program carry is
+    chunk_rows × the largest adjacent stage-boundary pair."""
+    from keystone_tpu.analysis.specs import DataSpec, shape_struct
+    from keystone_tpu.nodes.stats import NormalizeRows
+    from keystone_tpu.nodes.util import MaxClassifier
+    from keystone_tpu.workflow.fusion_rule import MegafusedPlanOperator
+
+    op = MegafusedPlanOperator([NormalizeRows(), MaxClassifier()])
+    spec = DataSpec(element=shape_struct((6,), np.float32),
+                    count=100, kind="dataset")
+    live = op.scan_live_nbytes([spec], chunk_rows=16)
+    # boundaries: 24B → 24B → 4B per item; worst adjacent pair 48B
+    assert live == 48 * 16, live
+
+    # unknown elements refuse an estimate instead of guessing
+    from keystone_tpu.analysis.specs import UNKNOWN
+
+    assert op.scan_live_nbytes(
+        [DataSpec(element=UNKNOWN, kind="dataset")], 16) is None
