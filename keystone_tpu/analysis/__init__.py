@@ -34,6 +34,8 @@ from .diagnostics import (
     Severity,
     ValidationReport,
 )
+from .contracts import audit_operator, audit_registry, contract_pass
+from .effects import class_effects, interference_pass, operator_effects
 from .hazards import hazard_pass
 from .memory import (
     DEFAULT_CHUNK_ROWS,
@@ -100,6 +102,18 @@ def validate_graph(
             from .hazards import megafusion_pass
 
             diags.extend(megafusion_pass(graph))
+        # contract tier: per-operator KP5xx audit over this graph's
+        # instances (the registry-wide sweep is `contracts.audit_registry`
+        # / the --audit-operators CLI)
+        from .contracts import contract_pass
+
+        diags.extend(contract_pass(graph, specs))
+        if cfg.concurrent_dispatch:
+            # KP511 only matters while the concurrent scheduler can
+            # actually force unordered vertices simultaneously
+            from .effects import interference_pass
+
+            diags.extend(interference_pass(graph))
 
     report = ValidationReport(diags, specs=specs, memory=memory, level=level)
     return report.filter(ignore) if ignore else report
@@ -126,8 +140,14 @@ __all__ = [
     "UNKNOWN",
     "ValidationReport",
     "as_source_spec",
+    "audit_operator",
+    "audit_registry",
+    "class_effects",
+    "contract_pass",
     "element_nbytes",
     "hazard_pass",
+    "interference_pass",
+    "operator_effects",
     "memory_pass",
     "resolve_chunk_rows",
     "shape_struct",

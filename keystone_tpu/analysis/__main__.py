@@ -1,22 +1,61 @@
-"""CLI: statically validate the example pipelines.
+"""CLI: statically validate the example pipelines / audit the operator
+registry.
 
     python -m keystone_tpu.analysis                 # all examples, level=full
     python -m keystone_tpu.analysis MnistRandomFFT  # one example
     python -m keystone_tpu.analysis --level specs --hbm-budget-gb 16
+    python -m keystone_tpu.analysis --audit-operators   # registry-wide KP5xx
+    python -m keystone_tpu.analysis --audit-operators --json
     python -m keystone_tpu.analysis --list-rules
 
 Exit code 1 if any example produces ERROR-severity findings (or any
-finding at all with ``--strict``). Runs entirely abstractly — no data
-loads, no device programs execute.
+finding at all with ``--strict``), or — under ``--audit-operators`` — if
+ANY unsuppressed KP5xx contract finding remains anywhere in the
+registered operator registry. Runs entirely abstractly — no data loads,
+no device programs execute.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from . import LEVELS, RULES, Severity, validate_graph
 from .examples import EXAMPLES, build_example
+
+
+def _audit_main(args) -> int:
+    """Registry-wide operator contract audit (KP5xx): sweep every
+    registered Operator/Estimator subclass, not just built pipelines."""
+    from .contracts import audit_registry
+
+    findings, stats = audit_registry()
+    if args.ignore:
+        findings = [(c, d) for c, d in findings if d.rule not in args.ignore]
+    if args.json:
+        print(json.dumps({
+            "audited_classes": stats["classes"],
+            "probed_classes": stats["probed"],
+            "findings": [
+                {
+                    "class": cls.__qualname__,
+                    "module": cls.__module__,
+                    "rule": d.rule,
+                    "severity": d.severity.name,
+                    "message": d.message,
+                }
+                for cls, d in findings
+            ],
+        }, indent=2))
+        return 1 if findings else 0
+    for cls, d in findings:
+        print(f"✗ {cls.__module__}.{cls.__qualname__}: "
+              f"[{d.severity.name}] {d.rule} {d.message}")
+    mark = "✗" if findings else "✓"
+    print(f"{mark} operator contract audit: {stats['classes']} class(es) "
+          f"swept ({stats['probed']} probed), {len(findings)} finding(s)")
+    return 1 if findings else 0
 
 
 def main(argv=None) -> int:
@@ -32,6 +71,11 @@ def main(argv=None) -> int:
                    help="suppress a rule id (repeatable)")
     p.add_argument("--strict", action="store_true",
                    help="fail on warnings too")
+    p.add_argument("--audit-operators", action="store_true",
+                   help="sweep EVERY registered Operator/Estimator subclass "
+                        "for KP5xx contract violations (zero tolerated)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (CI annotation)")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
@@ -39,6 +83,9 @@ def main(argv=None) -> int:
         for rule, desc in sorted(RULES.items()):
             print(f"{rule}  {desc}")
         return 0
+
+    if args.audit_operators:
+        return _audit_main(args)
 
     names = args.examples or sorted(EXAMPLES)
     unknown = [n for n in names if n not in EXAMPLES]
@@ -50,6 +97,7 @@ def main(argv=None) -> int:
     budget = (int(args.hbm_budget_gb * (1 << 30))
               if args.hbm_budget_gb else None)
     failed = False
+    records = []
     for name in names:
         try:
             pipeline, source_spec = build_example(name)
@@ -57,20 +105,38 @@ def main(argv=None) -> int:
                 source_spec, level=args.level, ignore=args.ignore,
                 hbm_budget_bytes=budget, raise_on_error=False)
         except Exception as e:  # a factory bug is a failure, not a crash
-            print(f"✗ {name}: failed to build/validate: "
-                  f"{type(e).__name__}: {e}")
+            if args.json:
+                records.append({"example": name, "build_error":
+                                f"{type(e).__name__}: {e}"})
+            else:
+                print(f"✗ {name}: failed to build/validate: "
+                      f"{type(e).__name__}: {e}")
             failed = True
             continue
         bad = bool(report.errors) or (args.strict and report.warnings)
-        mark = "✗" if bad else "✓"
-        print(f"{mark} {name}: {len(report.errors)} error(s), "
-              f"{len(report.warnings)} warning(s)"
-              + (f", peak ≈ {report.memory.peak_bytes >> 20} MiB"
-                 if report.memory and report.memory.peak_bytes else ""))
-        for d in report.diagnostics:
-            if d.severity >= Severity.WARNING or args.strict:
-                print(f"    {d}")
+        if args.json:
+            records.append({
+                "example": name,
+                "errors": len(report.errors),
+                "warnings": len(report.warnings),
+                "diagnostics": [
+                    {"rule": d.rule, "severity": d.severity.name,
+                     "anchor": d.anchor, "message": d.message}
+                    for d in report.diagnostics
+                ],
+            })
+        else:
+            mark = "✗" if bad else "✓"
+            print(f"{mark} {name}: {len(report.errors)} error(s), "
+                  f"{len(report.warnings)} warning(s)"
+                  + (f", peak ≈ {report.memory.peak_bytes >> 20} MiB"
+                     if report.memory and report.memory.peak_bytes else ""))
+            for d in report.diagnostics:
+                if d.severity >= Severity.WARNING or args.strict:
+                    print(f"    {d}")
         failed |= bad
+    if args.json:
+        print(json.dumps({"examples": records}, indent=2))
     return 1 if failed else 0
 
 

@@ -51,6 +51,23 @@ RULES = {
     "KP401": "megafusion-fallback: a stage keeps this plan from collapsing "
              "to one XLA program (fan-out, host code, or a streaming "
              "origin); the per-program dispatch path remains",
+    # contract tier (registry-wide operator audit; see analysis/contracts)
+    "KP501": "fusable-without-structural-fuse: a fusable stage's fused "
+             "program key is id-keyed (opaque), so fused programs "
+             "containing it re-trace on every rebuilt pipeline",
+    "KP502": "chunkable-non-distributive: a chunkable-declared batch path "
+             "provably does not distribute over host chunks "
+             "(f(concat(chunks)) != concat(f(chunks)) under eval_shape)",
+    "KP503": "donation-not-implemented: donates_deps is declared but no "
+             "reachable jitted step donates its arguments (or the "
+             "donate_argnums are mis-indexed against the step signature)",
+    "KP504": "unmasked-fused-stage: the unfused batch path masks padded "
+             "rows but fuse_masks_output is undeclared — fused programs "
+             "would corrupt padded rows",
+    # concurrency effect tier (see analysis/effects)
+    "KP511": "concurrent-effect-interference: two effectful vertices with "
+             "no dependency ordering share mutable state; the concurrent "
+             "scheduler may force them simultaneously",
 }
 
 

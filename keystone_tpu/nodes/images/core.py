@@ -211,6 +211,7 @@ class ImageVectorizer(Transformer):
     """(H, W, C) → flat vector (ImageVectorizer.scala:12)."""
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks
 
     def apply(self, x):
         return jnp.ravel(x)
@@ -226,6 +227,7 @@ class PixelScaler(Transformer):
     """x / 255 (PixelScaler.scala:9)."""
 
     fusable = True
+    chunkable = True  # per-item host map: distributes over chunks
 
     def apply(self, x):
         return jnp.asarray(x, jnp.float32) / 255.0
@@ -257,11 +259,25 @@ class GrayScaler(Transformer):
     """NTSC grayscale (GrayScaler.scala:9)."""
 
     fusable = True
+    chunkable = True  # per-item host map: distributes over chunks
 
     def apply(self, x):
         from ...utils.images import grayscale
 
         return grayscale(x)
+
+    def fuse(self):
+        # shape-only state: one static key serves every instance, so
+        # fused programs containing this stage stay structurally cached
+        # (KP501 — the PR-6 silent-retrace class)
+        def fn(p, x):
+            if x.shape[-1] == 1:
+                return x
+            w = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+            return jnp.sum(
+                jnp.asarray(x, jnp.float32) * w, axis=-1, keepdims=True)
+
+        return (("GrayScaler",), (), fn)
 
     def apply_batch(self, data):
         from ...data.dataset import HostDataset
@@ -281,6 +297,7 @@ class Cropper(Transformer):
     """(Cropper.scala:19)"""
 
     fusable = True
+    chunkable = True  # pure per-item slice: distributes over chunks
 
     def __init__(self, y0: int, x0: int, y1: int, x1: int):
         self.box = (y0, x0, y1, x1)
@@ -288,6 +305,13 @@ class Cropper(Transformer):
     def apply(self, x):
         y0, x0, y1, x1 = self.box
         return x[y0:y1, x0:x1, :]
+
+    def fuse(self):
+        # the box is static (it changes output shapes), so it keys the
+        # program; same-box Croppers share one compiled program (KP501)
+        y0, x0, y1, x1 = self.box
+        return (("Cropper", y0, x0, y1, x1), (),
+                lambda p, x: x[:, y0:y1, x0:x1, :])
 
 
 class Windower(Transformer):

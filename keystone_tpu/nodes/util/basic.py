@@ -234,9 +234,15 @@ class MatrixVectorizer(Transformer):
     """Flatten a per-item matrix to a vector (MatrixVectorizer.scala)."""
 
     fusable = True
+    chunkable = True  # pure per-item fn: distributes over chunks
 
     def apply(self, x):
         return jnp.ravel(x)
+
+    def fuse(self):
+        # shape-only: one static key for every instance (KP501)
+        return (("MatrixVectorizer",), (),
+                lambda p, x: x.reshape(x.shape[0], -1))
 
 
 class Identity(Transformer):

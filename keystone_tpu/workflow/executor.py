@@ -108,6 +108,36 @@ def _submit_warmup(op, element, count) -> None:
     _spawn_warm_thread(run, "keystone-aot-warmup")
 
 
+def concurrent_relation(graph: Graph):
+    """The scheduler's concurrently-schedulable relation, exposed for
+    static analysis (the KP511 interference pass): a predicate
+    ``unordered(u, v)`` that is True when the concurrent DAG scheduler
+    could force ``u`` and ``v`` simultaneously.
+
+    This is the static projection of `_schedule_plan`'s effective-
+    dependency DAG: two vertices are ordered only when one is an
+    ancestor of the other. Deferral (absorbing an already-forced or
+    single-consumer streaming vertex into its consumer's task) only
+    merges a vertex INTO a dependent's task — it never adds ordering
+    between otherwise-independent vertices — so DAG-unordered is a
+    faithful, conservative answer to "could the pool run these at the
+    same time"."""
+    from .analysis import ancestors
+
+    anc: Dict[GraphId, frozenset] = {}
+
+    def _anc(v: GraphId) -> frozenset:
+        got = anc.get(v)
+        if got is None:
+            got = anc[v] = frozenset(ancestors(graph, v))
+        return got
+
+    def unordered(u: GraphId, v: GraphId) -> bool:
+        return u != v and u not in _anc(v) and v not in _anc(u)
+
+    return unordered
+
+
 class GraphExecutor:
     def __init__(
         self,

@@ -59,11 +59,24 @@ system cannot (see ANALYSIS.md for the full catalog):
          single-program apply path must never do. Scan-invariant model
          state belongs in the closure, not the carry.
 
+  KJ008  hot-path-state-write (under ``workflow/`` and ``nodes/``): an
+         assignment to ``self.*`` or a module global — or an in-place
+         mutation of a module-level container — inside an operator's
+         ``apply``/``apply_batch``/``_chunk_loop``. The concurrent DAG
+         scheduler (PR 4, default on) may force two vertices
+         simultaneously, making the write interleaving schedule-
+         dependent (the KP511 race class, see
+         ``keystone_tpu/analysis/effects.py`` for the graph-level
+         pass). The ``self.__dict__[...]`` instance-memo idiom and
+         module-level structure-keyed caches (``*CACHE*``/``*PENDING*``
+         names) are sanctioned.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
-Usage: python scripts/jaxlint.py [--list-rules] [paths...]
-Exit code 1 when findings remain.
+Usage: python scripts/jaxlint.py [--list-rules] [--json] [paths...]
+Exit code 1 when findings remain. ``--json`` emits machine-readable
+findings for CI annotation.
 """
 
 from __future__ import annotations
@@ -91,6 +104,11 @@ RULES = {
     "KJ007": "lax.scan/fori_loop carry rebuilt by an allocating jnp call "
              "with no in-place update (dynamic_update_slice / .at[].set) "
              "— the carry buffer reallocates O(model) state every trip",
+    "KJ008": "state write in an operator hot path: assignment to self.* "
+             "or a module global inside apply/apply_batch/_chunk_loop — "
+             "the concurrent scheduler may force two such vertices "
+             "simultaneously (use the self.__dict__ memo idiom or a "
+             "structure-keyed cache)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -505,6 +523,128 @@ def _check_scan_carry_realloc(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "model state belongs in the closure, not the carry)")
 
 
+#: operator methods the concurrent scheduler may run simultaneously
+#: across vertices — writes to shared state inside them are races.
+#: Kept in lockstep with `analysis/effects.py`'s HOT_METHODS (the
+#: graph-level KP511 pass over the same discipline).
+_HOT_PATH_METHODS = {
+    "apply", "apply_batch", "apply_batch_stream", "single_transform",
+    "batch_transform", "batch_transform_stream", "batch_fn", "fuse",
+    "_chunk_loop",
+}
+#: in-place container mutators.
+_MUTATOR_CALLS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear",
+}
+#: module-level names matching the sanctioned structure-keyed cache
+#: idiom (program caches, pending-future registries, locks).
+_SANCTIONED_GLOBAL_RE = re.compile(r"(CACHE|PENDING|LOCK|REGISTRY)", re.I)
+
+
+def _chain_root(node: ast.AST) -> ast.AST:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node
+
+
+def _is_self_dict(node: ast.AST) -> bool:
+    """``self.__dict__`` — the sanctioned instance-memo root."""
+    return (isinstance(node, ast.Attribute) and node.attr == "__dict__"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _check_hot_path_state_write(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ008: apply-time state writes under ``nodes/``/``workflow/`` —
+    assignment to ``self.*`` or to a declared ``global``, and in-place
+    mutation (subscript assignment or a mutator-method call) of a
+    module-level container, inside an operator's hot-path methods
+    (``apply``/``apply_batch``/``_chunk_loop``). The concurrent DAG
+    scheduler (default on) may force two vertices simultaneously, so
+    any such write is schedule-dependent — the KP511 race class,
+    policed here at the file level with zero imports. Sanctioned:
+    the ``self.__dict__[...]`` instance-memo idiom and module-level
+    structure-keyed caches (``*CACHE*``/``*PENDING*``/``*LOCK*``)."""
+    module_names = {
+        t.id
+        for stmt in (tree.body if isinstance(tree, ast.Module) else [])
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign))
+        for t in (stmt.targets if isinstance(stmt, ast.Assign)
+                  else [stmt.target])
+        if isinstance(t, ast.Name)
+    }
+
+    def flagged_global(name: str) -> bool:
+        return name in module_names and not _SANCTIONED_GLOBAL_RE.search(name)
+
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) \
+                    or fn.name not in _HOT_PATH_METHODS:
+                continue
+            declared_globals: Set[str] = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Global):
+                    declared_globals.update(sub.names)
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                        for e in elts:
+                            root = _chain_root(e)
+                            if isinstance(e, ast.Name) \
+                                    and e.id in declared_globals:
+                                yield Finding(
+                                    path, sub.lineno, "KJ008",
+                                    f"`{fn.name}` writes module global "
+                                    f"`{e.id}`; two concurrently forced "
+                                    "vertices would race on it")
+                            elif isinstance(root, ast.Name) \
+                                    and root.id == "self":
+                                if isinstance(e, ast.Subscript) \
+                                        and _is_self_dict(e.value):
+                                    continue  # sanctioned memo idiom
+                                yield Finding(
+                                    path, sub.lineno, "KJ008",
+                                    f"`{fn.name}` assigns instance state "
+                                    f"`self.{_attr_name(e)}` at apply "
+                                    "time; shared instances race under "
+                                    "the concurrent scheduler (memoize "
+                                    "via self.__dict__[...] instead)")
+                            elif isinstance(e, (ast.Subscript, ast.Attribute)) \
+                                    and isinstance(root, ast.Name) \
+                                    and flagged_global(root.id):
+                                yield Finding(
+                                    path, sub.lineno, "KJ008",
+                                    f"`{fn.name}` mutates module-level "
+                                    f"container `{root.id}` at apply time")
+                elif isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _MUTATOR_CALLS \
+                        and not _is_self_dict(sub.func.value):
+                    root = _chain_root(sub.func.value)
+                    if isinstance(root, ast.Name) and flagged_global(root.id):
+                        yield Finding(
+                            path, sub.lineno, "KJ008",
+                            f"`{fn.name}` calls `{root.id}."
+                            f"{sub.func.attr}(...)` on a module-level "
+                            "container at apply time")
+
+
+def _attr_name(node: ast.AST) -> str:
+    names = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            names.append(node.attr)
+        node = node.value
+    return names[-1] if names else "?"
+
+
 def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
     for fn in ast.walk(tree):
         if not isinstance(fn, ast.FunctionDef):
@@ -544,6 +684,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_blocking_host_pull(tree, rel))
         findings.extend(_check_fresh_jit(tree, rel))
         findings.extend(_check_scan_carry_realloc(tree, rel))
+        findings.extend(_check_hot_path_state_write(tree, rel))
 
     # nested loops make ast.walk revisit inner statements: keep one
     # finding per (line, rule)
@@ -574,20 +715,30 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=["keystone_tpu"])
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings (CI annotation)")
     args = ap.parse_args(argv)
     if args.list_rules:
         for rule, desc in sorted(RULES.items()):
             print(f"{rule}  {desc}")
         return 0
     repo_root = Path(__file__).resolve().parent.parent
-    total = 0
+    findings: List[Finding] = []
     for f in iter_py_files(args.paths or ["keystone_tpu"]):
         root = repo_root if f.resolve().is_relative_to(repo_root) else None
-        for finding in lint_file(f.resolve() if root else f, repo_root=root):
-            print(finding)
-            total += 1
-    if total:
-        print(f"jaxlint: {total} finding(s)", file=sys.stderr)
+        findings.extend(lint_file(f.resolve() if root else f, repo_root=root))
+    if args.json:
+        import json
+
+        print(json.dumps({
+            "findings": [f._asdict() for f in findings],
+            "total": len(findings),
+        }, indent=2))
+        return 1 if findings else 0
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"jaxlint: {len(findings)} finding(s)", file=sys.stderr)
         return 1
     return 0
 

@@ -22,6 +22,9 @@ python scripts/jaxlint.py keystone_tpu
 echo "== pipeline validation (abstract specs) =="
 JAX_PLATFORMS=cpu python -m keystone_tpu.analysis "$@"
 
+echo "== operator contract audit (registry-wide KP5xx) =="
+JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --audit-operators
+
 echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
 TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
 trap 'rm -f "$TRACE_TMP"' EXIT

@@ -1,0 +1,585 @@
+"""Operator contract auditor (KP5xx) + concurrency effect analyzer
+(KP511) — `keystone_tpu/analysis/contracts.py` / `effects.py`.
+
+Marked ``lint``: data-free, device-free (AST walks + `jax.eval_shape`
+traces only), mirroring `scripts/lint.sh`'s --audit-operators stage so
+CI and pytest cannot drift.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis import Severity
+from keystone_tpu.analysis.contracts import (
+    audit_class,
+    audit_operator,
+    audit_registry,
+    operator_registry,
+)
+from keystone_tpu.analysis.effects import (
+    class_effects,
+    interference_pass,
+    operator_effects,
+)
+from keystone_tpu.analysis.specs import SpecDataset
+from keystone_tpu.nodes.stats.random_features import RandomSignNode
+from keystone_tpu.workflow.env import dispatch_override
+from keystone_tpu.workflow.pipeline import (
+    Estimator,
+    Pipeline,
+    Transformer,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------------- helpers
+
+
+class _CleanStage(Transformer):
+    """Fusable + chunkable with a structural fuse(): fully contract-clean."""
+
+    fusable = True
+    chunkable = True
+
+    def apply(self, x):
+        return x * 2.0
+
+    def fuse(self):
+        return (("CleanStage",), (), lambda p, xb: xb * 2.0)
+
+
+class _NoFuseStage(Transformer):
+    """The PR-6 bug class: declares fusable, implements no fuse()."""
+
+    fusable = True
+
+    def apply(self, x):
+        return x * 2.0
+
+
+class _StrippedRandomSign(RandomSignNode):
+    """A real stats stage with its fuse() stripped off — the exact
+    regression PR 6 paid ~5x re-apply cost for."""
+
+    fuse = None
+
+
+class _GramStage(Transformer):
+    """chunkable declared, but the batch path computes a whole-batch
+    Gram matrix — f(concat(chunks)) != concat(f(chunks))."""
+
+    chunkable = True
+
+    def apply(self, x):
+        return x
+
+    def fuse(self):
+        return (("Gram",), (), lambda p, xb: xb @ xb.T)
+
+
+class _BatchMeanStage(Transformer):
+    """chunkable declared, but the batch path reduces over the example
+    axis."""
+
+    chunkable = True
+
+    def apply(self, x):
+        return x
+
+    def batch_fn(self):
+        return lambda xb: jnp.mean(xb, axis=0)
+
+
+@partial(jax.jit, static_argnames=())
+def _undonated_step(W, R):
+    return W + R
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _donated_step(W, R):
+    return W + R
+
+
+def jit(fn=None, **kw):
+    """AST stand-in for jax.jit: a real jax.jit with an out-of-range
+    donate_argnums raises at decoration time, but the auditor's
+    cross-check must still catch the SOURCE shape (the bug a refactor
+    introduces by reordering a step's parameters)."""
+    return fn if fn is not None else (lambda f: f)
+
+
+@partial(jit, donate_argnums=(5,))
+def _misindexed_step(W, R):
+    return W + R
+
+
+class _UndonatedDonor(Transformer):
+    donates_deps = (0,)
+
+    def apply_batch(self, data):
+        return _undonated_step(data, data)
+
+    def apply(self, x):
+        return x
+
+
+class _HonestDonor(Transformer):
+    donates_deps = (0,)
+
+    def apply_batch(self, data):
+        return _donated_step(data, data)
+
+    def apply(self, x):
+        return x
+
+
+class _MisindexedDonor(Transformer):
+    donates_deps = (0,)
+
+    def apply_batch(self, data):
+        return _misindexed_step(data, data)
+
+    def apply(self, x):
+        return x
+
+
+class _UnmaskedMasker(Transformer):
+    """Masks padded rows in the unfused batch path but does not declare
+    fuse_masks_output — the padded-row corruption class."""
+
+    fusable = True
+
+    def apply(self, x):
+        return x
+
+    def fuse(self):
+        return (("UnmaskedMasker",), (), lambda p, xb: xb)
+
+    def apply_batch(self, data):
+        return data.with_data(data.array * data.mask[:, None])
+
+
+class _DeclaredMasker(_UnmaskedMasker):
+    fuse_masks_output = True
+
+
+class _SuppressedNoFuse(Transformer):  # keystone: ignore[KP501]
+    """A genuine exception, suppressed explicitly on the class line."""
+
+    fusable = True
+
+    def apply(self, x):
+        return x
+
+
+class _StatefulEstimator(Estimator):
+    """fusable_fit promising a fit that yields a fusable-but-opaque
+    transformer (no structural fuse on _NoFuseStage)."""
+
+    fusable_fit = True
+
+    def fit(self, data):
+        return _NoFuseStage()
+
+
+class _CleanEstimator(Estimator):
+    fusable_fit = True
+
+    def fit(self, data):
+        return _CleanStage()
+
+
+def _rules(diags):
+    return sorted({d.rule for d in diags})
+
+
+# ------------------------------------------------------ KP501 (fuse key)
+
+
+def test_kp501_flags_fusable_without_fuse():
+    diags = audit_operator(_NoFuseStage())
+    assert _rules(diags) == ["KP501"]
+    assert diags[0].severity == Severity.WARNING
+    assert "fuse()" in diags[0].message
+
+
+def test_kp501_negative_structural_fuse():
+    assert audit_operator(_CleanStage(), [(6,)]) == []
+
+
+def test_kp501_regression_stripped_stats_stage():
+    """Stripping fuse() off a real stats stage re-introduces the PR-6
+    silent-retrace bug class — the audit makes it un-reintroducible."""
+    assert audit_operator(RandomSignNode(6), [(6,)]) == []
+    diags = audit_operator(_StrippedRandomSign(6))
+    assert _rules(diags) == ["KP501"]
+
+
+def test_kp501_detects_opaque_key_not_method_presence():
+    """Detection inspects the fused program KEY path: a fuse() that
+    returns an id-keyed (opaque) component is still flagged."""
+
+    class _OpaqueFuse(Transformer):
+        fusable = True
+
+        def apply(self, x):
+            return x
+
+        def fuse(self):
+            return (("opaque", id(self)), (), lambda p, xb: xb)
+
+    diags = audit_operator(_OpaqueFuse())
+    assert _rules(diags) == ["KP501"]
+    assert "opaque" in diags[0].message
+
+
+def test_kp501_via_fusable_fit_output():
+    diags = audit_operator(_StatefulEstimator())
+    assert _rules(diags) == ["KP501"]
+    assert "_NoFuseStage" in diags[0].message
+    assert audit_operator(_CleanEstimator()) == []
+
+
+def test_kp501_suppressed_on_class_line():
+    assert audit_operator(_SuppressedNoFuse()) == []
+
+
+# -------------------------------------------------- KP502 (distributivity)
+
+
+def test_kp502_flags_non_distributive_batch_path():
+    diags = audit_operator(_GramStage(), [(4,)])
+    assert _rules(diags) == ["KP502"]
+    assert diags[0].severity == Severity.ERROR
+
+    diags = audit_operator(_BatchMeanStage(), [(4,)])
+    assert _rules(diags) == ["KP502"]
+
+
+def test_kp502_negative_distributive_and_host_stages():
+    from keystone_tpu.nodes.stats.normalization import (
+        ColumnSampler,
+        NormalizeRows,
+    )
+
+    assert audit_operator(NormalizeRows(), [(6,)]) == []
+    # host-code batch path: not provable either way, never flagged
+    assert audit_operator(ColumnSampler(4), [(8, 6)]) == []
+
+
+# ------------------------------------------------------ KP503 (donation)
+
+
+def test_kp503_flags_undonated_and_misindexed_steps():
+    diags = audit_operator(_UndonatedDonor())
+    assert _rules(diags) == ["KP503"]
+    assert "donate_argnums" in diags[0].message
+
+    diags = audit_operator(_MisindexedDonor())
+    assert _rules(diags) == ["KP503"]
+    assert "mis-indexed" in diags[0].message
+
+
+def test_kp503_negative_honest_donor():
+    assert audit_operator(_HonestDonor()) == []
+
+
+class _SubclassedDonor(_HonestDonor):
+    """Empty-body subclass: donates_deps AND the donating step resolve
+    through the MRO — just as honest as the base."""
+
+
+def test_kp503_resolves_through_mro():
+    assert audit_operator(_SubclassedDonor()) == []
+
+
+# -------------------------------------------------------- KP504 (masking)
+
+
+def test_kp504_flags_unmasked_fused_stage():
+    diags = audit_operator(_UnmaskedMasker())
+    assert _rules(diags) == ["KP504"]
+    assert diags[0].severity == Severity.ERROR
+    assert "fuse_masks_output" in diags[0].message
+
+
+class _SubclassedMasker(_UnmaskedMasker):
+    """Empty-body subclass: the masking batch path is INHERITED, and so
+    is the padded-row contract it breaks."""
+
+
+def test_kp504_sees_inherited_masking_batch_path():
+    diags = audit_operator(_SubclassedMasker())
+    assert _rules(diags) == ["KP504"], diags
+
+
+def test_kp504_negative_declared_and_mask_aware():
+    from keystone_tpu.nodes.stats.scalers import StandardScalerModel
+    from keystone_tpu.nodes.util.fusion import FusedBatchTransformer
+
+    assert audit_operator(_DeclaredMasker()) == []
+    assert audit_operator(
+        StandardScalerModel(np.zeros(4, np.float32),
+                            np.ones(4, np.float32))) == []
+    # the fusion machinery threads masks through by construction
+    assert audit_operator(FusedBatchTransformer([_CleanStage()])) == []
+
+
+# -------------------------------------------------- registry-wide sweep
+
+
+def test_registry_audit_is_clean():
+    """Acceptance: the full built-in operator registry carries zero
+    unsuppressed KP5xx findings."""
+    findings, stats = audit_registry()
+    assert not findings, "\n".join(
+        f"{cls.__qualname__}: {d}" for cls, d in findings)
+    assert stats["classes"] > 80
+    assert stats["probed"] > 40
+
+
+def test_registry_discovers_node_and_fusion_classes():
+    names = {c.__name__ for c in operator_registry()}
+    assert {"RandomSignNode", "StandardScalerModel", "FusedBatchTransformer",
+            "MegafusedBatchTransformer", "LinearMapper",
+            "GrayScaler"} <= names
+
+
+def test_audit_class_reports_probe_status():
+    diags, probed = audit_class(RandomSignNode)
+    assert diags == [] and probed
+    # no probe, no declared contracts: class-level checks only, clean
+    from keystone_tpu.workflow.operators import DelegatingOperator
+
+    diags, _ = audit_class(DelegatingOperator)
+    assert diags == []
+
+
+# ---------------------------------------------- validate() integration
+
+
+def test_validate_full_surfaces_kp501():
+    pipe = _StrippedRandomSign(6).to_pipeline()
+    report = pipe.validate((6,), raise_on_error=False)
+    assert report.by_rule("KP501"), str(report)
+    # suppression channel
+    assert not pipe.validate(
+        (6,), ignore=["KP501"], raise_on_error=False).by_rule("KP501")
+
+
+def test_validate_full_surfaces_kp502_as_error():
+    pipe = _GramStage().to_pipeline()
+    report = pipe.validate((4,), raise_on_error=False)
+    kp502 = report.by_rule("KP502")
+    assert kp502 and kp502[0].severity == Severity.ERROR
+
+
+def test_validate_structure_tier_skips_contracts():
+    pipe = _StrippedRandomSign(6).to_pipeline()
+    report = pipe.validate((6,), level="structure", raise_on_error=False)
+    assert not report.by_rule("KP501")
+
+
+# ------------------------------------------------- effects + KP511
+
+
+class _EffectfulCounter(Transformer):
+    """Deliberately effectful: mutates instance state at apply time."""
+
+    chunkable = True
+
+    def __init__(self):
+        self.calls = 0
+
+    def apply(self, x):
+        self.calls = self.calls + 1
+        return x
+
+
+class _MemoizedStage(Transformer):
+    """The sanctioned instance-memo idiom: not an effect."""
+
+    def apply(self, x):
+        got = self.__dict__.get("_memo")
+        if got is None:
+            self.__dict__["_memo"] = got = 2.0
+        return x * got
+
+
+class _SuppressedEffect(Transformer):
+    def apply(self, x):
+        self.last = x  # keystone: ignore[KP511]
+        return x
+
+
+def test_effect_inference_finds_self_writes():
+    effects = class_effects(_EffectfulCounter)
+    assert any(e.kind == "self_write" and e.target == "attr:calls"
+               for e in effects)
+    assert class_effects(_MemoizedStage) == ()
+    assert class_effects(_SuppressedEffect) == ()
+    assert class_effects(_CleanStage) == ()
+
+
+def test_operator_effects_sees_composite_components():
+    from keystone_tpu.nodes.util.fusion import FusedBatchTransformer
+
+    inner = _EffectfulCounter()
+    eff = operator_effects(FusedBatchTransformer([inner]))
+    assert id(inner) in eff
+
+
+def _effectful_gather_pipeline(shared):
+    """Two parallel branches forcing the SAME effectful instance — the
+    concurrent scheduler may run them simultaneously."""
+    left = shared.to_pipeline() >> Transformer.from_function(
+        lambda x: x + 1.0, name="L")
+    right = shared.to_pipeline() >> Transformer.from_function(
+        lambda x: x - 1.0, name="R")
+    return Pipeline.gather([left, right])
+
+
+def test_kp511_true_positive_under_concurrent_scheduler():
+    shared = _EffectfulCounter()
+    pipe = _effectful_gather_pipeline(shared)
+    with dispatch_override(True, workers=4):
+        report = pipe.validate((4,), raise_on_error=False)
+    kp511 = report.by_rule("KP511")
+    assert kp511, str(report)
+    assert kp511[0].severity == Severity.WARNING
+    assert "simultaneously" in kp511[0].message
+
+
+def test_kp511_true_negative_with_scheduler_off():
+    """KEYSTONE_CONCURRENT_DISPATCH=0 totally orders every pair: the
+    race cannot occur and the diagnostic must not fire."""
+    shared = _EffectfulCounter()
+    pipe = _effectful_gather_pipeline(shared)
+    with dispatch_override(False):
+        report = pipe.validate((4,), raise_on_error=False)
+    assert not report.by_rule("KP511"), str(report)
+
+
+def test_kp511_ordered_chain_does_not_fire():
+    """A dependency chain orders the two effectful vertices — the
+    scheduler serializes them, so there is no race to flag."""
+    shared = _EffectfulCounter()
+    pipe = shared.to_pipeline() >> Transformer.from_function(
+        lambda x: x * 2.0, name="mid") >> shared
+    with dispatch_override(True, workers=4):
+        report = pipe.validate((4,), raise_on_error=False)
+    assert not report.by_rule("KP511"), str(report)
+
+
+def test_kp511_distinct_instances_do_not_fire():
+    """Two DIFFERENT instances writing their own state never race."""
+    left = _EffectfulCounter().to_pipeline()
+    right = _EffectfulCounter().to_pipeline()
+    pipe = Pipeline.gather([left, right])
+    with dispatch_override(True, workers=4):
+        report = pipe.validate((4,), raise_on_error=False)
+    assert not report.by_rule("KP511"), str(report)
+
+
+def test_concurrent_relation_matches_dag_order():
+    from keystone_tpu.workflow.executor import concurrent_relation
+
+    shared = _EffectfulCounter()
+    pipe = _effectful_gather_pipeline(shared)
+    applied = pipe.apply(SpecDataset((4,), count=8))
+    g = applied.graph
+    unordered = concurrent_relation(g)
+    # the two branch-head vertices hold the same operator instance
+    heads = [n for n in g.operators if g.get_operator(n) is shared]
+    assert len(heads) == 2
+    assert unordered(heads[0], heads[1])
+    # a vertex is ordered against its own downstream consumer
+    from keystone_tpu.workflow.analysis import children
+
+    kid = next(iter(children(g, heads[0])))
+    assert not unordered(heads[0], kid)
+
+
+def test_interference_pass_direct():
+    shared = _EffectfulCounter()
+    pipe = _effectful_gather_pipeline(shared)
+    applied = pipe.apply(SpecDataset((4,), count=8))
+    diags = interference_pass(applied.graph)
+    assert diags and all(d.rule == "KP511" for d in diags)
+
+
+# ------------------------------------------------------------- doc sync
+
+
+def _catalog_codes():
+    text = (REPO / "ANALYSIS.md").read_text()
+    return {m.group(1) for m in
+            re.finditer(r"^\|\s*(K[PJ]\d{3})\s*\|", text, re.M)}
+
+
+def test_analysis_md_documents_every_rule():
+    """Doc-sync: every KP/KJ code emitted by diagnostics.py/jaxlint.py
+    has a row in ANALYSIS.md and vice versa — the catalog can no longer
+    run one PR behind."""
+    import importlib.util
+
+    from keystone_tpu.analysis.diagnostics import RULES as KP_RULES
+
+    spec = importlib.util.spec_from_file_location(
+        "jaxlint", REPO / "scripts" / "jaxlint.py")
+    jaxlint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(jaxlint)
+
+    emitted = set(KP_RULES) | set(jaxlint.RULES)
+    documented = _catalog_codes()
+    missing = emitted - documented
+    stale = documented - emitted
+    assert not missing, f"rules emitted but undocumented: {sorted(missing)}"
+    assert not stale, f"rules documented but never emitted: {sorted(stale)}"
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_audit_cli_json_output():
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "keystone_tpu.analysis",
+         "--audit-operators", "--json"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["findings"] == []
+    assert payload["audited_classes"] > 80
+
+
+def test_jaxlint_json_output(tmp_path):
+    bad = tmp_path / "nodes" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "class T:\n"
+        "    def apply(self, x):\n"
+        "        self.state = x\n"
+        "        return x\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "jaxlint.py"), "--json",
+         str(bad)],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    payload = json.loads(out.stdout)
+    assert payload["total"] == 1
+    assert payload["findings"][0]["rule"] == "KJ008"

@@ -389,6 +389,87 @@ def test_kj007_suppression(tmp_path):
     assert jl.lint_file(f) == []
 
 
+def test_kj008_flags_hot_path_state_writes(tmp_path):
+    """KJ008: assignment to self.*, a declared global, or a module-level
+    container inside apply/apply_batch/_chunk_loop is flagged in
+    workflow/ and nodes/ — the KP511 race class at the file level."""
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "bad_state.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "_TABLE = {}\n"
+        "_total = 0\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def apply(self, x):\n"
+        "        self.state = x\n"                       # KJ008
+        "        return x\n"
+        "\n"
+        "    def apply_batch(self, data):\n"
+        "        global _total\n"
+        "        _total = _total + 1\n"                  # KJ008
+        "        _TABLE[id(data)] = data\n"              # KJ008
+        "        _TABLE.setdefault(0, data)\n"           # KJ008
+        "        return data\n"
+        "\n"
+        "    def fit(self, data):\n"
+        "        self.model = data\n"                    # fit: not hot
+        "        return self\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ008"] * 4, findings
+    assert sorted(f.line for f in findings) == [7, 12, 13, 14]
+
+    # outside workflow/ and nodes/, the rule does not apply
+    elsewhere = tmp_path / "loaders" / "ok_state.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj008_sanctioned_idioms_pass(tmp_path):
+    """KJ008 negatives: the self.__dict__ memo idiom, structure-keyed
+    caches (*CACHE*/*PENDING* names), local mutations, and writes
+    outside hot-path methods."""
+    jl = _jaxlint()
+    good = tmp_path / "workflow" / "good_state.py"
+    good.parent.mkdir(parents=True)
+    good.write_text(
+        "_PROGRAM_CACHE = {}\n"
+        "_WARMUP_PENDING = {}\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self.model = None\n"                    # wiring: not hot
+        "\n"
+        "    def apply(self, x):\n"
+        "        f = self.__dict__.get('_jitted')\n"
+        "        if f is None:\n"
+        "            self.__dict__['_jitted'] = f = x\n"  # memo idiom: ok
+        "        _PROGRAM_CACHE[id(x)] = x\n"             # cache: ok\n
+        "        _WARMUP_PENDING.pop(id(x), None)\n"      # cache: ok
+        "        out = []\n"
+        "        out.append(x)\n"                         # local: ok
+        "        return out\n"
+    )
+    assert jl.lint_file(good) == []
+
+
+def test_kj008_suppression(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "nodes" / "suppressed_state.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "class T:\n"
+        "    def apply(self, x):\n"
+        "        self.last = x  # keystone: ignore[KJ008]\n"
+        "        return x\n"
+    )
+    assert jl.lint_file(f) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""
