@@ -44,6 +44,14 @@ from .memory import (
     resolve_chunk_rows,
 )
 from .propagate import spec_pass, structural_pass, toposort
+from .sharding import (
+    PartitionRule,
+    ShardedValue,
+    ShardingResult,
+    fit_sharding_demands,
+    per_device_pass,
+    sharding_pass,
+)
 from .specs import (
     UNKNOWN,
     DataSpec,
@@ -67,12 +75,15 @@ def validate_graph(
     ignore: Iterable[str] = (),
     hbm_budget_bytes: Optional[int] = None,
     chunk_rows: Optional[int] = None,
+    partition_rules: Iterable = (),
 ) -> ValidationReport:
     """Run the analyzer tiers up to ``level`` over a lowered graph.
 
     ``source_specs`` maps each unbound `SourceId` to its abstract input
     spec (anything `as_source_spec` accepts); unlisted sources propagate
-    UNKNOWN. Never touches data or devices."""
+    UNKNOWN. ``partition_rules`` (level="full") are declarative
+    `sharding.PartitionRule`s / ``(regex, PartitionSpec)`` pairs pinning
+    per-stage placement. Never touches data or devices."""
     if level not in LEVELS:
         raise ValueError(f"level must be one of {LEVELS}, got {level!r}")
     tier = LEVELS.index(level)
@@ -80,6 +91,7 @@ def validate_graph(
     diags = list(structural_pass(graph))
     specs: Dict = {}
     memory: Optional[MemoryEstimate] = None
+    shardings: Dict = {}
 
     if tier >= 1:
         normalized = {
@@ -114,8 +126,30 @@ def validate_graph(
             from .effects import interference_pass
 
             diags.extend(interference_pass(graph))
+        # sharding tier: partition-spec propagation + collective lints
+        # (KP601-604) + the per-device memory model. KP600 REPLACES the
+        # whole-fleet KP202 budget check here: once placement is known,
+        # "peak live set vs budget" is a per-chip question — the fleet
+        # sum is not what any device's allocator sees.
+        from .sharding import per_device_pass, sharding_pass
 
-    report = ValidationReport(diags, specs=specs, memory=memory, level=level)
+        shardings, shard_diags, _ = sharding_pass(
+            graph, specs, rules=partition_rules)
+        diags.extend(shard_diags)
+        if memory is not None:
+            budget = hbm_budget_bytes
+            if budget is None:
+                budget = cfg.hbm_budget_bytes
+            _, pd_diags = per_device_pass(
+                graph, specs, shardings, memory,
+                hbm_budget_bytes=budget)
+            # the per-device check supersedes the whole-fleet one: a
+            # fleet sum over budget while every chip is under is not a
+            # violation, and a chip over budget is KP600's finding
+            diags = [d for d in diags if d.rule != "KP202"] + pd_diags
+
+    report = ValidationReport(diags, specs=specs, memory=memory,
+                              level=level, shardings=shardings)
     return report.filter(ignore) if ignore else report
 
 
@@ -131,9 +165,12 @@ __all__ = [
     "Diagnostic",
     "LEVELS",
     "MemoryEstimate",
+    "PartitionRule",
     "PipelineValidationError",
     "RULES",
     "Severity",
+    "ShardedValue",
+    "ShardingResult",
     "SpecDataset",
     "SpecMismatchError",
     "TransformerSpec",
@@ -145,11 +182,14 @@ __all__ = [
     "class_effects",
     "contract_pass",
     "element_nbytes",
+    "fit_sharding_demands",
     "hazard_pass",
     "interference_pass",
     "operator_effects",
     "memory_pass",
+    "per_device_pass",
     "resolve_chunk_rows",
+    "sharding_pass",
     "shape_struct",
     "spec_of",
     "spec_pass",

@@ -6,13 +6,24 @@ registry.
     python -m keystone_tpu.analysis --level specs --hbm-budget-gb 16
     python -m keystone_tpu.analysis --audit-operators   # registry-wide KP5xx
     python -m keystone_tpu.analysis --audit-operators --json
+    python -m keystone_tpu.analysis --explain-sharding  # per-stage placement
+    python -m keystone_tpu.analysis --explain-sharding --json
     python -m keystone_tpu.analysis --list-rules
 
 Exit code 1 if any example produces ERROR-severity findings (or any
 finding at all with ``--strict``), or — under ``--audit-operators`` — if
 ANY unsuppressed KP5xx contract finding remains anywhere in the
-registered operator registry. Runs entirely abstractly — no data loads,
-no device programs execute.
+registered operator registry, or — under ``--explain-sharding`` — if any
+unsuppressed KP6xx sharding finding remains in any example. Runs
+entirely abstractly — no data loads, no device programs execute.
+
+``--explain-sharding`` renders, per example, the propagated per-stage
+partition table: spec (analysis/sharding.py's propagation over the
+current mesh), per-device bytes (the KP2xx residency divided by each
+leaf's shard count), and the priced boundary collective cost (KP601
+all-to-all / KP603 all-gather bytes). Run it on a multi-device mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) to see real
+shard counts; a 1-device mesh degenerates to whole-value placement.
 """
 
 from __future__ import annotations
@@ -58,6 +69,85 @@ def _audit_main(args) -> int:
     return 1 if findings else 0
 
 
+def _explain_sharding_main(args) -> int:
+    """Per-example sharding explanation (KP6xx gate): propagate partition
+    specs, scale memory per device, price boundary collectives, and fail
+    on any unsuppressed KP6xx finding."""
+    from ..parallel import mesh as meshlib
+    from ..workflow.env import execution_config
+    from .memory import memory_pass
+    from .propagate import spec_pass
+    from .sharding import (
+        explain_rows,
+        format_explain,
+        per_device_pass,
+        sharding_pass,
+    )
+    from . import as_source_spec
+
+    names = args.examples or sorted(EXAMPLES)
+    unknown = [n for n in names if n not in EXAMPLES]
+    if unknown:
+        print(f"unknown example(s): {', '.join(unknown)}; "
+              f"known: {', '.join(sorted(EXAMPLES))}", file=sys.stderr)
+        return 2
+    mesh = meshlib.current_mesh()
+    budget = (int(args.hbm_budget_gb * (1 << 30))
+              if args.hbm_budget_gb else execution_config().hbm_budget_bytes)
+
+    failed = False
+    records = []
+    for name in names:
+        try:
+            pipeline, source_spec = build_example(name)
+            graph = pipeline.graph
+            specs, _ = spec_pass(
+                graph, {pipeline.source: as_source_spec(source_spec)})
+            shardings, diags, boundary = sharding_pass(graph, specs)
+            est, _ = memory_pass(graph, specs)
+            per_dev, pd_diags = per_device_pass(
+                graph, specs, shardings, est, hbm_budget_bytes=budget)
+            diags = [d for d in diags + pd_diags
+                     if d.rule not in set(args.ignore)]
+            rows = explain_rows(graph, specs, shardings, boundary, per_dev)
+        except Exception as e:  # a factory bug is a failure, not a crash
+            if args.json:
+                records.append({"example": name, "build_error":
+                                f"{type(e).__name__}: {e}"})
+            else:
+                print(f"✗ {name}: failed to build/explain: "
+                      f"{type(e).__name__}: {e}")
+            failed = True
+            continue
+        failed |= bool(diags)
+        if args.json:
+            records.append({
+                "example": name,
+                "devices": int(mesh.devices.size),
+                "per_device_peak_bytes": est.per_device_peak_bytes,
+                "stages": rows,
+                "findings": [
+                    {"rule": d.rule, "severity": d.severity.name,
+                     "anchor": d.anchor, "message": d.message}
+                    for d in diags
+                ],
+            })
+        else:
+            mark = "✗" if diags else "✓"
+            print(f"{mark} {name} (mesh: {int(mesh.devices.size)} device(s), "
+                  f"per-device peak ≈ "
+                  f"{est.per_device_peak_bytes >> 10} KiB)")
+            print("  " + format_explain(rows).replace("\n", "\n  "))
+            for d in diags:
+                print(f"    {d}")
+    if args.json:
+        print(json.dumps({
+            "devices": int(mesh.devices.size),
+            "examples": records,
+        }, indent=2))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m keystone_tpu.analysis", description=__doc__,
@@ -74,6 +164,10 @@ def main(argv=None) -> int:
     p.add_argument("--audit-operators", action="store_true",
                    help="sweep EVERY registered Operator/Estimator subclass "
                         "for KP5xx contract violations (zero tolerated)")
+    p.add_argument("--explain-sharding", action="store_true",
+                   help="render each example's per-stage partition table "
+                        "(spec, per-device bytes, boundary collective "
+                        "cost) and fail on any unsuppressed KP6xx finding")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (CI annotation)")
     p.add_argument("--list-rules", action="store_true")
@@ -86,6 +180,9 @@ def main(argv=None) -> int:
 
     if args.audit_operators:
         return _audit_main(args)
+
+    if args.explain_sharding:
+        return _explain_sharding_main(args)
 
     names = args.examples or sorted(EXAMPLES)
     unknown = [n for n in names if n not in EXAMPLES]

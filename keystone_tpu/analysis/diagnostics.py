@@ -51,6 +51,26 @@ RULES = {
     "KP401": "megafusion-fallback: a stage keeps this plan from collapsing "
              "to one XLA program (fan-out, host code, or a streaming "
              "origin); the per-program dispatch path remains",
+    # sharding tier (partition-spec propagation; see analysis/sharding)
+    "KP600": "per-device-hbm: peak live memory per device — live-set "
+             "residency divided over each leaf's actual shard count — "
+             "exceeds the per-device HBM budget",
+    "KP601": "implicit-reshard: producer and consumer disagree on a stage "
+             "boundary's partition spec; XLA inserts an all-to-all of the "
+             "boundary bytes there",
+    "KP602": "large-operand-replicated: an array above the replication "
+             "threshold is held replicated although a mesh axis could "
+             "shard one of its dimensions evenly",
+    "KP603": "gather-of-sharded-into-host: a host-code stage consumes "
+             "device-sharded data, forcing an all-gather of every shard "
+             "onto the host",
+    "KP604": "mesh-indivisible-rows: the data-shard count does not divide "
+             "the propagated example count, so padded/ragged shards "
+             "change per-device shapes (and recompile) across stages",
+    "KP605": "invalid-partition-rule: a PartitionRule pins a spec that "
+             "cannot apply to the matched stage — more entries than the "
+             "value has dimensions, or a mesh axis the current mesh does "
+             "not have",
     # contract tier (registry-wide operator audit; see analysis/contracts)
     "KP501": "fusable-without-structural-fuse: a fusable stage's fused "
              "program key is id-keyed (opaque), so fused programs "
@@ -100,11 +120,15 @@ class ValidationReport:
         specs: Optional[dict] = None,
         memory: Optional[Any] = None,
         level: str = "structure",
+        shardings: Optional[dict] = None,
     ):
         self.diagnostics: List[Diagnostic] = list(diagnostics)
         self.specs = specs or {}
         self.memory = memory
         self.level = level
+        #: per-vertex propagated partition specs (analysis/sharding.py);
+        #: populated at level="full", empty otherwise
+        self.shardings = shardings or {}
 
     # ------------------------------------------------------------- views
 
@@ -130,6 +154,7 @@ class ValidationReport:
         return ValidationReport(
             [d for d in self.diagnostics if d.rule not in ignore],
             specs=self.specs, memory=self.memory, level=self.level,
+            shardings=self.shardings,
         )
 
     def raise_for_errors(self) -> "ValidationReport":

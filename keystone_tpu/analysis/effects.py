@@ -113,6 +113,14 @@ def _is_self_dict(node: ast.AST) -> bool:
             and node.value.id == "self")
 
 
+def _is_self_dict_chain(node: ast.AST) -> bool:
+    """``self.__dict__`` or ``self.__dict__[...]`` — mutator calls on
+    either are the sanctioned memo idiom, not shared-state mutation."""
+    if _is_self_dict(node):
+        return True
+    return isinstance(node, ast.Subscript) and _is_self_dict(node.value)
+
+
 def _first_attr(node: ast.AST) -> str:
     """Attribute name nearest ``self`` in a chain: self.a.b[c] → a."""
     names = []
@@ -154,10 +162,13 @@ def _method_effects(
             if isinstance(sub.func.value, ast.Name) \
                     and sub.func.value.id == "self":
                 helpers.add(sub.func.attr)
-            # in-place mutation of a module-level container, one
-            # attribute/subscript hop allowed (_TABLE["k"].append(...))
+            # in-place mutation of a module-level OR instance-held
+            # container, one attribute/subscript hop allowed
+            # (_TABLE["k"].append(...), self.seen.append(...)) — the
+            # mutator spelling races exactly like the subscript-assign
+            # spelling (self.seen[k] = v) already recorded below
             if sub.func.attr in _MUTATOR_CALLS \
-                    and not _is_self_dict(sub.func.value) \
+                    and not _is_self_dict_chain(sub.func.value) \
                     and not _suppressed(lines, sub.lineno, "KP511"):
                 root = _attr_chain_root(sub.func.value)
                 if isinstance(root, ast.Name) \
@@ -165,6 +176,13 @@ def _method_effects(
                     effects.append(Effect(
                         "container_mutation",
                         f"{module_name}:{root.id}", where(sub)))
+                elif isinstance(root, ast.Name) and root.id == "self" \
+                        and isinstance(sub.func.value,
+                                       (ast.Attribute, ast.Subscript)):
+                    effects.append(Effect(
+                        "self_write",
+                        f"attr:{_first_attr(sub.func.value)}",
+                        where(sub)))
 
         if not isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
             continue

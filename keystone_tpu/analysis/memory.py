@@ -77,12 +77,58 @@ class MemoryEstimate:
     peak_bytes: int = 0
     peak_at: Optional[GraphId] = None
     unknown_nodes: int = 0
+    #: per-device picture, filled in by `analysis.sharding.per_device_pass`
+    #: when the sharding tier runs (level="full"): residency scaled by
+    #: each node's actual shard counts. Empty/zero until then.
+    per_device: Dict[NodeId, Optional[int]] = field(default_factory=dict)
+    per_device_peak_bytes: int = 0
+    per_device_peak_at: Optional[GraphId] = None
 
     def __repr__(self) -> str:
         return (
             f"MemoryEstimate(peak={_fmt_bytes(self.peak_bytes)} at "
             f"{self.peak_at}, {self.unknown_nodes} unknown node(s))"
         )
+
+
+def live_set_walk(
+    graph: Graph,
+    order: List[GraphId],
+    residents: Dict[NodeId, Optional[int]],
+) -> Tuple[int, Optional[GraphId]]:
+    """THE live-set walk, shared by the whole-fleet model here and the
+    per-device model (`analysis.sharding.per_device_pass`): a vertex's
+    output is live from production through its last consumer's schedule
+    position, sinks pin their dependency forever. One implementation so
+    the two pictures can never diverge semantically — they differ only
+    in the residency numbers fed in. Returns ``(peak_bytes, peak_at)``."""
+    sched_pos = {v: i for i, v in enumerate(order)}
+    last_use: Dict[NodeId, int] = {}
+    pinned: set = set()
+    for vid in residents:
+        users = graph.users_of(vid)
+        if any(isinstance(u, SinkId) for u in users):
+            pinned.add(vid)
+        last_use[vid] = max(
+            (sched_pos[u] for u in users if u in sched_pos),
+            default=sched_pos.get(vid, 0),
+        )
+
+    live = 0
+    peak = 0
+    peak_at: Optional[GraphId] = None
+    expiring: Dict[int, List[NodeId]] = {}
+    for vid, end in last_use.items():
+        expiring.setdefault(end, []).append(vid)
+    for i, v in enumerate(order):
+        if isinstance(v, NodeId) and residents.get(v) is not None:
+            live += residents[v]
+            if live > peak:
+                peak, peak_at = live, v
+        for dead in expiring.get(i, ()):
+            if dead not in pinned and residents.get(dead) is not None:
+                live -= residents[dead]
+    return peak, peak_at
 
 
 def memory_pass(
@@ -107,7 +153,6 @@ def memory_pass(
     inflight_chunks = 2 * prefetch_depth + 2  # utils/batching.py bound
 
     order, _ = toposort(graph)
-    sched_pos = {v: i for i, v in enumerate(order)}
     est = MemoryEstimate()
     diags: List[Diagnostic] = []
 
@@ -174,31 +219,7 @@ def memory_pass(
                    f"{_fmt_bytes(resident)})" if resident < full else ""),
                 vertex=vid, label=_label(graph, vid)))
 
-    # Live-set walk: vertex output is live from production through its
-    # last consumer's schedule position (sinks pin their dep forever).
-    last_use: Dict[NodeId, int] = {}
-    pinned: set = set()
-    for vid in est.per_node:
-        users = graph.users_of(vid)
-        if any(isinstance(u, SinkId) for u in users):
-            pinned.add(vid)
-        last_use[vid] = max(
-            (sched_pos[u] for u in users if u in sched_pos),
-            default=sched_pos[vid],
-        )
-
-    live = 0
-    expiring: Dict[int, List[NodeId]] = {}
-    for vid, end in last_use.items():
-        expiring.setdefault(end, []).append(vid)
-    for i, v in enumerate(order):
-        if isinstance(v, NodeId) and est.resident.get(v) is not None:
-            live += est.resident[v]
-            if live > est.peak_bytes:
-                est.peak_bytes, est.peak_at = live, v
-        for dead in expiring.get(i, ()):
-            if dead not in pinned and est.resident.get(dead) is not None:
-                live -= est.resident[dead]
+    est.peak_bytes, est.peak_at = live_set_walk(graph, order, est.resident)
 
     if hbm_budget_bytes and est.peak_bytes > hbm_budget_bytes:
         diags.append(Diagnostic(

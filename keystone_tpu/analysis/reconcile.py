@@ -53,11 +53,15 @@ def reconcile_trace(trace: Dict[str, Any]) -> Dict[str, Any]:
     """Join the trace's static estimates against its observed bytes.
 
     Returns ``{"rows": [...], "static_peak_bytes", "observed_peak_bytes",
-    "peak_rel_error"}`` where each row carries ``label``, ``vertex``,
-    ``static_bytes``, ``observed_bytes`` and ``rel_error`` (signed,
-    relative to the observation: +1.0 means the model predicted double).
-    Nodes with only one side known are reported with ``rel_error=None``
-    so coverage gaps stay visible instead of silently dropping."""
+    "peak_rel_error", "static_per_device_peak_bytes"}`` where each row
+    carries ``label``, ``vertex``, ``static_bytes``, ``observed_bytes``
+    and ``rel_error`` (signed, relative to the observation: +1.0 means
+    the model predicted double), plus — when the sharding tier ran — the
+    propagated ``spec`` and ``static_per_device_bytes`` (one shard's
+    predicted bytes; on a mesh this is what each chip's allocator sees,
+    the number the KP600 budget lints against). Nodes with only one side
+    known are reported with ``rel_error=None`` so coverage gaps stay
+    visible instead of silently dropping."""
     ks = trace.get("keystone", {})
     static = (ks.get("static_memory") or {}).get("per_node", {})
     observed = observed_node_bytes(trace)
@@ -77,6 +81,8 @@ def reconcile_trace(trace: Dict[str, Any]) -> Dict[str, Any]:
             "static_bytes": static_b,
             "observed_bytes": obs_b,
             "rel_error": rel,
+            "spec": (s or {}).get("spec"),
+            "static_per_device_bytes": (s or {}).get("per_device_bytes"),
         })
     # nodes with both sides first, largest observation first — the head
     # of the table is what calibration actually reads
@@ -98,6 +104,8 @@ def reconcile_trace(trace: Dict[str, Any]) -> Dict[str, Any]:
         "static_peak_bytes": static_peak,
         "observed_peak_bytes": observed_peak,
         "peak_rel_error": peak_rel,
+        "static_per_device_peak_bytes": (
+            (ks.get("static_memory") or {}).get("per_device_peak_bytes")),
     }
 
 
@@ -112,19 +120,30 @@ def _fmt(n: Optional[float]) -> str:
 
 
 def format_reconciliation(rec: Dict[str, Any], top: int = 20) -> str:
+    per_dev = any(r.get("static_per_device_bytes") is not None
+                  for r in rec["rows"])
     lines = ["== static vs observed memory (KP2xx calibration) =="]
-    lines.append(f"{'node':<40} {'static':>10} {'observed':>10} {'err %':>8}")
+    head = f"{'node':<40} {'static':>10} {'observed':>10} {'err %':>8}"
+    if per_dev:
+        head += f" {'per-dev':>10}"
+    lines.append(head)
     for r in rec["rows"][:top]:
         err = (f"{100 * r['rel_error']:+.1f}%"
                if r["rel_error"] is not None else "—")
-        lines.append(
+        line = (
             f"{r['label'][:40]:<40} {_fmt(r['static_bytes']):>10} "
             f"{_fmt(r['observed_bytes']):>10} {err:>8}"
         )
+        if per_dev:
+            line += f" {_fmt(r.get('static_per_device_bytes')):>10}"
+        lines.append(line)
     sp, op_, pr = (rec["static_peak_bytes"], rec["observed_peak_bytes"],
                    rec["peak_rel_error"])
     if sp is not None or op_ is not None:
         err = f"{100 * pr:+.1f}%" if pr is not None else "—"
-        lines.append(
+        line = (
             f"{'PEAK LIVE SET':<40} {_fmt(sp):>10} {_fmt(op_):>10} {err:>8}")
+        if per_dev:
+            line += f" {_fmt(rec.get('static_per_device_peak_bytes')):>10}"
+        lines.append(line)
     return "\n".join(lines)

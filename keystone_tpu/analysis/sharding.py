@@ -1,0 +1,723 @@
+"""Static sharding analyzer: partition-spec propagation, per-device
+memory, collective-cost lints (KP6xx).
+
+KeystoneML's optimizer picks physical operators from cost models *before*
+execution; the KP1xx–KP5xx tiers already do that for shapes, memory, and
+operator contracts. This pass makes *placement* a checked, priced
+property too: every stage boundary of a lowered Graph is assigned a
+`jax.sharding.PartitionSpec` (per element leaf, leading example axis
+included), flowed the same way the runtime actually places data —
+
+  - **seeded** from `data.dataset.leaf_sharding`'s placement decision
+    (leading axis over ``"data"``; 1-D elements additionally shard their
+    feature axis over ``"model"`` when the mesh has one and the width
+    divides — the VectorSplitter analog),
+  - **propagated** through operator ``abstract_sharding`` hooks when
+    declared (solver fits state their row-sharded input demands this
+    way), with a default rule: leading-axis data sharding survives
+    elementwise/chunkable device stages, collapses to replicated when
+    the input was replicated, and dies at host-code stages,
+  - **overridden** by declarative regex partition rules
+    (`PartitionRule`), so a pipeline can pin per-stage placement without
+    touching node code (the `match_partition_rules` idiom).
+
+On top of the propagated specs:
+
+  - the KP2xx memory model goes **per-device** (`per_device_pass`):
+    live-set residency divided by each leaf's actual shard count,
+    replicated operands charged in full per device, with a KP600 budget
+    violation replacing the whole-fleet KP202 estimate at the full tier
+    — the memory-safe-XLA discipline of arXiv 2206.14148 applied per
+    chip;
+  - a collective/reshard detector prices boundary movement: KP601
+    implicit reshard (producer and consumer specs disagree → an
+    all-to-all of the boundary bytes), KP602 large-operand-replicated,
+    KP603 gather-of-sharded-into-host (an all-gather of every shard),
+    KP604 mesh-indivisible example counts (ragged/padded shards change
+    per-device shapes and recompile).
+
+Everything here is pure spec arithmetic: no data moves, no device
+allocates, no program compiles. Surfaced through
+``validate(level="full")`` and ``python -m keystone_tpu.analysis
+--explain-sharding``.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import mesh as meshlib
+from ..workflow.graph import Graph, GraphId, NodeId, SinkId, SourceId
+from .diagnostics import Diagnostic, Severity
+from .memory import MemoryEstimate, _fmt_bytes, live_set_walk
+from .propagate import _label, toposort
+from .specs import UNKNOWN, DataSpec, is_known
+
+#: Replicated operands smaller than this never trip KP602 — broadcasting
+#: a scaler's mean vector is free; broadcasting a feature matrix is not.
+DEFAULT_REPLICATED_THRESHOLD = 64 << 20
+
+#: `abstract_sharding` demand values: what a dependency's layout must be
+#: for the operator's device program to run collective-free.
+DEMAND_DATA_SHARDED = "data-sharded"
+DEMAND_REPLICATED = "replicated"
+
+
+# ------------------------------------------------------------------ values
+
+
+@dataclass(frozen=True)
+class ShardedValue:
+    """Propagated sharding of one vertex: a pytree of `PartitionSpec`s
+    aligned with the vertex's `DataSpec` element leaves. Dataset specs
+    are *batch-level* (a leading example axis precedes the element
+    dims); datum specs match the element rank exactly."""
+
+    specs: Any
+    kind: str = "dataset"  # "dataset" | "datum"
+
+    def leaf_specs(self) -> List[P]:
+        return [
+            s for s in jax.tree_util.tree_leaves(
+                self.specs, is_leaf=lambda x: isinstance(x, P))
+        ]
+
+    def max_shards(self, mesh=None) -> int:
+        """Largest shard count any leaf is split into (1 = replicated)."""
+        mesh = mesh or meshlib.current_mesh()
+        return max(
+            (meshlib.spec_shards(s, mesh) for s in self.leaf_specs()),
+            default=1)
+
+    def __repr__(self) -> str:
+        return f"ShardedValue[{spec_str(self)}]"
+
+
+def spec_str(sv: Optional["ShardedValue"]) -> str:
+    """Human-readable spec — the per-stage table's second column."""
+    if sv is None:
+        return "—"
+
+    def one(s: P) -> str:
+        entries = ", ".join(repr(e) if e is not None else "None" for e in s)
+        return f"P({entries})" if entries else "P()"
+
+    leaves = sv.leaf_specs()
+    if len(leaves) == 1:
+        return one(leaves[0])
+    return "(" + ", ".join(one(s) for s in leaves) + ")"
+
+
+@dataclass(frozen=True)
+class ShardingResult:
+    """Return value of an operator's optional ``abstract_sharding(
+    in_shardings, in_specs)`` hook.
+
+    ``out``: the output `ShardedValue` (None → the default rule decides).
+    ``demands``: per-dependency input layout demands
+    (`DEMAND_DATA_SHARDED` / `DEMAND_REPLICATED` / None) — a producer
+    whose propagated spec disagrees with a demand is an implicit reshard
+    boundary (KP601), priced at the producer's full bytes."""
+
+    out: Optional[ShardedValue] = None
+    demands: Tuple[Optional[str], ...] = ()
+
+
+def fit_sharding_demands(n_deps: int) -> ShardingResult:
+    """The distributed-solver hook: every training dependency must
+    arrive row-sharded over the ``data`` axis (the TSQR per-shard QR /
+    BCD per-shard Gram layout); the fitted model itself is replicated
+    state, not a dataset, so no output sharding is declared."""
+    return ShardingResult(demands=(DEMAND_DATA_SHARDED,) * n_deps)
+
+
+@dataclass(frozen=True)
+class PartitionRule:
+    """Declarative placement override: ``pattern`` is a regex matched
+    (re.search) against the stage label and its ``label@vertex`` anchor;
+    ``spec`` is the PartitionSpec pinned on every output leaf of the
+    first matching stage. First matching rule wins."""
+
+    pattern: str
+    spec: P
+
+    def matches(self, label: str, anchor: str) -> bool:
+        return re.search(self.pattern, label) is not None or \
+            re.search(self.pattern, anchor) is not None
+
+
+def _as_rules(rules) -> List[PartitionRule]:
+    out = []
+    for r in rules or ():
+        if isinstance(r, PartitionRule):
+            out.append(r)
+        else:
+            pattern, spec = r
+            out.append(PartitionRule(pattern, spec))
+    return out
+
+
+# ----------------------------------------------------------------- seeding
+
+
+def element_leaf_spec(mesh, elem_leaf) -> P:
+    """Batch-level PartitionSpec `Dataset` placement would give a leaf
+    with this per-item shape — the static mirror of
+    `data.dataset.leaf_sharding` (which operates on the padded batch
+    shape): leading example axis over ``data``; 1-D elements shard the
+    feature axis over ``model`` when the mesh has one and the width
+    divides evenly."""
+    shape = tuple(getattr(elem_leaf, "shape", ()))
+    if len(shape) == 1:
+        model = int(mesh.shape.get(meshlib.MODEL_AXIS, 1))
+        if model > 1 and shape[0] % model == 0:
+            return P(meshlib.DATA_AXIS, meshlib.MODEL_AXIS)
+    return P(meshlib.DATA_AXIS, *([None] * len(shape)))
+
+
+def seed_sharding(spec: Any, mesh) -> Optional[ShardedValue]:
+    """Placement of a freshly materialized value: what `Dataset.__init__`
+    / `HostDataset.stack` would assign. None for host values and unknown
+    elements (there is nothing on device to shard)."""
+    if not isinstance(spec, DataSpec) or not is_known(spec.element) \
+            or not spec.on_device:
+        return None
+    if spec.kind == "datum":
+        specs = jax.tree_util.tree_map(
+            lambda l: P(*([None] * len(getattr(l, "shape", ())))),
+            spec.element)
+        return ShardedValue(specs, kind="datum")
+    specs = jax.tree_util.tree_map(
+        lambda l: element_leaf_spec(mesh, l), spec.element)
+    return ShardedValue(specs, kind="dataset")
+
+
+def _replicated_like(spec: DataSpec) -> Optional[ShardedValue]:
+    if not is_known(spec.element):
+        return None
+    extra = 1 if spec.kind == "dataset" else 0
+    specs = jax.tree_util.tree_map(
+        lambda l: P(*([None] * (len(getattr(l, "shape", ())) + extra))),
+        spec.element)
+    return ShardedValue(specs, kind=spec.kind)
+
+
+def _leading_axis(sv: Optional[ShardedValue]):
+    """Mesh axis (or None) the leading example dim is sharded over, read
+    off the first leaf. Datum values have no example axis → None."""
+    if sv is None or sv.kind != "dataset":
+        return None
+    leaves = sv.leaf_specs()
+    if not leaves or not len(leaves[0]):
+        return None
+    first = leaves[0][0]
+    if isinstance(first, (tuple, list)):
+        return first[0] if first else None
+    return first
+
+
+# ------------------------------------------------------------- propagation
+
+
+def _is_host_stage(graph: Graph, vid: NodeId, specs: Dict) -> bool:
+    """Statically provable host-code stage: a plain transformer whose
+    abstract trace died on host code (known input elements, UNKNOWN
+    output element) or whose output spec says host. Delegates and
+    estimators are excluded — a delegate's opaque fitted transformer is
+    *unknowable*, not provably host, and an estimator must see the whole
+    dataset by construction (the KP302 reasoning)."""
+    from ..workflow.operators import (
+        DelegatingOperator,
+        EstimatorOperator,
+        TransformerOperator,
+    )
+
+    op = graph.get_operator(vid)
+    if isinstance(op, (DelegatingOperator, EstimatorOperator)):
+        return False
+    if not isinstance(op, TransformerOperator):
+        return False
+    out = specs.get(vid)
+    if isinstance(out, DataSpec) and not out.on_device:
+        return True
+    in_specs = [specs.get(d) for d in graph.get_dependencies(vid)]
+    data_in = [s for s in in_specs if isinstance(s, DataSpec)]
+    if not data_in or not all(is_known(s.element) for s in data_in):
+        return False
+    return isinstance(out, DataSpec) and not is_known(out.element)
+
+
+def sharding_pass(
+    graph: Graph,
+    specs: Dict[GraphId, Any],
+    *,
+    mesh=None,
+    rules: Sequence = (),
+    replicated_threshold_bytes: int = DEFAULT_REPLICATED_THRESHOLD,
+) -> Tuple[Dict[GraphId, Optional[ShardedValue]], List[Diagnostic],
+           Dict[NodeId, int]]:
+    """Propagate partition specs over the graph and lint the boundaries.
+
+    Returns ``(shardings, diagnostics, boundary_costs)`` where
+    ``boundary_costs[vid]`` is the priced bytes of collective traffic
+    the placement implies at that stage's boundary (KP601 all-to-all,
+    KP603 all-gather). Pure spec arithmetic — zero device work."""
+    mesh = mesh or meshlib.current_mesh()
+    rules = _as_rules(rules)
+    order, _ = toposort(graph)
+    shardings: Dict[GraphId, Optional[ShardedValue]] = {}
+    diags: List[Diagnostic] = []
+    boundary: Dict[NodeId, int] = {}
+    data_shards = int(mesh.shape.get(meshlib.DATA_AXIS, 1))
+    flagged_counts: set = set()
+
+    def add_cost(vid: NodeId, nbytes: Optional[int]) -> None:
+        if nbytes:
+            boundary[vid] = boundary.get(vid, 0) + int(nbytes)
+
+    for vid in order:
+        if isinstance(vid, SourceId):
+            shardings[vid] = seed_sharding(specs.get(vid), mesh)
+            continue
+        if isinstance(vid, SinkId):
+            shardings[vid] = shardings.get(graph.get_sink_dependency(vid))
+            continue
+
+        op = graph.get_operator(vid)
+        deps = graph.get_dependencies(vid)
+        label = _label(graph, vid)
+        anchor = f"{label}@{vid}"
+        in_shardings = [shardings.get(d) for d in deps]
+        in_specs = [specs.get(d, UNKNOWN) for d in deps]
+        out_spec = specs.get(vid)
+
+        # ---- operator hook: demands + (optionally) the output placement
+        assigned: Optional[ShardedValue] = None
+        hook = getattr(op, "abstract_sharding", None)
+        if hook is not None:
+            try:
+                res = hook(in_shardings, in_specs)
+            except Exception as e:
+                # a buggy hook must not kill validation, but it must be
+                # loud: silently falling to the default rule would also
+                # silently drop the hook's KP601 demand checks, and the
+                # sharding gate would stay green on a broken hook
+                res = None
+                diags.append(Diagnostic(
+                    "KP605", Severity.WARNING,
+                    f"abstract_sharding hook raised "
+                    f"{type(e).__name__}: {e} — this stage's placement "
+                    "demands were skipped (default propagation applied)",
+                    vertex=vid, label=label))
+            if isinstance(res, ShardedValue):
+                res = ShardingResult(out=res)
+            if isinstance(res, ShardingResult):
+                assigned = res.out
+                if assigned is not None:
+                    problem = _sharded_value_problem(
+                        assigned, out_spec, mesh)
+                    if problem is not None:
+                        # same contract as rule specs (KP605): an
+                        # unrealizable placement must fail loudly, not
+                        # silently model shard-count 1
+                        diags.append(Diagnostic(
+                            "KP605", Severity.ERROR,
+                            f"abstract_sharding hook on this stage "
+                            f"returned {spec_str(assigned)} but "
+                            f"{problem}; the hook's placement is "
+                            "ignored here",
+                            vertex=vid, label=label))
+                        assigned = None
+                for i, demand in enumerate(res.demands):
+                    if demand is None or i >= len(deps):
+                        continue
+                    dep_sv = in_shardings[i]
+                    dep_spec = in_specs[i]
+                    if dep_sv is None or not isinstance(dep_spec, DataSpec):
+                        continue
+                    lead = _leading_axis(dep_sv)
+                    bad = (
+                        demand == DEMAND_DATA_SHARDED
+                        and lead != meshlib.DATA_AXIS
+                        and data_shards > 1
+                    ) or (
+                        demand == DEMAND_REPLICATED
+                        and dep_sv.max_shards(mesh) > 1
+                    )
+                    if bad:
+                        moved = dep_spec.nbytes
+                        add_cost(vid, moved)
+                        diags.append(Diagnostic(
+                            "KP601", Severity.WARNING,
+                            f"implicit reshard: dependency {i} "
+                            f"({_label(graph, deps[i])}@{deps[i]}) arrives "
+                            f"as {spec_str(dep_sv)} but this stage demands "
+                            f"a {demand} layout — XLA inserts an "
+                            f"all-to-all of ≈{_fmt_bytes(moved)} at this "
+                            "boundary",
+                            vertex=vid, label=label))
+
+        # ---- default rule when neither hook nor rule decided the output
+        if assigned is None:
+            assigned = _default_out_sharding(
+                op, out_spec, in_shardings, in_specs, mesh)
+
+        # ---- declarative regex override (first matching rule wins).
+        # Host-resident values take no device placement (mirroring
+        # seed_sharding/_default_out_sharding): pinning a device spec on
+        # one would divide per-device bytes by shards that don't exist
+        # and fabricate KP603 all-gathers downstream.
+        if isinstance(out_spec, DataSpec) and is_known(out_spec.element) \
+                and out_spec.on_device:
+            for rule in rules:
+                if not rule.matches(label, anchor):
+                    continue
+                problem = _rule_problem(rule, out_spec, mesh)
+                if problem is not None:
+                    # a rule the mesh/value cannot realize must fail
+                    # loudly — silently dividing by impossible shard
+                    # counts would corrupt every KP600/KP602 number
+                    diags.append(Diagnostic(
+                        "KP605", Severity.ERROR,
+                        f"partition rule {rule.pattern!r} pins "
+                        f"{rule.spec} on this stage but {problem}; the "
+                        "rule is ignored here",
+                        vertex=vid, label=label))
+                    break
+                pinned = ShardedValue(
+                    jax.tree_util.tree_map(lambda l: rule.spec,
+                                           out_spec.element),
+                    kind=out_spec.kind)
+                if assigned is not None and not _same_placement(
+                        assigned, pinned, mesh):
+                    moved = out_spec.nbytes
+                    add_cost(vid, moved)
+                    diags.append(Diagnostic(
+                        "KP601", Severity.WARNING,
+                        f"implicit reshard: propagation gives this stage "
+                        f"{spec_str(assigned)} but partition rule "
+                        f"{rule.pattern!r} pins {spec_str(pinned)} — the "
+                        f"boundary moves ≈{_fmt_bytes(moved)} "
+                        "(all-to-all) to honor the rule",
+                        vertex=vid, label=label))
+                assigned = pinned
+                break
+
+        shardings[vid] = assigned
+
+        # ---- KP603: device-sharded data gathered into a host stage
+        if _is_host_stage(graph, vid, specs):
+            gathered = 0
+            for d, dep_sv, dep_spec in zip(deps, in_shardings, in_specs):
+                if dep_sv is None or not isinstance(dep_spec, DataSpec):
+                    continue
+                if dep_sv.max_shards(mesh) > 1 and dep_spec.nbytes:
+                    gathered += dep_spec.nbytes
+                    diags.append(Diagnostic(
+                        "KP603", Severity.WARNING,
+                        f"host-code stage consumes device-sharded "
+                        f"{_label(graph, d)}@{d} ({spec_str(dep_sv)}): "
+                        f"every shard all-gathers to the host "
+                        f"(≈{_fmt_bytes(dep_spec.nbytes)}); keep the "
+                        "stage on device or reshard explicitly",
+                        vertex=vid, label=label))
+            add_cost(vid, gathered)
+
+        # ---- KP602: large operand held replicated though shardable
+        if assigned is not None and isinstance(out_spec, DataSpec):
+            total = out_spec.nbytes
+            if total and total >= replicated_threshold_bytes \
+                    and assigned.max_shards(mesh) <= 1:
+                axis = _shardable_axis(out_spec, mesh)
+                if axis is not None:
+                    diags.append(Diagnostic(
+                        "KP602", Severity.WARNING,
+                        f"{_fmt_bytes(total)} held replicated on every "
+                        f"device although the {axis!r} mesh axis divides "
+                        "one of its dimensions — a sharded placement "
+                        "exists (pin one with a PartitionRule or an "
+                        "abstract_sharding hook)",
+                        vertex=vid, label=label))
+
+        # ---- KP604: data-shard count does not divide the example count
+        if assigned is not None and assigned.kind == "dataset" \
+                and _leading_axis(assigned) == meshlib.DATA_AXIS \
+                and isinstance(out_spec, DataSpec) \
+                and out_spec.count and data_shards > 1 \
+                and out_spec.count % data_shards != 0 \
+                and out_spec.count not in flagged_counts:
+            flagged_counts.add(out_spec.count)
+            diags.append(Diagnostic(
+                "KP604", Severity.WARNING,
+                f"{data_shards} data shards do not divide the propagated "
+                f"example count {out_spec.count}: placement pads to "
+                f"{-(-out_spec.count // data_shards) * data_shards} rows, "
+                "so per-device shapes differ from same-pipeline stages "
+                "at other counts and every distinct residue recompiles",
+                vertex=vid, label=label))
+
+    return shardings, diags, boundary
+
+
+def _spec_problem(spec: P, out_spec: DataSpec, mesh) -> Optional[str]:
+    """Why one PartitionSpec cannot apply to this stage's value, or None
+    when it can: every named axis must exist on the mesh, and the spec
+    may not have more entries than the value's (batch-level) rank."""
+    unknown = [ax for ax in meshlib.spec_axes(spec)
+               if ax not in mesh.shape]
+    if unknown:
+        names = ", ".join(repr(a) for a in sorted(set(unknown)))
+        return (f"the current mesh (axes "
+                f"{tuple(mesh.axis_names)}) has no axis {names}")
+    n_entries = len(tuple(spec))
+    extra = 1 if out_spec.kind == "dataset" else 0
+    min_rank = min(
+        (len(getattr(l, "shape", ())) + extra
+         for l in jax.tree_util.tree_leaves(out_spec.element)),
+        default=0)
+    if n_entries > min_rank:
+        return (f"the value's rank is {min_rank} (batch axis included) — "
+                f"fewer than the spec's {n_entries} entries")
+    return None
+
+
+def _rule_problem(rule: PartitionRule, out_spec: DataSpec,
+                  mesh) -> Optional[str]:
+    return _spec_problem(rule.spec, out_spec, mesh)
+
+
+def _sharded_value_problem(sv: ShardedValue, out_spec,
+                           mesh) -> Optional[str]:
+    """KP605 for hook-returned placements: the same realizability
+    contract rule specs get, aligned per leaf when the element spec is
+    known (a higher-rank leaf may legitimately carry a longer spec);
+    unknown-axis names are always checkable."""
+    for lspec in sv.leaf_specs():
+        unknown = [ax for ax in meshlib.spec_axes(lspec)
+                   if ax not in mesh.shape]
+        if unknown:
+            names = ", ".join(repr(a) for a in sorted(set(unknown)))
+            return (f"the current mesh (axes "
+                    f"{tuple(mesh.axis_names)}) has no axis {names}")
+    if not isinstance(out_spec, DataSpec) or not is_known(out_spec.element):
+        return None
+    leaves = jax.tree_util.tree_leaves(out_spec.element)
+    leaf_specs = sv.leaf_specs()
+    if len(leaves) != len(leaf_specs):
+        return None  # shape of the tree itself is the hook's business
+    extra = 1 if sv.kind == "dataset" else 0
+    for leaf, lspec in zip(leaves, leaf_specs):
+        rank = len(getattr(leaf, "shape", ())) + extra
+        if len(tuple(lspec)) > rank:
+            return (f"a leaf's rank is {rank} (batch axis included) — "
+                    f"fewer than its spec's {len(tuple(lspec))} entries")
+    return None
+
+
+def _same_placement(a: ShardedValue, b: ShardedValue, mesh) -> bool:
+    la, lb = a.leaf_specs(), b.leaf_specs()
+    if len(la) != len(lb):
+        return False
+    return all(meshlib.specs_equal(x, y) for x, y in zip(la, lb))
+
+
+def _shardable_axis(spec: DataSpec, mesh) -> Optional[str]:
+    """A mesh axis (>1 devices) that evenly divides some dimension of
+    the value — proof that a sharded placement exists. Prefers the model
+    axis (KP602's 'replicated over the model axis' case)."""
+    leaves = jax.tree_util.tree_leaves(spec.element)
+    dims: List[int] = []
+    if spec.kind == "dataset" and spec.count:
+        dims.append(int(spec.count))
+    for leaf in leaves:
+        dims.extend(int(s) for s in getattr(leaf, "shape", ()))
+    for ax in (meshlib.MODEL_AXIS, meshlib.DATA_AXIS):
+        n = int(mesh.shape.get(ax, 1))
+        if n > 1 and any(d >= n and d % n == 0 for d in dims):
+            return ax
+    return None
+
+
+def _default_out_sharding(
+    op, out_spec, in_shardings, in_specs, mesh
+) -> Optional[ShardedValue]:
+    """The default propagation rule: leading-axis data sharding survives
+    device stages fed by data-sharded inputs (feature axes re-derived
+    from the output element, exactly as `Dataset` placement would);
+    replicated inputs stay replicated; host inputs producing a device
+    dataset get the fresh `Dataset.stack` placement; host/unknown
+    outputs carry no sharding."""
+    if not isinstance(out_spec, DataSpec) or not is_known(out_spec.element) \
+            or not out_spec.on_device:
+        return None
+    data_pairs = [
+        (sv, s) for sv, s in zip(in_shardings, in_specs)
+        if isinstance(s, DataSpec)
+    ]
+    if not data_pairs:
+        # a source-less materialization (DatasetOperator): fresh placement
+        return seed_sharding(out_spec, mesh)
+    first_sv = data_pairs[0][0]
+    if first_sv is None:
+        # host → device boundary (HostDataset.stack): fresh placement
+        return seed_sharding(out_spec, mesh)
+    if out_spec.kind == "datum":
+        return _replicated_like(out_spec)
+    if _leading_axis(first_sv) == meshlib.DATA_AXIS:
+        return seed_sharding(out_spec, mesh)
+    return _replicated_like(out_spec)
+
+
+# -------------------------------------------------------------- per-device
+
+
+def _entry_shards(entry, mesh) -> int:
+    """Shard factor of ONE PartitionSpec entry (None, a name, or a tuple
+    of names)."""
+    if entry is None:
+        return 1
+    names = entry if isinstance(entry, (tuple, list)) else (entry,)
+    n = 1
+    for name in names:
+        n *= int(mesh.shape.get(name, 1))
+    return n
+
+
+def per_device_bytes(spec: Any, sv: Optional[ShardedValue], mesh) -> Optional[int]:
+    """Bytes of this value resident on ONE device, modeled the way the
+    runtime actually shards: each dimension is padded UP to a multiple
+    of its axis factor before splitting (`Dataset` pads the leading axis
+    to the data-shard count), so a shard's extent is ``ceil(dim /
+    factor)`` per dimension — at mesh-indivisible counts this matches
+    ``addressable_shards[0].data.nbytes``, where a flat ``total/shards``
+    would under-read exactly when KP604 fires. Replicated leaves are
+    charged in full. Unknown shardings conservatively charge the whole
+    value per device (the pre-sharding whole-fleet assumption)."""
+    if not isinstance(spec, DataSpec):
+        return None
+    total = spec.nbytes
+    if total is None:
+        return None
+    if sv is None:
+        return total
+    leaves = jax.tree_util.tree_leaves(spec.element)
+    leaf_specs = sv.leaf_specs()
+    if len(leaves) != len(leaf_specs):
+        return total
+    count = spec.count if spec.kind == "dataset" else None
+    if spec.kind == "dataset" and count is None:
+        return total
+    out = 0
+    for leaf, lspec in zip(leaves, leaf_specs):
+        dims = list(getattr(leaf, "shape", ()))
+        if spec.kind == "dataset":
+            dims = [int(count)] + dims
+        entries = list(lspec) + [None] * (len(dims) - len(lspec))
+        per_dev = int(np.dtype(leaf.dtype).itemsize)
+        for dim, entry in zip(dims, entries):
+            factor = max(1, _entry_shards(entry, mesh))
+            per_dev *= -(-int(dim) // factor)
+        out += per_dev
+    return out
+
+
+def per_device_pass(
+    graph: Graph,
+    specs: Dict[GraphId, Any],
+    shardings: Dict[GraphId, Optional[ShardedValue]],
+    memory: MemoryEstimate,
+    *,
+    mesh=None,
+    hbm_budget_bytes: Optional[int] = None,
+) -> Tuple[Dict[NodeId, Optional[int]], List[Diagnostic]]:
+    """Scale the KP2xx live-set model down to ONE device's residency and
+    lint it against the per-device HBM budget (KP600 — this *replaces*
+    the whole-fleet KP202 estimate at the full tier: on a sharded mesh
+    the fleet-wide sum is not what any chip's allocator sees).
+
+    Per-node: the memory model's resident bytes (streaming discounts and
+    scan live-sets included) scaled by this node's per-device fraction.
+    The live-set walk mirrors `memory_pass` exactly — production through
+    last consumer, sinks pin forever. Results are attached to ``memory``
+    (``per_device``, ``per_device_peak_bytes``, ``per_device_peak_at``)
+    so one `MemoryEstimate` carries both pictures."""
+    mesh = mesh or meshlib.current_mesh()
+    diags: List[Diagnostic] = []
+    order, _ = toposort(graph)
+
+    per_dev: Dict[NodeId, Optional[int]] = {}
+    for vid in memory.per_node:
+        full = memory.per_node.get(vid)
+        resident = memory.resident.get(vid)
+        if full is None or resident is None or full <= 0:
+            per_dev[vid] = resident
+            continue
+        pd_full = per_device_bytes(specs.get(vid), shardings.get(vid), mesh)
+        if pd_full is None:
+            per_dev[vid] = resident
+            continue
+        # scale the (possibly streaming-discounted) residency by the
+        # node's own sharded fraction
+        per_dev[vid] = int(resident * (pd_full / full))
+
+    peak, peak_at = live_set_walk(graph, order, per_dev)
+
+    memory.per_device = per_dev
+    memory.per_device_peak_bytes = peak
+    memory.per_device_peak_at = peak_at
+
+    if hbm_budget_bytes and peak > hbm_budget_bytes:
+        diags.append(Diagnostic(
+            "KP600", Severity.WARNING,
+            f"peak PER-DEVICE live memory {_fmt_bytes(peak)} exceeds the "
+            f"{_fmt_bytes(hbm_budget_bytes)} per-device HBM budget (peak "
+            f"at {_label(graph, peak_at)}@{peak_at}, "
+            f"{mesh.devices.size} device(s) on the mesh)",
+            vertex=peak_at, label=_label(graph, peak_at)))
+    return per_dev, diags
+
+
+# ------------------------------------------------------------ explanation
+
+
+def explain_rows(
+    graph: Graph,
+    specs: Dict[GraphId, Any],
+    shardings: Dict[GraphId, Optional[ShardedValue]],
+    boundary: Dict[NodeId, int],
+    per_device: Dict[NodeId, Optional[int]],
+) -> List[Dict[str, Any]]:
+    """Per-stage table rows (topo order): propagated spec, per-device
+    bytes, priced boundary collective bytes — the ``--explain-sharding``
+    payload, JSON-ready."""
+    order, _ = toposort(graph)
+    rows = []
+    for vid in order:
+        if not isinstance(vid, NodeId):
+            continue
+        rows.append({
+            "vertex": vid.id,
+            "label": _label(graph, vid),
+            "spec": spec_str(shardings.get(vid)),
+            "per_device_bytes": per_device.get(vid),
+            "boundary_bytes": boundary.get(vid, 0),
+        })
+    return rows
+
+
+def format_explain(rows: List[Dict[str, Any]]) -> str:
+    lines = [f"{'stage':<44} {'spec':<24} {'per-dev':>10} {'boundary':>10}"]
+    for r in rows:
+        pd = _fmt_bytes(r["per_device_bytes"]) \
+            if r["per_device_bytes"] is not None else "?"
+        bd = _fmt_bytes(r["boundary_bytes"]) if r["boundary_bytes"] else "—"
+        name = f"{r['label']}@{r['vertex']}"
+        lines.append(f"{name[:44]:<44} {r['spec'][:24]:<24} "
+                     f"{pd:>10} {bd:>10}")
+    return "\n".join(lines)

@@ -48,7 +48,26 @@ def leaf_sharding(mesh, shape) -> NamedSharding:
     data-sharded on the leading axis. One function, used both by
     `Dataset.__init__`'s placement and by AOT plan warmup
     (`FusedBatchTransformer.warmup`) — the compiled-ahead executable
-    must be lowered with exactly the shardings the runtime will pass."""
+    must be lowered with exactly the shardings the runtime will pass.
+
+    The leading axis must divide the mesh's data-shard count. `Dataset`
+    placement always pads it first, but direct callers (AOT warmup over
+    analyzer specs, ad-hoc `device_put`s) can hand in ragged leading
+    axes — those fall back to a fully replicated placement with a
+    warning instead of letting jax raise mid-force with an opaque
+    uneven-sharding error (the KP604 lint flags the same condition
+    statically)."""
+    shards = mesh.shape.get(meshlib.DATA_AXIS, 1)
+    if shape and shards > 1 and int(shape[0]) % shards != 0:
+        import warnings
+
+        warnings.warn(
+            f"leaf_sharding: leading axis {shape[0]} does not divide the "
+            f"{shards}-way {meshlib.DATA_AXIS!r} mesh axis; placing the "
+            "value replicated instead (pad the leading axis to a "
+            "multiple of the data-shard count to shard it)",
+            stacklevel=2)
+        return NamedSharding(mesh, P())
     if len(shape) == 2:
         feat = meshlib.feature_sharding(mesh, shape[1])
         if feat is not None:

@@ -308,6 +308,15 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return supervised_fit_spec(
             in_specs, self.label, max_in_dim=d_pad)
 
+    def abstract_sharding(self, in_shardings, in_specs):
+        """The BCD sweep's per-block Grams are per-shard partial sums
+        all-reduced over ``data`` (`_bcd_epoch`'s XᵀX layout): both
+        training inputs must arrive row-sharded, or the solve implicitly
+        reshards its whole training set (KP601)."""
+        from ...analysis.sharding import fit_sharding_demands
+
+        return fit_sharding_demands(2)
+
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         from ...parallel import mesh as meshlib
 

@@ -231,6 +231,15 @@ class KernelRidgeRegression(LabelEstimator):
 
         return supervised_fit_spec(in_specs, self.label)
 
+    def abstract_sharding(self, in_shardings, in_specs):
+        """`_krr_step`'s kernel blocks are computed against row-sharded
+        training data (K(X_block, X) distributes over X's row shards):
+        both training inputs must arrive data-sharded or the dual solve
+        implicitly reshards the full training set (KP601)."""
+        from ...analysis.sharding import fit_sharding_demands
+
+        return fit_sharding_demands(2)
+
     @property
     def weight(self):
         return 3 * self.num_epochs + 1

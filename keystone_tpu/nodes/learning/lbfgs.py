@@ -158,6 +158,15 @@ class DenseLBFGSwithL2(LabelEstimator):
 
         return supervised_fit_spec(in_specs, self.label)
 
+    def abstract_sharding(self, in_shardings, in_specs):
+        """`_lbfgs_step`'s gradient is a per-shard partial sum all-reduced
+        over ``data`` (the treeReduce analog): training inputs must
+        arrive row-sharded or every iteration pays an implicit reshard
+        (KP601)."""
+        from ...analysis.sharding import fit_sharding_demands
+
+        return fit_sharding_demands(2)
+
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         from ...parallel import mesh as meshlib
 

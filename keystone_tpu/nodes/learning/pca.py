@@ -191,6 +191,15 @@ class DistributedPCAEstimator(Estimator):
         return _pca_fit_spec(self.dims, self.label,
                              in_specs[0] if in_specs else None)
 
+    def abstract_sharding(self, in_shardings, in_specs):
+        """TSQR's first stage is a per-shard QR inside `shard_map` over
+        the ``data`` axis (`_tsqr_r`): the training rows must arrive
+        data-sharded or the factorization implicitly reshards the whole
+        matrix first (KP601)."""
+        from ...analysis.sharding import fit_sharding_demands
+
+        return fit_sharding_demands(1)
+
     def fit(self, data) -> PCATransformer:
         if isinstance(data, HostDataset):
             data = Dataset(_collect_rows(data))

@@ -18,6 +18,10 @@ from .mesh import (
     make_mesh,
     replicate,
     replicated_sharding,
+    spec_axes,
+    spec_of_array,
+    spec_shards,
+    specs_equal,
     use_mesh,
 )
 from .multihost import (
@@ -37,6 +41,10 @@ __all__ = [
     "make_mesh",
     "replicate",
     "replicated_sharding",
+    "spec_axes",
+    "spec_of_array",
+    "spec_shards",
+    "specs_equal",
     "use_mesh",
     "all_gather_rows",
     "broadcast",

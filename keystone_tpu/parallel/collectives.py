@@ -173,6 +173,21 @@ def all_gather_rows(x, mesh=None, axis: str = meshlib.DATA_AXIS):
 def reshard(x, spec: P, mesh=None):
     """≈ shuffle/repartition: move data to a new layout. XLA lowers the
     transfer to all-to-all/collective-permute over ICI (or DCN across
-    hosts) — the analog of Shuffler.scala:16-19 without a sort key."""
+    hosts) — the analog of Shuffler.scala:16-19 without a sort key.
+
+    Identity reshards short-circuit: when the operand already carries an
+    equivalent sharding the array is returned as-is — no program is
+    built or dispatched (a repartition to the current layout is free in
+    Spark too; the static KP601 lint prices only *real* boundary
+    moves)."""
     mesh = mesh or meshlib.current_mesh()
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    target = NamedSharding(mesh, spec)
+    current = getattr(x, "sharding", None)
+    ndim = getattr(x, "ndim", None)
+    if current is not None and ndim is not None:
+        try:
+            if current.is_equivalent_to(target, ndim):
+                return x
+        except (TypeError, ValueError):
+            pass  # cross-mesh / exotic shardings: fall through and move
+    return jax.device_put(x, target)

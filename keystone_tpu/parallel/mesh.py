@@ -117,6 +117,65 @@ def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     return NamedSharding(mesh or current_mesh(), P())
 
 
+# ------------------------------------------------------- spec introspection
+#
+# The static sharding analyzer (analysis/sharding.py) reasons about
+# PartitionSpecs without arrays; these helpers are the one shared spelling
+# of "how many ways does this spec split a value" and "what spec does this
+# live array actually carry", so the analyzer and the runtime can never
+# disagree about what a spec means on a given mesh.
+
+
+def spec_axes(spec) -> Tuple[str, ...]:
+    """Flat tuple of mesh axis names a PartitionSpec uses (entries may be
+    None, a name, or a tuple of names)."""
+    if spec is None:
+        return ()
+    out = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.extend(entry)
+        else:
+            out.append(entry)
+    return tuple(out)
+
+
+def spec_shards(spec, mesh: Optional[Mesh] = None) -> int:
+    """Number of distinct shards a PartitionSpec implies on ``mesh`` —
+    the product of the used axis sizes. P() → 1 (fully replicated)."""
+    mesh = mesh or current_mesh()
+    n = 1
+    for ax in spec_axes(spec):
+        n *= int(mesh.shape.get(ax, 1))
+    return n
+
+
+def spec_of_array(x) -> Optional[P]:
+    """The PartitionSpec a live array actually carries, or None when the
+    array has no NamedSharding (host numpy, single-device default). The
+    runtime end of the analyzer's propagated specs: reconciliation
+    compares this against what `analysis.sharding` predicted."""
+    sharding = getattr(x, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return sharding.spec
+    return None
+
+
+def specs_equal(a, b) -> bool:
+    """Placement equality of two PartitionSpecs: equal after stripping
+    trailing Nones (P('data') and P('data', None) place identically)."""
+
+    def norm(s):
+        entries = list(s) if s is not None else []
+        while entries and entries[-1] is None:
+            entries.pop()
+        return tuple(tuple(e) if isinstance(e, list) else e for e in entries)
+
+    return norm(a) == norm(b)
+
+
 def shard_leading_axis(x, mesh: Optional[Mesh] = None):
     """Place an array on the mesh, sharded over the leading axis.
 

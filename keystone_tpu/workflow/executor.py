@@ -212,11 +212,36 @@ class GraphExecutor:
             from ..analysis.memory import memory_pass
             from ..analysis.propagate import spec_pass
             from ..analysis.reconcile import node_key
+            from ..analysis.sharding import (
+                per_device_bytes,
+                per_device_pass,
+                sharding_pass,
+                spec_str,
+            )
+            from ..parallel import mesh as meshlib
 
             specs, _ = spec_pass(graph, {})
             est, _ = memory_pass(graph, specs)
+            # per-device side: propagate partition specs over the bound
+            # graph and divide each node's full bytes by its shard
+            # counts — the static analog of one shard's observed bytes,
+            # so reconcile.py can diff per-device estimates against a
+            # real mesh run
+            mesh = meshlib.current_mesh()
+            try:
+                shardings, _, _ = sharding_pass(graph, specs, mesh=mesh)
+            except Exception:
+                shardings = {}
+            try:
+                # peak only; a failure here must not discard the specs
+                # sharding_pass already propagated
+                per_device_pass(graph, specs, shardings, est, mesh=mesh)
+            except Exception:
+                pass
             meta = tracer.metadata.setdefault(
-                "static_memory", {"per_node": {}, "peak_bytes": 0})
+                "static_memory",
+                {"per_node": {}, "peak_bytes": 0,
+                 "per_device_peak_bytes": 0})
             for vid, nbytes in est.per_node.items():
                 if nbytes is None:
                     continue
@@ -227,14 +252,24 @@ class GraphExecutor:
                 # collide on id:label — keep the larger estimate, matching
                 # the observed side's max-over-forces semantics
                 if prev is None or prev["bytes"] < int(nbytes):
-                    meta["per_node"][key] = {
+                    entry = {
                         "label": label,
                         "vertex": vid.id,
                         "bytes": int(nbytes),
                     }
+                    sv = shardings.get(vid)
+                    if sv is not None:
+                        entry["spec"] = spec_str(sv)
+                        pd = per_device_bytes(specs.get(vid), sv, mesh)
+                        if pd is not None:
+                            entry["per_device_bytes"] = int(pd)
+                    meta["per_node"][key] = entry
             # several executors (fit graph, apply graph) contribute to one
             # trace; keep the largest static peak — the model's watermark
             meta["peak_bytes"] = max(meta["peak_bytes"], int(est.peak_bytes))
+            meta["per_device_peak_bytes"] = max(
+                meta.get("per_device_peak_bytes", 0),
+                int(getattr(est, "per_device_peak_bytes", 0) or 0))
         except Exception:  # estimation must never break execution
             pass
 

@@ -101,7 +101,8 @@ def _add_data_vertex(g: Graph, data: Any) -> Tuple[Graph, NodeOrSourceId]:
 
 
 def _validate(graph, source_specs, *, level: str = "full", ignore=(),
-              hbm_budget_bytes=None, chunk_rows=None, raise_on_error=True):
+              hbm_budget_bytes=None, chunk_rows=None, partition_rules=(),
+              raise_on_error=True):
     """Shared implementation of `Pipeline.validate` and friends."""
     from ..analysis import validate_graph
 
@@ -113,6 +114,7 @@ def _validate(graph, source_specs, *, level: str = "full", ignore=(),
         hbm_budget_bytes=hbm_budget_bytes,
         # None → ExecutionConfig.chunk_size, resolved inside memory_pass
         chunk_rows=chunk_rows,
+        partition_rules=partition_rules,
     )
     if raise_on_error:
         report.raise_for_errors()
@@ -178,7 +180,7 @@ class Pipeline(Chainable):
     # ----------------------------------------------------------- validate
 
     def validate(self, source_spec=None, *, level: str = "full", ignore=(),
-                 hbm_budget_bytes=None, chunk_rows=None,
+                 hbm_budget_bytes=None, chunk_rows=None, partition_rules=(),
                  raise_on_error: bool = True):
         """Statically validate this pipeline before any data loads.
 
@@ -197,6 +199,9 @@ class Pipeline(Chainable):
         propagation starts at the first node with intrinsic specs.
 
         ``level``: "structure" ⊂ "specs" ⊂ "memory" ⊂ "full".
+        ``partition_rules``: declarative ``(regex, PartitionSpec)``
+        placement overrides for the sharding tier (see
+        `analysis.sharding.PartitionRule`).
         Raises `analysis.PipelineValidationError` on ERROR-severity
         findings unless ``raise_on_error=False``; always returns the
         `ValidationReport`."""
@@ -206,7 +211,8 @@ class Pipeline(Chainable):
             self.graph,
             {self.source: as_source_spec(source_spec)},
             level=level, ignore=ignore, hbm_budget_bytes=hbm_budget_bytes,
-            chunk_rows=chunk_rows, raise_on_error=raise_on_error)
+            chunk_rows=chunk_rows, partition_rules=partition_rules,
+            raise_on_error=raise_on_error)
 
     # -------------------------------------------------------------- apply
 

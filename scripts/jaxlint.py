@@ -71,6 +71,16 @@ system cannot (see ANALYSIS.md for the full catalog):
          module-level structure-keyed caches (``*CACHE*``/``*PENDING*``
          names) are sanctioned.
 
+  KJ009  hard-coded-mesh-axis / bare-device-put: a bare ``"data"`` /
+         ``"model"`` string literal used as a mesh axis name in a
+         sharding construction or collective call under ``nodes/`` /
+         ``workflow/`` (the canonical names live in
+         ``parallel/mesh.py`` — import ``DATA_AXIS``/``MODEL_AXIS`` so
+         a mesh relayout stays a one-place change), and — under
+         ``parallel/`` / ``data/`` — ``jax.device_put`` without an
+         explicit sharding/device argument (defaults to device 0,
+         silently un-sharding whatever flows through a mesh hot path).
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -109,6 +119,11 @@ RULES = {
              "the concurrent scheduler may force two such vertices "
              "simultaneously (use the self.__dict__ memo idiom or a "
              "structure-keyed cache)",
+    "KJ009": "hard-coded mesh axis name ('data'/'model') in a sharding or "
+             "collective call (use meshlib.DATA_AXIS/MODEL_AXIS), or a "
+             "jax.device_put without an explicit sharding in a "
+             "parallel-adjacent hot path (placement must be deliberate "
+             "on a mesh)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -555,6 +570,15 @@ def _is_self_dict(node: ast.AST) -> bool:
             and node.value.id == "self")
 
 
+def _is_self_dict_chain(node: ast.AST) -> bool:
+    """``self.__dict__`` or ``self.__dict__[...]`` — a mutator call on
+    either (``self.__dict__.setdefault``, ``self.__dict__['k'].append``)
+    is the sanctioned memo idiom, not shared-state mutation."""
+    if _is_self_dict(node):
+        return True
+    return isinstance(node, ast.Subscript) and _is_self_dict(node.value)
+
+
 def _check_hot_path_state_write(tree: ast.AST, path: str) -> Iterator[Finding]:
     """KJ008: apply-time state writes under ``nodes/``/``workflow/`` —
     assignment to ``self.*`` or to a declared ``global``, and in-place
@@ -626,7 +650,7 @@ def _check_hot_path_state_write(tree: ast.AST, path: str) -> Iterator[Finding]:
                 elif isinstance(sub, ast.Call) \
                         and isinstance(sub.func, ast.Attribute) \
                         and sub.func.attr in _MUTATOR_CALLS \
-                        and not _is_self_dict(sub.func.value):
+                        and not _is_self_dict_chain(sub.func.value):
                     root = _chain_root(sub.func.value)
                     if isinstance(root, ast.Name) and flagged_global(root.id):
                         yield Finding(
@@ -634,6 +658,120 @@ def _check_hot_path_state_write(tree: ast.AST, path: str) -> Iterator[Finding]:
                             f"`{fn.name}` calls `{root.id}."
                             f"{sub.func.attr}(...)` on a module-level "
                             "container at apply time")
+                    elif isinstance(root, ast.Name) and root.id == "self" \
+                            and isinstance(sub.func.value,
+                                           (ast.Attribute, ast.Subscript)):
+                        # self.attr.append(...) mutates shared instance
+                        # state exactly like self.attr[k] = v does; a
+                        # direct self.add(...) METHOD call is not a
+                        # container mutation (the receiver must be an
+                        # attribute/subscript chain, as in effects.py)
+                        yield Finding(
+                            path, sub.lineno, "KJ008",
+                            f"`{fn.name}` calls `self."
+                            f"{_attr_name(sub.func.value)}."
+                            f"{sub.func.attr}(...)` at apply time; "
+                            "shared instances race under the concurrent "
+                            "scheduler (memoize via self.__dict__[...] "
+                            "instead)")
+
+
+#: the library's two mesh axis names — the canonical constants live in
+#: parallel/mesh.py (DATA_AXIS/MODEL_AXIS); everything else must import
+#: them, so a mesh rename (or a 3-axis pod layout) is a one-line change.
+_MESH_AXIS_LITERALS = {"data", "model"}
+#: call names whose arguments are axis names / partition specs.
+_SHARDING_CALL_NAMES = {
+    "P", "PartitionSpec", "NamedSharding", "Mesh", "make_mesh",
+}
+#: collective ops taking a positional axis-name argument.
+_COLLECTIVE_ATTRS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "axis_index", "ppermute", "pshuffle",
+}
+#: kwarg names that carry mesh axis names.
+_AXIS_KWARGS = {"axis", "axis_name", "axis_names"}
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _axis_literals_in(node: ast.AST) -> Iterator[ast.Constant]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and sub.value in _MESH_AXIS_LITERALS:
+            yield sub
+
+
+def _check_axis_literals(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ009 (axis-literal half, under ``nodes/``/``workflow/``): a bare
+    ``"data"``/``"model"`` string in a sharding construction
+    (`P`/`PartitionSpec`/`NamedSharding`/`Mesh`), a collective call's
+    axis argument (`lax.psum(x, "data")`), an ``axis=``/``axis_name(s)=``
+    kwarg, or a ``mesh.shape.get("data")`` lookup. Axis names are mesh
+    *configuration*: hard-coding them in node/workflow code silently
+    desynchronizes from `parallel.mesh.DATA_AXIS`/`MODEL_AXIS` the day
+    the mesh layout changes. Plain string data (NLP word lists, dict
+    keys) never matches — only these call contexts are inspected."""
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        name = _call_name(call.func)
+        contexts: List[ast.AST] = []
+        if name in _SHARDING_CALL_NAMES or name in _COLLECTIVE_ATTRS:
+            contexts.extend(call.args)
+        if name == "get" and isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Attribute) \
+                and call.func.value.attr == "shape":
+            contexts.extend(call.args)
+        for kw in call.keywords:
+            if kw.arg in _AXIS_KWARGS:
+                contexts.append(kw.value)
+        seen_lines = set()
+        for ctx in contexts:
+            for lit in _axis_literals_in(ctx):
+                if lit.lineno in seen_lines:
+                    continue
+                seen_lines.add(lit.lineno)
+                yield Finding(
+                    path, lit.lineno, "KJ009",
+                    f"hard-coded mesh axis name {lit.value!r} in "
+                    f"`{name}(...)`; import meshlib.DATA_AXIS/MODEL_AXIS "
+                    "so the axis layout stays a one-place decision")
+
+
+def _check_bare_device_put(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ009 (device_put half, under ``parallel/``/``data/``): a
+    ``jax.device_put(x)`` with no sharding/device argument in the layers
+    that own placement. The default placement is device 0 — on a mesh
+    that silently un-shards (and un-overlaps) whatever flows through;
+    placement decisions in the parallel-adjacent hot paths must be
+    explicit (`NamedSharding`, `leaf_sharding`, `mesh` helpers)."""
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        func = call.func
+        is_dput = (
+            isinstance(func, ast.Attribute) and func.attr == "device_put"
+            and _attr_root(func) == "jax"
+        ) or (isinstance(func, ast.Name) and func.id == "device_put")
+        if not is_dput:
+            continue
+        if len(call.args) >= 2 or any(
+                kw.arg in {"device", "sharding", "dst_sharding"} or
+                kw.arg is None
+                for kw in call.keywords):
+            continue
+        yield Finding(
+            path, call.lineno, "KJ009",
+            "jax.device_put without an explicit sharding defaults to "
+            "device 0; parallel-layer placements must name their "
+            "sharding (NamedSharding / data.dataset.leaf_sharding)")
 
 
 def _attr_name(node: ast.AST) -> str:
@@ -685,6 +823,9 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_fresh_jit(tree, rel))
         findings.extend(_check_scan_carry_realloc(tree, rel))
         findings.extend(_check_hot_path_state_write(tree, rel))
+        findings.extend(_check_axis_literals(tree, rel))
+    if "parallel/" in posix or "data/" in posix:
+        findings.extend(_check_bare_device_put(tree, rel))
 
     # nested loops make ast.walk revisit inner statements: keep one
     # finding per (line, rule)

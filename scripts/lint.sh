@@ -25,9 +25,33 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.analysis "$@"
 echo "== operator contract audit (registry-wide KP5xx) =="
 JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --audit-operators
 
+echo "== sharding audit (per-stage placement over every example, 8-device mesh) =="
+# Every analyzable() example's propagated partition table on a forced
+# 8-device CPU mesh: the CLI exits 1 on ANY unsuppressed KP6xx finding
+# (implicit reshard, oversized replication, host all-gather,
+# mesh-indivisible counts) — placement regressions fail here in seconds.
+SHARDING_JSON="$(mktemp /tmp/keystone_sharding_audit.XXXXXX.json)"
+trap 'rm -f "$SHARDING_JSON"' EXIT
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m keystone_tpu.analysis --explain-sharding --json > "$SHARDING_JSON"
+python - "$SHARDING_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+assert payload["devices"] == 8, payload["devices"]
+examples = payload["examples"]
+assert len(examples) >= 7, [e["example"] for e in examples]
+for e in examples:
+    assert "build_error" not in e, e
+    assert e["findings"] == [], e["findings"]
+    assert e["stages"], e["example"]
+stages = sum(len(e["stages"]) for e in examples)
+print(f"sharding audit: {len(examples)} example(s), {stages} stage rows, "
+      "0 KP6xx findings OK")
+PY
+
 echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
 TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
-trap 'rm -f "$TRACE_TMP"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_SMOKE_TRACE="$TRACE_TMP" python - <<'PY'
 import json, os
 import numpy as np
@@ -51,7 +75,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
 
 echo "== dispatch smoke (example pipeline under the concurrent scheduler) =="
 DISPATCH_TRACE="$(mktemp /tmp/keystone_dispatch_smoke.XXXXXX.json)"
-trap 'rm -f "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_TRACE="$DISPATCH_TRACE" KEYSTONE_CONCURRENT_DISPATCH=1 \
 python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance) run
@@ -83,7 +107,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
 echo "== compile smoke (warm second run performs 0 cold compiles) =="
 COMPILE_CACHE="$(mktemp -d /tmp/keystone_compile_smoke.XXXXXX)"
 COMPILE_TRACE="$(mktemp /tmp/keystone_compile_smoke.XXXXXX.json)"
-trap 'rm -f "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$COMPILE_CACHE" \
 KEYSTONE_TRACE="$COMPILE_TRACE" python - <<'PY'
 # One example pipeline run TWICE against a fresh persistent-cache dir
@@ -127,7 +151,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
 echo "== megafusion smoke (1-program apply run; warm repeat stays 0-cold) =="
 MEGA_CACHE="$(mktemp -d /tmp/keystone_mega_smoke.XXXXXX)"
 MEGA_TRACE="$(mktemp /tmp/keystone_mega_smoke.XXXXXX.json)"
-trap 'rm -f "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_MEGAFUSION=1 KEYSTONE_COMPILE_CACHE="$MEGA_CACHE" \
 KEYSTONE_TRACE="$MEGA_TRACE" python - <<'PY'
 # One example apply run TWICE under megafusion against a fresh

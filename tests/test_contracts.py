@@ -433,6 +433,43 @@ def test_effect_inference_finds_self_writes():
     assert class_effects(_CleanStage) == ()
 
 
+class _MutatorCounter(Transformer):
+    """Review regression: the mutator-call spelling of instance-state
+    mutation (`self.seen.append(x)`) races exactly like the subscript
+    assignment and must infer the same self_write effect."""
+
+    chunkable = True
+
+    def __init__(self):
+        self.seen = []
+
+    def apply(self, x):
+        self.seen.append(x)
+        return x
+
+
+class _DictMemoMutator(Transformer):
+    """Mutator calls on the sanctioned self.__dict__ chain are memo
+    maintenance, not shared-state mutation."""
+
+    def apply(self, x):
+        self.__dict__.setdefault("_hits", []).append(1)
+        return x
+
+
+def test_effect_inference_finds_self_container_mutators():
+    effects = class_effects(_MutatorCounter)
+    assert any(e.kind == "self_write" and e.target == "attr:seen"
+               for e in effects)
+    assert class_effects(_DictMemoMutator) == ()
+    # and the graph pass turns the shared mutator instance into KP511
+    shared = _MutatorCounter()
+    diags = interference_pass(
+        _effectful_gather_pipeline(shared).apply(
+            SpecDataset((4,), count=8)).graph)
+    assert diags and all(d.rule == "KP511" for d in diags)
+
+
 def test_operator_effects_sees_composite_components():
     from keystone_tpu.nodes.util.fusion import FusedBatchTransformer
 

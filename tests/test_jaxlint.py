@@ -126,6 +126,106 @@ def test_suppression_comment_honored(tmp_path):
     assert jl.lint_file(f) == []
 
 
+def test_kj009_flags_hardcoded_axis_literals(tmp_path):
+    """KJ009 (axis-literal half): bare "data"/"model" strings in
+    sharding constructions, collective calls, axis kwargs, and
+    mesh.shape.get lookups under nodes//workflow/ are flagged; the
+    meshlib-constant spelling and plain string data are not."""
+    jl = _jaxlint()
+    bad = tmp_path / "workflow" / "bad_axes.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "from jax import lax\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "from keystone_tpu.parallel import mesh as meshlib\n"
+        "\n"
+        "\n"
+        "def place(x, mesh):\n"
+        "    a = P(\"data\", \"model\")\n"                       # KJ009
+        "    b = NamedSharding(mesh, P(meshlib.DATA_AXIS))\n"    # ok
+        "    c = lax.psum(x, \"data\")\n"                        # KJ009
+        "    d = lax.psum(x, meshlib.DATA_AXIS)\n"               # ok
+        "    e = mesh.shape.get(\"model\", 1)\n"                 # KJ009
+        "    f = tree_reduce(x, axis=\"data\")\n"                # KJ009
+        "    g = {\"data\": \"datum\"}\n"                        # plain: ok
+        "    h = [\"model\", \"level\"]\n"                       # plain: ok
+        "    return a, b, c, d, e, f, g, h\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ009"] * 4
+    assert sorted(f.line for f in findings) == [7, 9, 11, 12]
+
+    # outside nodes/ and workflow/, the axis-literal half does not apply
+    elsewhere = tmp_path / "loaders" / "ok_axes.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj009_flags_bare_device_put(tmp_path):
+    """KJ009 (device_put half): a sharding-less jax.device_put in the
+    parallel-adjacent layers is flagged; explicit placements pass."""
+    jl = _jaxlint()
+    bad = tmp_path / "parallel" / "bad_put.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "\n"
+        "\n"
+        "def place(x, mesh):\n"
+        "    a = jax.device_put(x)\n"                            # KJ009
+        "    b = jax.device_put(x, NamedSharding(mesh, P()))\n"  # ok
+        "    c = jax.device_put(x, device=None)\n"               # ok
+        "    return a, b, c\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ009"]
+    assert findings[0].line == 6
+
+    # nodes/ hot paths are policed by the axis half, not the put half
+    elsewhere = tmp_path / "nodes" / "ok_put.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj008_flags_self_container_mutator_calls(tmp_path):
+    """Review regression: `self.seen.append(x)` in a hot path races
+    exactly like `self.seen[k] = x` and must be flagged; mutator calls
+    on the sanctioned `self.__dict__` memo chain must not."""
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "bad_mutator.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "class T:\n"
+        "    def add(self, a, b):\n"
+        "        return a + b\n"
+        "    def apply(self, x):\n"
+        "        self.seen.append(x)\n"                    # KJ008
+        "        self.__dict__.setdefault('memo', {})\n"   # sanctioned
+        "        self.__dict__['hits'].append(x)\n"        # sanctioned
+        "        y = self.add(x, x)\n"                     # method: ok
+        "        return y\n")
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ008"]
+    assert findings[0].line == 5 and "self.seen.append" in findings[0].message
+
+
+def test_kj009_suppression(tmp_path):
+    jl = _jaxlint()
+    f = tmp_path / "data" / "sanctioned_put.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "def stage(x):\n"
+        "    return jax.device_put(x)  # keystone: ignore[KJ009]\n"
+    )
+    assert jl.lint_file(f) == []
+
+
 def test_nested_loop_reports_once(tmp_path):
     jl = _jaxlint()
     f = tmp_path / "nodes" / "nested.py"

@@ -1,0 +1,463 @@
+"""Static sharding analyzer tests (keystone_tpu/analysis/sharding.py).
+
+The acceptance contract: partition specs propagate over the lowered
+graph exactly as `Dataset` placement assigns them at force time (checked
+against live arrays on the 8-device CPU mesh), the per-device memory
+model divides the fleet estimate by real shard counts (reconciled
+against observed per-shard bytes through a trace), and each KP6xx rule
+fires on a seeded bug, stays quiet on the clean form, and suppresses
+through the standard ``ignore=[...]`` channel."""
+
+import json
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from keystone_tpu.analysis import (
+    PartitionRule,
+    SpecDataset,
+    validate_graph,
+)
+from keystone_tpu.analysis.examples import EXAMPLES, build_example
+from keystone_tpu.analysis.memory import memory_pass
+from keystone_tpu.analysis.propagate import spec_pass
+from keystone_tpu.analysis.sharding import (
+    DEMAND_DATA_SHARDED,
+    ShardingResult,
+    explain_rows,
+    format_explain,
+    per_device_pass,
+    sharding_pass,
+    spec_str,
+)
+from keystone_tpu.data.dataset import Dataset, leaf_sharding
+from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.stats import LinearRectifier, RandomSignNode
+from keystone_tpu.parallel import mesh as meshlib
+from keystone_tpu.workflow import Pipeline, Transformer
+
+
+class _HostStage(Transformer):
+    """Provably-host stage: the abstract trace dies on the numpy pull."""
+
+    def apply(self, x):
+        return np.asarray(x).sum()
+
+
+def _chain_pipeline(dim=16, count=64):
+    pipe = RandomSignNode(dim).to_pipeline() >> LinearRectifier(0.0)
+    return pipe.apply(
+        SpecDataset((dim,), np.float32, count=count, name="x"))
+
+
+def _full(graph, **kwargs):
+    return validate_graph(graph, level="full", **kwargs)
+
+
+# ------------------------------------------------------------ propagation
+
+
+def test_propagation_matches_runtime_placement():
+    """The seeded spec at a Dataset vertex equals what placement actually
+    assigned the live array — the analyzer and the runtime share
+    `leaf_sharding`'s decision."""
+    ds = Dataset.from_numpy(np.ones((64, 16), np.float32))
+    applied = Transformer.from_function(lambda x: x * 2.0).to_pipeline()(ds)
+    report = applied.validate(raise_on_error=False)
+    assert report.shardings, "full-level validate must propagate shardings"
+    placed_spec = meshlib.spec_of_array(ds.data)
+    assert placed_spec is not None
+    seeded = [
+        sv for vid, sv in report.shardings.items()
+        if sv is not None and getattr(vid, "id", None) is not None
+    ]
+    assert seeded
+    # every device stage keeps the leading-axis data sharding
+    for sv in seeded:
+        leaf = sv.leaf_specs()[0]
+        assert meshlib.spec_axes(leaf)[:1] == (meshlib.DATA_AXIS,), sv
+    assert meshlib.spec_axes(placed_spec)[:1] == (meshlib.DATA_AXIS,)
+
+
+def test_data_sharding_survives_elementwise_chain():
+    applied = _chain_pipeline()
+    report = _full(applied.graph)
+    node_svs = {vid: sv for vid, sv in report.shardings.items()
+                if sv is not None}
+    assert len(node_svs) >= 3  # dataset + two stages (+ sink)
+    for sv in node_svs.values():
+        assert spec_str(sv).startswith("P('data'")
+    assert not [d for d in report.diagnostics if d.rule.startswith("KP6")]
+
+
+def test_sharding_only_runs_at_full_level():
+    applied = _chain_pipeline()
+    assert not validate_graph(applied.graph, level="memory").shardings
+    assert validate_graph(applied.graph, level="full").shardings
+
+
+# ------------------------------------------------------- KP601 (reshard)
+
+
+def test_kp601_partition_rule_override_fires_and_suppresses():
+    applied = _chain_pipeline()
+    rules = [PartitionRule("LinearRectifier", P())]
+    report = _full(applied.graph, partition_rules=rules)
+    kp601 = report.by_rule("KP601")
+    assert kp601 and "all-to-all" in kp601[0].message
+    # the pinned stage now carries the rule's spec
+    flagged = kp601[0].vertex
+    assert spec_str(report.shardings[flagged]) == "P()"
+    # suppression channel
+    assert not _full(applied.graph, partition_rules=rules,
+                     ignore=["KP601"]).by_rule("KP601")
+    # no rules → no reshard
+    assert not _full(applied.graph).by_rule("KP601")
+
+
+def test_kp601_solver_demand_fires_on_replicated_input():
+    feat = RandomSignNode(8).to_pipeline()
+    data = SpecDataset((8,), np.float32, count=32, name="d")
+    labels = SpecDataset((4,), np.float32, count=32, name="l")
+    pred = feat.and_then(BlockLeastSquaresEstimator(8, 1, 0.1), data, labels)
+    # replicating the featurizer forces the BCD fit's row-sharded demand
+    # to disagree with its producer
+    report = validate_graph(
+        pred.graph, {pred.source: (8,)}, level="full",
+        partition_rules=[("RandomSignNode", P())])
+    demand_hits = [d for d in report.by_rule("KP601")
+                   if "demands a data-sharded layout" in d.message]
+    assert demand_hits
+    # data-sharded producers satisfy the demand
+    clean = validate_graph(pred.graph, {pred.source: (8,)}, level="full")
+    assert not clean.by_rule("KP601")
+
+
+def test_solver_fit_hooks_declare_row_sharded_demands():
+    from keystone_tpu.nodes.learning.kernels import KernelRidgeRegression
+    from keystone_tpu.nodes.learning.lbfgs import DenseLBFGSwithL2
+    from keystone_tpu.nodes.learning.pca import DistributedPCAEstimator
+
+    for est, n in [
+        (BlockLeastSquaresEstimator(8, 1), 2),
+        (KernelRidgeRegression(1.0, 0.1), 2),
+        (DenseLBFGSwithL2(), 2),
+        (DistributedPCAEstimator(4), 1),
+    ]:
+        res = est.abstract_sharding([None] * n, [None] * n)
+        assert isinstance(res, ShardingResult)
+        assert res.demands == (DEMAND_DATA_SHARDED,) * n, type(est).__name__
+
+
+# -------------------------------------------------- KP605 (invalid rule)
+
+
+def test_kp605_rule_with_unknown_axis_or_excess_rank():
+    applied = _chain_pipeline()
+    # "expert" is not an axis of the (data,)-mesh
+    bad_axis = _full(applied.graph,
+                     partition_rules=[("LinearRectifier", P("expert"))])
+    kp605 = bad_axis.by_rule("KP605")
+    assert kp605 and kp605[0].severity.name == "ERROR"
+    assert "no axis 'expert'" in kp605[0].message
+    # the bad rule was ignored: propagation kept the flowed spec
+    assert spec_str(bad_axis.shardings[kp605[0].vertex]) == "P('data', None)"
+    # more entries than the value's (count, dim) rank
+    too_long = _full(
+        applied.graph,
+        partition_rules=[
+            ("LinearRectifier",
+             P(meshlib.DATA_AXIS, None, None))])
+    assert too_long.by_rule("KP605")
+    # a realizable rule stays KP605-quiet
+    ok = _full(applied.graph,
+               partition_rules=[("LinearRectifier", P(meshlib.DATA_AXIS))])
+    assert not ok.by_rule("KP605")
+
+
+def test_rules_never_pin_device_specs_on_host_values():
+    """Review regression: a catch-all rule must not assign a device
+    placement to a host-resident value — per-device bytes would divide
+    by shards that don't exist and host consumers would fabricate
+    KP603 all-gathers."""
+    pipe = _HostStage().to_pipeline() >> _HostStage()
+    applied = pipe.apply(SpecDataset(count=64, name="h", on_device=False))
+    report = _full(applied.graph,
+                   partition_rules=[(".*", P(meshlib.DATA_AXIS))])
+    assert all(sv is None for sv in report.shardings.values())
+    assert not report.by_rule("KP603")
+
+
+def test_kp605_rejects_unrealizable_hook_placement():
+    """Review regression: a hook-returned ShardedValue gets the same
+    KP605 realizability contract as rule specs — an unknown axis must
+    fail loudly, not silently model shard-count 1."""
+    from keystone_tpu.analysis.sharding import ShardedValue
+
+    class _BadHookStage(Transformer):
+        def apply(self, x):
+            return x * 2.0
+
+        def abstract_sharding(self, in_shardings, in_specs):
+            return ShardedValue(P("expert"))
+
+    applied = (_BadHookStage().to_pipeline()).apply(
+        SpecDataset((16,), np.float32, count=64, name="x"))
+    report = _full(applied.graph)
+    kp605 = report.by_rule("KP605")
+    assert kp605 and "no axis 'expert'" in kp605[0].message
+    # the bad placement was discarded: the default rule decided instead
+    assert spec_str(report.shardings[kp605[0].vertex]).startswith("P('data'")
+
+
+def test_kp605_raising_hook_is_loud_not_silent():
+    """Review regression: a hook that raises must be distinguishable
+    from 'no hook declared' — otherwise a broken solver hook silently
+    drops its KP601 demand checks while the gate stays green."""
+
+    class _RaisingHookStage(Transformer):
+        def apply(self, x):
+            return x * 2.0
+
+        def abstract_sharding(self, in_shardings, in_specs):
+            raise TypeError("refactor broke me")
+
+    applied = (_RaisingHookStage().to_pipeline()).apply(
+        SpecDataset((16,), np.float32, count=64, name="x"))
+    report = _full(applied.graph)
+    kp605 = report.by_rule("KP605")
+    assert kp605 and "refactor broke me" in kp605[0].message
+    assert kp605[0].severity.name == "WARNING"
+    # default propagation still decided the stage's placement
+    assert spec_str(report.shardings[kp605[0].vertex]).startswith("P('data'")
+
+
+def test_per_device_bytes_models_padded_shards_at_ragged_counts():
+    """At mesh-indivisible counts the runtime pads before splitting, so
+    one shard holds ceil(count/shards) rows — the static per-device
+    number must match the padded shard, not total/shards."""
+    from keystone_tpu.analysis.sharding import per_device_bytes, seed_sharding
+    from keystone_tpu.analysis.specs import DataSpec, shape_struct
+
+    mesh = meshlib.current_mesh()
+    spec = DataSpec(element=shape_struct((1024,), np.float32), count=12)
+    sv = seed_sharding(spec, mesh)
+    static = per_device_bytes(spec, sv, mesh)
+    ds = Dataset.from_numpy(np.ones((12, 1024), np.float32))
+    observed = ds.data.addressable_shards[0].data.nbytes
+    assert static == observed == 2 * 4096  # ceil(12/8)=2 padded rows
+
+
+# --------------------------------------------------- KP602 (replication)
+
+
+def test_kp602_large_replicated_operand_on_model_mesh():
+    mesh = meshlib.make_mesh(
+        shape=(2, 4), axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS))
+    with meshlib.use_mesh(mesh):
+        big = SpecDataset((4096,), np.float32, count=8192, name="big")
+        applied = Transformer.from_function(
+            lambda x: x, name="ident").to_pipeline()(big)
+        # pin everything replicated: 128 MiB > the 64 MiB threshold and
+        # the 4-way model axis divides the 4096-wide feature dim
+        report = _full(applied.graph, partition_rules=[(".", P())])
+        kp602 = report.by_rule("KP602")
+        assert kp602 and "'model'" in kp602[0].message
+        # the default (sharded) placement is quiet
+        assert not _full(applied.graph).by_rule("KP602")
+        # suppression channel
+        assert not _full(applied.graph, partition_rules=[(".", P())],
+                         ignore=["KP602"]).by_rule("KP602")
+
+
+def test_kp602_quiet_below_threshold():
+    mesh = meshlib.make_mesh(
+        shape=(2, 4), axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS))
+    with meshlib.use_mesh(mesh):
+        small = SpecDataset((64,), np.float32, count=128, name="small")
+        applied = Transformer.from_function(
+            lambda x: x, name="ident").to_pipeline()(small)
+        report = _full(applied.graph, partition_rules=[(".", P())])
+        assert not report.by_rule("KP602")
+
+
+# ------------------------------------------------- KP603 (host all-gather)
+
+
+def test_kp603_host_stage_consuming_sharded_data():
+    pipe = RandomSignNode(16).to_pipeline() >> _HostStage()
+    applied = pipe.apply(
+        SpecDataset((16,), np.float32, count=64, name="x"))
+    report = _full(applied.graph)
+    kp603 = report.by_rule("KP603")
+    assert kp603 and "all-gather" in kp603[0].message
+    assert not _full(applied.graph, ignore=["KP603"]).by_rule("KP603")
+
+
+def test_kp603_quiet_for_host_to_host():
+    # a host stage consuming host data gathers nothing
+    pipe = _HostStage().to_pipeline() >> _HostStage()
+    applied = pipe.apply(SpecDataset(count=64, name="h", on_device=False))
+    assert not _full(applied.graph).by_rule("KP603")
+
+
+# ------------------------------------------- KP604 (indivisible counts)
+
+
+def test_kp604_mesh_indivisible_count():
+    ragged = _chain_pipeline(count=30)  # 8 shards do not divide 30
+    report = _full(ragged.graph)
+    kp604 = report.by_rule("KP604")
+    assert kp604 and "pads to 32" in kp604[0].message
+    # one diagnostic per distinct count, not one per stage
+    assert len(kp604) == 1
+    assert not _full(ragged.graph, ignore=["KP604"]).by_rule("KP604")
+    assert not _full(_chain_pipeline(count=32).graph).by_rule("KP604")
+
+
+# ----------------------------------------------- per-device memory model
+
+
+def test_per_device_peak_divides_fleet_peak_by_shards():
+    applied = _chain_pipeline(dim=16, count=64)
+    report = _full(applied.graph)
+    mem = report.memory
+    assert mem.per_device_peak_bytes > 0
+    shards = meshlib.n_data_shards()
+    assert shards == 8
+    assert mem.per_device_peak_bytes == mem.peak_bytes // shards
+
+
+def test_kp600_per_device_budget_replaces_kp202():
+    applied = _chain_pipeline(dim=256, count=4096)
+    tight = _full(applied.graph, hbm_budget_bytes=256 << 10)
+    assert tight.by_rule("KP600")
+    assert not tight.by_rule("KP202")  # replaced at the full tier
+    # a budget the per-device peak satisfies is quiet, even though the
+    # fleet-wide sum would have tripped the whole-fleet check
+    mem = tight.memory
+    assert mem.per_device_peak_bytes < mem.peak_bytes
+    mid = _full(applied.graph,
+                hbm_budget_bytes=(mem.per_device_peak_bytes
+                                  + mem.peak_bytes) // 2)
+    assert not mid.by_rule("KP600") and not mid.by_rule("KP202")
+
+
+def test_per_device_static_matches_observed_shard_bytes(tmp_path):
+    """Reconciliation closes the per-device loop: the static per-device
+    estimate embedded in the trace equals the bytes one shard of the
+    forced array actually holds on the 8-device mesh."""
+    from keystone_tpu.analysis.reconcile import reconcile_trace
+    from keystone_tpu.telemetry import trace_run
+
+    path = str(tmp_path / "trace.json")
+    ds = Dataset.from_numpy(np.ones((64, 16), np.float32))
+    with trace_run(path):
+        out = Transformer.from_function(
+            lambda x: x * 2.0).to_pipeline()(ds).get()
+    rec = reconcile_trace(json.load(open(path)))
+    rows = [r for r in rec["rows"]
+            if r.get("static_per_device_bytes") and r["observed_bytes"]]
+    assert rows, rec["rows"]
+    leaf = jax.tree_util.tree_leaves(out.data)[0]
+    observed_shard = leaf.addressable_shards[0].data.nbytes
+    for r in rows:
+        assert r["static_per_device_bytes"] == observed_shard, r
+        assert r["spec"].startswith("P('data'"), r
+    assert rec["static_per_device_peak_bytes"] and \
+        rec["static_per_device_peak_bytes"] <= rec["static_peak_bytes"]
+
+
+# ------------------------------------------------------- explain surface
+
+
+def test_explain_rows_and_table():
+    applied = _chain_pipeline()
+    graph = applied.graph
+    specs, _ = spec_pass(graph, {})
+    shardings, diags, boundary = sharding_pass(graph, specs)
+    est, _ = memory_pass(graph, specs)
+    per_dev, _ = per_device_pass(graph, specs, shardings, est)
+    rows = explain_rows(graph, specs, shardings, boundary, per_dev)
+    assert rows and all(
+        set(r) >= {"vertex", "label", "spec", "per_device_bytes",
+                   "boundary_bytes"} for r in rows)
+    table = format_explain(rows)
+    assert "per-dev" in table and "P('data'" in table
+
+
+@pytest.mark.lint
+def test_explain_sharding_cli_all_examples_clean(capsys):
+    from keystone_tpu.analysis.__main__ import main
+
+    rc = main(["--explain-sharding"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for name in EXAMPLES:
+        assert f"✓ {name}" in out
+    assert "P('data'" in out
+
+
+@pytest.mark.lint
+def test_explain_sharding_cli_json(capsys):
+    from keystone_tpu.analysis.__main__ import main
+
+    rc = main(["--explain-sharding", "--json", "MnistRandomFFT"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["devices"] == 8
+    ex = payload["examples"][0]
+    assert ex["example"] == "MnistRandomFFT"
+    assert ex["findings"] == []
+    assert ex["stages"] and all("spec" in s for s in ex["stages"])
+
+
+# --------------------------------------------------- runtime satellites
+
+
+def test_reshard_short_circuits_identity():
+    from keystone_tpu.parallel.collectives import reshard
+
+    x = meshlib.shard_leading_axis(np.ones((16, 4), np.float32))
+    same = reshard(x, P(meshlib.DATA_AXIS))
+    assert same is x  # no program built or dispatched
+    moved = reshard(x, P())
+    assert moved is not x
+    np.testing.assert_array_equal(np.asarray(moved), np.asarray(x))
+    # and resharding the moved value back to its own layout is free again
+    assert reshard(moved, P()) is moved
+
+
+def test_leaf_sharding_ragged_leading_axis_falls_back_replicated():
+    mesh = meshlib.make_mesh(jax.devices()[:2])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        sh = leaf_sharding(mesh, (3, 4))  # 3 rows on a 2-device mesh
+    assert any("does not divide" in str(w.message) for w in caught)
+    assert sh.spec == P()
+    # divisible shapes keep the data sharding, silently
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        sh2 = leaf_sharding(mesh, (4, 4))
+    assert not caught
+    assert meshlib.spec_axes(sh2.spec)[:1] == (meshlib.DATA_AXIS,)
+    # a ragged COUNT still forces fine through Dataset (placement pads)
+    with meshlib.use_mesh(mesh):
+        ds = Dataset.from_numpy(
+            np.arange(12, dtype=np.float32).reshape(3, 4))
+        out = Transformer.from_function(lambda x: x + 1).to_pipeline()(ds)
+        got = out.get().numpy()
+        np.testing.assert_allclose(
+            got, np.arange(12, dtype=np.float32).reshape(3, 4) + 1)
+
+
+@pytest.mark.lint
+def test_example_pipelines_have_zero_kp6xx(capsys):
+    for name in sorted(EXAMPLES):
+        pipeline, source_spec = build_example(name)
+        report = pipeline.validate(source_spec, raise_on_error=False)
+        kp6 = [d for d in report.diagnostics if d.rule.startswith("KP6")]
+        assert not kp6, (name, [str(d) for d in kp6])
