@@ -52,6 +52,7 @@ from .sharding import (
     per_device_pass,
     sharding_pass,
 )
+from .planner import ShardingPlan, plan_sharding
 from .specs import (
     UNKNOWN,
     DataSpec,
@@ -170,6 +171,7 @@ __all__ = [
     "RULES",
     "Severity",
     "ShardedValue",
+    "ShardingPlan",
     "ShardingResult",
     "SpecDataset",
     "SpecMismatchError",
@@ -188,6 +190,7 @@ __all__ = [
     "operator_effects",
     "memory_pass",
     "per_device_pass",
+    "plan_sharding",
     "resolve_chunk_rows",
     "sharding_pass",
     "shape_struct",

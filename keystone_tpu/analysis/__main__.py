@@ -8,6 +8,7 @@ registry.
     python -m keystone_tpu.analysis --audit-operators --json
     python -m keystone_tpu.analysis --explain-sharding  # per-stage placement
     python -m keystone_tpu.analysis --explain-sharding --json
+    python -m keystone_tpu.analysis --explain-sharding --plan --mesh-shape 2x4
     python -m keystone_tpu.analysis --list-rules
 
 Exit code 1 if any example produces ERROR-severity findings (or any
@@ -24,6 +25,15 @@ leaf's shard count), and the priced boundary collective cost (KP601
 all-to-all / KP603 all-gather bytes). Run it on a multi-device mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) to see real
 shard counts; a 1-device mesh degenerates to whole-value placement.
+
+``--plan`` (with ``--explain-sharding``) additionally runs the sharding
+planner (analysis/planner.py) per example: the rendered table compares
+chosen vs default placement per stage with the priced boundary-byte
+delta, and KP6xx findings are linted UNDER the chosen plan.
+``--mesh-shape 2x4`` forces a ('data','model') mesh of that shape over
+the local devices — the lint.sh planner audit runs this on 8 forced CPU
+devices and asserts planner cost ≤ default on every example (strict <
+on at least 2).
 """
 
 from __future__ import annotations
@@ -69,13 +79,47 @@ def _audit_main(args) -> int:
     return 1 if findings else 0
 
 
+def _parse_mesh_shape(raw):
+    """``--mesh-shape 2x4`` → a ('data', 'model') mesh context over the
+    first data×model local devices; None means the ambient mesh."""
+    if not raw:
+        return None
+    import jax
+
+    from ..parallel import mesh as meshlib
+
+    try:
+        parts = [int(p) for p in raw.lower().split("x")]
+    except ValueError:
+        parts = []
+    if len(parts) != 2 or any(p < 1 for p in parts):
+        raise ValueError(f"--mesh-shape must be DATAxMODEL (e.g. 2x4), "
+                         f"got {raw!r}")
+    n = parts[0] * parts[1]
+    devs = jax.devices()
+    if len(devs) < n:
+        raise ValueError(
+            f"--mesh-shape {raw} needs {n} devices, found {len(devs)}")
+    return meshlib.make_mesh(
+        devs[:n], shape=tuple(parts),
+        axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS))
+
+
 def _explain_sharding_main(args) -> int:
     """Per-example sharding explanation (KP6xx gate): propagate partition
     specs, scale memory per device, price boundary collectives, and fail
-    on any unsuppressed KP6xx finding."""
+    on any unsuppressed KP6xx finding. With ``--plan`` the sharding
+    planner additionally chooses a placement per example; the rendered
+    table (and JSON ``planner`` record) compares chosen vs default
+    placement and their priced boundary bytes, and findings are computed
+    UNDER the chosen plan — so the gate proves the decided placement
+    clean, not just the static default."""
+    from contextlib import nullcontext
+
     from ..parallel import mesh as meshlib
     from ..workflow.env import execution_config
     from .memory import memory_pass
+    from .planner import format_plan, plan_sharding
     from .propagate import spec_pass
     from .sharding import (
         explain_rows,
@@ -91,55 +135,94 @@ def _explain_sharding_main(args) -> int:
         print(f"unknown example(s): {', '.join(unknown)}; "
               f"known: {', '.join(sorted(EXAMPLES))}", file=sys.stderr)
         return 2
-    mesh = meshlib.current_mesh()
+    try:
+        forced_mesh = _parse_mesh_shape(args.mesh_shape)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2  # usage error, not a findings failure
+    mesh_ctx = (meshlib.use_mesh(forced_mesh) if forced_mesh is not None
+                else nullcontext())
     budget = (int(args.hbm_budget_gb * (1 << 30))
               if args.hbm_budget_gb else execution_config().hbm_budget_bytes)
 
     failed = False
     records = []
-    for name in names:
-        try:
-            pipeline, source_spec = build_example(name)
-            graph = pipeline.graph
-            specs, _ = spec_pass(
-                graph, {pipeline.source: as_source_spec(source_spec)})
-            shardings, diags, boundary = sharding_pass(graph, specs)
-            est, _ = memory_pass(graph, specs)
-            per_dev, pd_diags = per_device_pass(
-                graph, specs, shardings, est, hbm_budget_bytes=budget)
-            diags = [d for d in diags + pd_diags
-                     if d.rule not in set(args.ignore)]
-            rows = explain_rows(graph, specs, shardings, boundary, per_dev)
-        except Exception as e:  # a factory bug is a failure, not a crash
+    with mesh_ctx:
+        mesh = meshlib.current_mesh()
+        for name in names:
+            try:
+                pipeline, source_spec = build_example(name)
+                graph = pipeline.graph
+                specs, _ = spec_pass(
+                    graph, {pipeline.source: as_source_spec(source_spec)})
+                splan = None
+                plan_choices = None
+                if args.plan:
+                    splan = plan_sharding(
+                        graph, specs, mesh=mesh, hbm_budget_bytes=budget)
+                    plan_choices = splan.choices if splan else None
+                shardings, diags, boundary = sharding_pass(
+                    graph, specs, mesh=mesh, plan=plan_choices)
+                est, _ = memory_pass(graph, specs)
+                per_dev, pd_diags = per_device_pass(
+                    graph, specs, shardings, est, mesh=mesh,
+                    hbm_budget_bytes=budget)
+                diags = [d for d in diags + pd_diags
+                         if d.rule not in set(args.ignore)]
+                rows = explain_rows(graph, specs, shardings, boundary,
+                                    per_dev)
+            except Exception as e:  # a factory bug is a failure, not a crash
+                if args.json:
+                    records.append({"example": name, "build_error":
+                                    f"{type(e).__name__}: {e}"})
+                else:
+                    print(f"✗ {name}: failed to build/explain: "
+                          f"{type(e).__name__}: {e}")
+                failed = True
+                continue
+            failed |= bool(diags)
             if args.json:
-                records.append({"example": name, "build_error":
-                                f"{type(e).__name__}: {e}"})
+                rec = {
+                    "example": name,
+                    "devices": int(mesh.devices.size),
+                    "per_device_peak_bytes": est.per_device_peak_bytes,
+                    "stages": rows,
+                    "findings": [
+                        {"rule": d.rule, "severity": d.severity.name,
+                         "anchor": d.anchor, "message": d.message}
+                        for d in diags
+                    ],
+                }
+                if splan is not None:
+                    rec["planner"] = {
+                        "planned_cost_bytes": int(splan.planned_cost_bytes),
+                        "default_cost_bytes": int(splan.default_cost_bytes),
+                        "savings_bytes": splan.savings_bytes,
+                        "improved": splan.improved,
+                        "changed_stages": len(splan.changed_vertices()),
+                        "stages": splan.rows(graph),
+                    }
+                elif args.plan:
+                    rec["planner"] = None  # nothing to decide (1 device)
+                records.append(rec)
             else:
-                print(f"✗ {name}: failed to build/explain: "
-                      f"{type(e).__name__}: {e}")
-            failed = True
-            continue
-        failed |= bool(diags)
-        if args.json:
-            records.append({
-                "example": name,
-                "devices": int(mesh.devices.size),
-                "per_device_peak_bytes": est.per_device_peak_bytes,
-                "stages": rows,
-                "findings": [
-                    {"rule": d.rule, "severity": d.severity.name,
-                     "anchor": d.anchor, "message": d.message}
-                    for d in diags
-                ],
-            })
-        else:
-            mark = "✗" if diags else "✓"
-            print(f"{mark} {name} (mesh: {int(mesh.devices.size)} device(s), "
-                  f"per-device peak ≈ "
-                  f"{est.per_device_peak_bytes >> 10} KiB)")
-            print("  " + format_explain(rows).replace("\n", "\n  "))
-            for d in diags:
-                print(f"    {d}")
+                mark = "✗" if diags else "✓"
+                print(f"{mark} {name} (mesh: {int(mesh.devices.size)} "
+                      f"device(s), per-device peak ≈ "
+                      f"{est.per_device_peak_bytes >> 10} KiB)")
+                if splan is not None:
+                    print(f"  planner: boundary bytes "
+                          f"{int(splan.default_cost_bytes):,} (default) → "
+                          f"{int(splan.planned_cost_bytes):,} (chosen), "
+                          f"{splan.savings_bytes:,} saved, "
+                          f"{len(splan.changed_vertices())} stage(s) "
+                          "changed")
+                    print("  " + format_plan(splan.rows(graph))
+                          .replace("\n", "\n  "))
+                else:
+                    print("  " + format_explain(rows).replace("\n", "\n  "))
+                for d in diags:
+                    print(f"    {d}")
     if args.json:
         print(json.dumps({
             "devices": int(mesh.devices.size),
@@ -168,6 +251,15 @@ def main(argv=None) -> int:
                    help="render each example's per-stage partition table "
                         "(spec, per-device bytes, boundary collective "
                         "cost) and fail on any unsuppressed KP6xx finding")
+    p.add_argument("--plan", action="store_true",
+                   help="with --explain-sharding: run the sharding "
+                        "planner per example and render chosen-vs-default "
+                        "placement with priced savings; findings are "
+                        "linted under the CHOSEN plan")
+    p.add_argument("--mesh-shape", default=None, metavar="DATAxMODEL",
+                   help="force a ('data','model') mesh of this shape "
+                        "(e.g. 2x4) over the local devices for "
+                        "--explain-sharding")
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (CI annotation)")
     p.add_argument("--list-rules", action="store_true")

@@ -1,0 +1,768 @@
+"""Sharding-aware plan optimizer: placement as an optimizer *decision*.
+
+PR 8's static front-end made placement a checked, priced property: every
+stage boundary carries a `PartitionSpec`, implicit reshards are priced
+as boundary all-to-alls (KP601/KP603), and memory is modeled per device
+(KP600). This module is the decision back-end — KeystoneML's thesis
+(PAPER §4) applied to placement: the optimizer, not the user, chooses
+each stage's physical layout from a small legal menu, prices every
+candidate with the SAME cost model the lints use
+(`parallel.mesh.collective_cost`), and hands the winning assignment to
+the execution layer for enforcement.
+
+The model:
+
+  - **menu** — per stage boundary, the legal placement *families*:
+    data-sharded leading axis (`FAMILY_DATA`), model-sharded feature
+    axis (`FAMILY_MODEL`), 2-D data×model (`FAMILY_DATA_MODEL`), and
+    replicated (`FAMILY_REPLICATED`). A family is legal for a stage only
+    when the mesh has the axes and every element leaf's feature dim
+    divides the model-axis size — the same divisibility contract
+    `data.dataset.leaf_sharding` enforces at runtime.
+  - **cost** — a boundary where producer and consumer families differ
+    prices an all-to-all of the producer's bytes (plus a fixed
+    per-reshard penalty, so fewer moves win byte ties); an operator
+    `abstract_sharding` demand (`fit_sharding_demands` — solver fits
+    want row-sharded inputs) unmet by the producer's family prices the
+    same all-to-all the KP601 lint would report; a provably-host
+    consumer of sharded data prices the KP603 all-gather; a replicated
+    stage above the KP602 threshold with a shardable axis prices a
+    broadcast. Per-device residency over the KP600 budget makes a
+    family INFEASIBLE (pruned), the memory-safe-compilation discipline
+    of arXiv 2206.14148.
+  - **solver** — min-cost DP over the fan-out-free chain structure of
+    the lowered plan: exact on chains (each link's table carries the
+    best cost per family with backpointers), greedy frontier merge at
+    gather diamonds and fan-in (parents are frozen at their own best
+    assignment — demand- and gather-aware — before the consumer
+    chooses).
+
+The planner NEVER loses to the default: the chosen assignment and the
+PR-8 default placement are scored by the same function, and when the
+optimum fails to strictly beat the default the plan degrades to the
+default assignment (``improved=False``, nothing is enforced) — so
+``KEYSTONE_SHARDING_PLANNER`` only ever removes priced boundary bytes.
+
+Everything here is pure spec arithmetic — no data moves, no device
+allocates. Enforcement lives in `workflow.optimizer.ShardingPlannerRule`.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import mesh as meshlib
+from ..workflow.graph import Graph, GraphId, NodeId, SinkId, SourceId
+from .propagate import _label, toposort
+from .sharding import (
+    DEFAULT_REPLICATED_THRESHOLD,
+    DEMAND_DATA_SHARDED,
+    DEMAND_REPLICATED,
+    PartitionRule,
+    ShardedValue,
+    ShardingResult,
+    _is_host_stage,
+    _shardable_axis,
+    per_device_bytes,
+    sharding_pass,
+    spec_str,
+)
+from .specs import DataSpec, element_nbytes, is_known
+
+#: the placement menu: every family the planner may assign to a stage.
+FAMILY_DATA = "data"
+FAMILY_DATA_MODEL = "data_model"
+FAMILY_MODEL = "model"
+FAMILY_REPLICATED = "replicated"
+MENU: Tuple[str, ...] = (
+    FAMILY_DATA, FAMILY_DATA_MODEL, FAMILY_MODEL, FAMILY_REPLICATED)
+
+#: fixed per-boundary-move penalty (bytes): every reshard costs a
+#: collective launch + layout change on top of its payload, so
+#: assignments with fewer moves win byte ties (the "reshard count
+#: penalty" term of the objective).
+RESHARD_PENALTY_BYTES = 64 << 10
+
+_INF = float("inf")
+
+
+# ------------------------------------------------------------------ families
+
+
+def _family_leaf_spec(family: str, leaf, mesh, kind: str) -> Optional[P]:
+    """Batch-level PartitionSpec ``family`` gives one element leaf, or
+    None when the leaf cannot take it (no model axis, indivisible
+    feature dim, rank-0 leaf for a feature-axis family)."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    if kind != "dataset":
+        return None
+    if family == FAMILY_DATA:
+        return P(meshlib.DATA_AXIS)
+    if family == FAMILY_REPLICATED:
+        return P()
+    model = int(mesh.shape.get(meshlib.MODEL_AXIS, 1))
+    if model <= 1 or not shape or int(shape[0]) % model != 0:
+        return None
+    if family == FAMILY_MODEL:
+        return P(None, meshlib.MODEL_AXIS)
+    if family == FAMILY_DATA_MODEL:
+        return P(meshlib.DATA_AXIS, meshlib.MODEL_AXIS)
+    raise ValueError(f"unknown placement family {family!r}")
+
+
+def realize_family(family: str, spec: DataSpec, mesh) -> Optional[ShardedValue]:
+    """The `ShardedValue` ``family`` assigns to a stage's value, or None
+    when any element leaf cannot take the family (the family is then not
+    on this stage's menu)."""
+    leaves = jax.tree_util.tree_leaves(spec.element)
+    leaf_specs = [_family_leaf_spec(family, l, mesh, spec.kind)
+                  for l in leaves]
+    if any(s is None for s in leaf_specs):
+        return None
+    specs = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(spec.element), leaf_specs)
+    return ShardedValue(specs, kind=spec.kind)
+
+
+def family_of(sv: Optional[ShardedValue], mesh) -> Optional[str]:
+    """Classify a propagated `ShardedValue` back into a menu family, or
+    None when it matches no family (mixed per-leaf placements, exotic
+    axes) — such stages are left out of the planner's choice set."""
+    if sv is None or sv.kind != "dataset":
+        return None
+    fams = set()
+    for lspec in sv.leaf_specs():
+        axes = meshlib.spec_axes(lspec)
+        entries = tuple(lspec)
+        lead = entries[0] if entries else None
+        if isinstance(lead, (tuple, list)):
+            lead = lead[0] if lead else None
+        if not axes:
+            fams.add(FAMILY_REPLICATED)
+        elif lead == meshlib.DATA_AXIS and meshlib.MODEL_AXIS in axes:
+            fams.add(FAMILY_DATA_MODEL)
+        elif lead == meshlib.DATA_AXIS:
+            fams.add(FAMILY_DATA)
+        elif meshlib.MODEL_AXIS in axes and meshlib.DATA_AXIS not in axes:
+            fams.add(FAMILY_MODEL)
+        else:
+            return None
+    if len(fams) != 1:
+        return None
+    return fams.pop()
+
+
+def family_shards(family: Optional[str], mesh) -> int:
+    data = int(mesh.shape.get(meshlib.DATA_AXIS, 1))
+    model = int(mesh.shape.get(meshlib.MODEL_AXIS, 1))
+    return {
+        FAMILY_DATA: data,
+        FAMILY_MODEL: model,
+        FAMILY_DATA_MODEL: data * model,
+        FAMILY_REPLICATED: 1,
+        None: 1,
+    }[family]
+
+
+# --------------------------------------------------------------------- costs
+
+
+def _effective_input_family(v_fam: str, u_spec, mesh) -> str:
+    """The layout a consumer choosing ``v_fam`` actually needs its
+    *input* in. A feature-axis family that cannot apply to the input's
+    element (rank-0 leaves, indivisible widths) demands only its data
+    component: computing a model-sharded output from a value with no
+    shardable feature axis needs that value row-aligned, not feature-
+    split — so a data-sharded scalar-label input feeding a data×model
+    one-hot output is collective-free, while a feature-sharded matrix
+    feeding a data-only consumer really does pay the model-axis
+    gather."""
+    if v_fam in (FAMILY_DATA, FAMILY_REPLICATED):
+        return v_fam
+    if isinstance(u_spec, DataSpec) and \
+            realize_family(v_fam, u_spec, mesh) is not None:
+        return v_fam
+    return FAMILY_DATA if v_fam == FAMILY_DATA_MODEL else FAMILY_REPLICATED
+
+
+def _transition_bytes(u_fam: Optional[str], v_fam: Optional[str],
+                      nbytes: Optional[int], mesh,
+                      u_spec=None) -> float:
+    """Priced bytes of relaying a producer's output from its family to
+    the layout the consumer's family implies for it
+    (`_effective_input_family`). A matching layout — and anything
+    leaving a replicated producer, which every device already holds
+    whole — is free; gathering into full replication is an all-gather;
+    everything else is an all-to-all of the boundary bytes
+    (`parallel.mesh.collective_cost`, the KP601 formula). Pure
+    collective bytes — the per-reshard penalty is an OBJECTIVE term
+    only (`_with_penalty`), never reported as bytes."""
+    if u_fam is None or v_fam is None or not nbytes:
+        return 0.0
+    eff = _effective_input_family(v_fam, u_spec, mesh)
+    if u_fam == eff:
+        return 0.0
+    if u_fam == FAMILY_REPLICATED:
+        return 0.0  # local slicing: each device holds the full value
+    if eff == FAMILY_REPLICATED:
+        cost = meshlib.collective_cost(
+            "all_gather", nbytes, shards=family_shards(u_fam, mesh),
+            mesh=mesh)
+    else:
+        cost = meshlib.collective_cost(
+            "all_to_all", nbytes,
+            shards=max(family_shards(u_fam, mesh),
+                       family_shards(eff, mesh)),
+            mesh=mesh)
+    return float(cost.bytes_moved)
+
+
+def _demand_bytes(demand: Optional[str], fam: Optional[str],
+                  nbytes: Optional[int], mesh) -> float:
+    """KP601's demand pricing: an `abstract_sharding` input demand unmet
+    by the producer's family. A sharding demand costs an all-to-all
+    between layouts; a replication demand gathers the whole value (the
+    lint's own convention). Pure collective bytes — see
+    `_transition_bytes` on the penalty split."""
+    if demand is None or fam is None or not nbytes:
+        return 0.0
+    data = int(mesh.shape.get(meshlib.DATA_AXIS, 1))
+    bad = (
+        demand == DEMAND_DATA_SHARDED and data > 1
+        and fam not in (FAMILY_DATA, FAMILY_DATA_MODEL)
+    ) or (
+        demand == DEMAND_REPLICATED and fam != FAMILY_REPLICATED
+    )
+    if not bad:
+        return 0.0
+    if demand == DEMAND_REPLICATED:
+        cost = meshlib.collective_cost(
+            "all_gather", nbytes, shards=family_shards(fam, mesh),
+            mesh=mesh)
+    else:
+        cost = meshlib.collective_cost(
+            "all_to_all", nbytes,
+            shards=max(data, family_shards(fam, mesh)), mesh=mesh)
+    return float(cost.bytes_moved)
+
+
+def _with_penalty(move_bytes: float) -> float:
+    """Objective contribution of one boundary move: its bytes plus the
+    fixed per-reshard penalty (every move also costs a collective
+    launch, so fewer moves win byte ties). Zero moves carry no
+    penalty."""
+    return move_bytes + RESHARD_PENALTY_BYTES if move_bytes else 0.0
+
+
+def _gather_bytes(fam: Optional[str], nbytes: Optional[int], mesh) -> float:
+    """KP603's pricing: a host consumer of device-sharded data
+    all-gathers every shard."""
+    if fam is None or fam == FAMILY_REPLICATED or not nbytes:
+        return 0.0
+    cost = meshlib.collective_cost(
+        "all_gather", nbytes, shards=family_shards(fam, mesh), mesh=mesh)
+    return float(cost.bytes_moved)
+
+
+class _CostModel:
+    """The planner's priced view of one graph: per-vertex menus, node
+    costs (KP600 budget feasibility, KP602 replication penalty), hook
+    demands, and a shared assignment scorer — so the DP's choice and the
+    default's score come from literally the same arithmetic."""
+
+    def __init__(self, graph: Graph, specs: Dict[GraphId, Any], mesh,
+                 hbm_budget_bytes: Optional[int],
+                 replicated_threshold_bytes: int):
+        self.graph = graph
+        self.specs = specs
+        self.mesh = mesh
+        self.budget = hbm_budget_bytes
+        self.threshold = replicated_threshold_bytes
+        order, _ = toposort(graph)
+        self.order = [v for v in order if not isinstance(v, SinkId)]
+        # apply-path boundaries propagated from an unbound source carry
+        # no example count; cost them at the graph's nominal count (the
+        # largest known count — the fit side's — else a fixed stand-in)
+        # so the per-example byte ratios that drive the decision still
+        # rank correctly. Absolute feasibility (KP600) is only checked
+        # where the count is real.
+        known_counts = [
+            s.count for s in specs.values()
+            if isinstance(s, DataSpec) and s.kind == "dataset"
+            and s.count
+        ]
+        self.nominal_count = max(known_counts, default=1024)
+        #: vid -> {family: realized ShardedValue} for choosable vertices
+        self.menus: Dict[GraphId, Dict[str, ShardedValue]] = {}
+        for vid in self.order:
+            spec = specs.get(vid)
+            if not self._choosable_spec(spec):
+                continue
+            menu = {}
+            for fam in MENU:
+                sv = realize_family(fam, spec, mesh)
+                if sv is not None:
+                    menu[fam] = sv
+            if menu:
+                self.menus[vid] = menu
+        self._demands: Dict[GraphId, Tuple[Optional[str], ...]] = {}
+        self._host: Dict[GraphId, bool] = {}
+
+    def _choosable_spec(self, spec) -> bool:
+        if not isinstance(spec, DataSpec) or spec.kind != "dataset":
+            return False
+        if not spec.on_device or not is_known(spec.element):
+            return False
+        return self.vbytes(spec) is not None
+
+    def vbytes(self, spec) -> Optional[int]:
+        """Priced size of a boundary value: real bytes when the count is
+        known, per-element bytes × the nominal count otherwise."""
+        if not isinstance(spec, DataSpec):
+            return None
+        if spec.nbytes is not None:
+            return spec.nbytes
+        if spec.kind != "dataset":
+            return None
+        per = element_nbytes(spec.element)
+        if per is None:
+            return None
+        return per * self.nominal_count
+
+    def data_deps(self, vid) -> List[GraphId]:
+        if isinstance(vid, (SourceId,)):
+            return []
+        deps = self.graph.get_dependencies(vid)
+        return [d for d in deps if isinstance(self.specs.get(d), DataSpec)]
+
+    def demands(self, vid, assignment) -> Tuple[Optional[str], ...]:
+        """The operator's `abstract_sharding` input demands, evaluated
+        once (fit demands are static; a raising hook contributes none —
+        the lint's KP605 channel reports it)."""
+        if vid in self._demands:
+            return self._demands[vid]
+        out: Tuple[Optional[str], ...] = ()
+        if isinstance(vid, NodeId):
+            op = self.graph.get_operator(vid)
+            hook = getattr(op, "abstract_sharding", None)
+            if hook is not None:
+                deps = self.graph.get_dependencies(vid)
+                in_shardings = [assignment.get(d) for d in deps]
+                in_specs = [self.specs.get(d) for d in deps]
+                try:
+                    res = hook(in_shardings, in_specs)
+                    if isinstance(res, ShardingResult):
+                        out = tuple(res.demands)
+                except Exception:
+                    out = ()
+        self._demands[vid] = out
+        return out
+
+    def is_host(self, vid) -> bool:
+        got = self._host.get(vid)
+        if got is None:
+            got = isinstance(vid, NodeId) and _is_host_stage(
+                self.graph, vid, self.specs)
+            self._host[vid] = got
+        return got
+
+    def node_cost(self, vid, fam: str) -> float:
+        """Per-vertex cost of holding this stage in ``fam``: INF when
+        the per-device residency busts the KP600 budget (the menu entry
+        is pruned), plus the KP602 broadcast penalty for oversized
+        replication with a shardable axis."""
+        spec = self.specs.get(vid)
+        sv = self.menus[vid][fam]
+        cost = 0.0
+        if self.budget:
+            pd = per_device_bytes(spec, sv, self.mesh)
+            if pd is not None and pd > self.budget:
+                return _INF
+        if fam == FAMILY_REPLICATED and spec.nbytes \
+                and spec.nbytes >= self.threshold \
+                and _shardable_axis(spec, self.mesh) is not None:
+            cost += float(meshlib.collective_cost(
+                "broadcast", spec.nbytes,
+                shards=int(self.mesh.devices.size),
+                mesh=self.mesh).bytes_moved)
+        return cost
+
+    # ---------------------------------------------------------- scoring
+
+    def score(self, families: Dict[GraphId, str]) -> Tuple[
+            float, float, Dict[NodeId, int]]:
+        """``(objective, bytes_total, boundary)`` of one complete
+        assignment. ``boundary`` holds per-vertex PURE collective bytes
+        (charged at the consumer, matching the lint's ``boundary_costs``
+        semantics — no synthetic penalties); ``bytes_total`` is their
+        sum; ``objective`` additionally carries the per-reshard penalty
+        and INF for budget-infeasible assignments, and is what the
+        solver compares. The SAME function scores the planner's optimum
+        and the PR-8 default, so "planner ≤ default" is a property of
+        the arithmetic, not of two models agreeing."""
+        assignment = {
+            vid: self.menus[vid][fam]
+            for vid, fam in families.items() if vid in self.menus
+        }
+        objective = 0.0
+        bytes_total = 0.0
+        boundary: Dict[NodeId, int] = {}
+
+        def charge(vid, move_bytes: float, penalized: bool = True) -> None:
+            nonlocal objective, bytes_total
+            if not move_bytes:
+                return
+            objective += (_with_penalty(move_bytes) if penalized
+                          else move_bytes)
+            if move_bytes != _INF:
+                bytes_total += move_bytes
+                if isinstance(vid, NodeId):
+                    boundary[vid] = boundary.get(vid, 0) + int(move_bytes)
+
+        for vid in self.order:
+            fam_v = families.get(vid)
+            if fam_v is not None and vid in self.menus:
+                # node costs are either INF (budget) or real broadcast
+                # bytes (KP602) — never a launch-penalty situation
+                charge(vid, self.node_cost(vid, fam_v), penalized=False)
+            deps = self.data_deps(vid)
+            demands = self.demands(vid, assignment)
+            all_deps = (list(self.graph.get_dependencies(vid))
+                        if isinstance(vid, NodeId) else [])
+            for d in deps:
+                fam_u = families.get(d)
+                u_spec = self.specs.get(d)
+                nbytes = self.vbytes(u_spec)
+                if self.is_host(vid):
+                    charge(vid, _gather_bytes(fam_u, nbytes, self.mesh),
+                           penalized=False)
+                    continue
+                demand = None
+                if demands:
+                    try:
+                        i = all_deps.index(d)
+                    except ValueError:
+                        i = -1
+                    if 0 <= i < len(demands):
+                        demand = demands[i]
+                if demand is not None:
+                    charge(vid, _demand_bytes(
+                        demand, fam_u, nbytes, self.mesh))
+                elif fam_v is not None:
+                    charge(vid, _transition_bytes(
+                        fam_u, fam_v, nbytes, self.mesh, u_spec=u_spec))
+        return objective, bytes_total, boundary
+
+
+# ---------------------------------------------------------------------- plan
+
+
+@dataclass
+class ShardingPlan:
+    """The planner's decision: chosen per-stage placements, the PR-8
+    default they were scored against, and both priced totals. When
+    ``improved`` is False the choices ARE the default assignment and
+    nothing is enforced."""
+
+    mesh: Any
+    families: Dict[GraphId, str]
+    default_families: Dict[GraphId, str]
+    choices: Dict[GraphId, ShardedValue]
+    default_shardings: Dict[GraphId, Optional[ShardedValue]]
+    planned_cost_bytes: float
+    default_cost_bytes: float
+    planned_boundary: Dict[NodeId, int] = field(default_factory=dict)
+    default_boundary: Dict[NodeId, int] = field(default_factory=dict)
+
+    @property
+    def improved(self) -> bool:
+        return self.planned_cost_bytes < self.default_cost_bytes
+
+    @property
+    def savings_bytes(self) -> int:
+        return max(0, int(self.default_cost_bytes - self.planned_cost_bytes))
+
+    def changed_vertices(self) -> List[GraphId]:
+        return [vid for vid, fam in sorted(
+                    self.families.items(),
+                    key=lambda kv: getattr(kv[0], "id", -1))
+                if self.default_families.get(vid) != fam]
+
+    def spec_for(self, vid) -> Optional[P]:
+        """The batch-level PartitionSpec the plan pins on ``vid``'s
+        output (first leaf — enforcement constrains array outputs, which
+        are single-leaf on every enforced path)."""
+        sv = self.choices.get(vid)
+        if sv is None:
+            return None
+        leaves = sv.leaf_specs()
+        return leaves[0] if leaves else None
+
+    def partition_rules(self, graph: Graph) -> List[PartitionRule]:
+        """The chosen plan as declarative `PartitionRule`s — one
+        anchor-exact rule per stage whose choice deviates from the
+        default — the channel by which the decision feeds any
+        rule-consuming surface (`validate(partition_rules=...)`)."""
+        rules = []
+        for vid in self.changed_vertices():
+            if not isinstance(vid, NodeId):
+                continue
+            spec = self.spec_for(vid)
+            if spec is None:
+                continue
+            anchor = f"{_label(graph, vid)}@{vid}"
+            rules.append(PartitionRule(f"^{re.escape(anchor)}$", spec))
+        return rules
+
+    def rows(self, graph: Graph) -> List[Dict[str, Any]]:
+        """Chosen-vs-default per-stage table (topo order), JSON-ready —
+        the ``--explain-sharding --plan`` payload."""
+        order, _ = toposort(graph)
+        rows = []
+        for vid in order:
+            if not isinstance(vid, NodeId):
+                continue
+            chosen = self.choices.get(vid, self.default_shardings.get(vid))
+            rows.append({
+                "vertex": vid.id,
+                "label": _label(graph, vid),
+                "default_spec": spec_str(self.default_shardings.get(vid)),
+                "chosen_spec": spec_str(chosen),
+                "changed": vid in set(self.changed_vertices()),
+                "default_boundary_bytes": self.default_boundary.get(vid, 0),
+                "planned_boundary_bytes": self.planned_boundary.get(vid, 0),
+            })
+        return rows
+
+
+def format_plan(rows: List[Dict[str, Any]]) -> str:
+    lines = [f"{'stage':<38} {'default':<20} {'chosen':<20} {'Δbytes':>12}"]
+    for r in rows:
+        delta = r["default_boundary_bytes"] - r["planned_boundary_bytes"]
+        mark = "*" if r["changed"] else " "
+        name = f"{r['label']}@{r['vertex']}"
+        col = f"{delta:+,d}" if delta else "—"
+        lines.append(
+            f"{name[:38]:<38} {r['default_spec'][:20]:<20} "
+            f"{mark}{r['chosen_spec'][:19]:<19} {col:>12}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- solver
+
+
+def plan_sharding(
+    graph: Graph,
+    specs: Dict[GraphId, Any],
+    *,
+    mesh=None,
+    hbm_budget_bytes: Optional[int] = None,
+    replicated_threshold_bytes: int = DEFAULT_REPLICATED_THRESHOLD,
+) -> Optional[ShardingPlan]:
+    """Choose a placement assignment minimizing priced boundary bytes.
+
+    Returns None when there is nothing to decide (a 1-device mesh, or no
+    stage with a known on-device dataset boundary). Otherwise the DP
+    runs, both the optimum and the PR-8 default are scored with the same
+    cost function, and the better one is returned — ``improved`` says
+    whether the planner actually beat the default."""
+    mesh = mesh or meshlib.current_mesh()
+    if int(mesh.devices.size) <= 1:
+        return None
+    model = _CostModel(graph, specs, mesh, hbm_budget_bytes,
+                       replicated_threshold_bytes)
+    if not model.menus:
+        return None
+
+    # the PR-8 default placement, classified into families; stages whose
+    # default placement matches no family are dropped from the choice
+    # set entirely (the planner leaves what it cannot classify alone)
+    default_shardings, _, _ = sharding_pass(graph, specs, mesh=mesh)
+    default_families: Dict[GraphId, str] = {}
+    for vid in list(model.menus):
+        fam = family_of(default_shardings.get(vid), mesh)
+        if fam is None or fam not in model.menus[vid]:
+            del model.menus[vid]
+        else:
+            default_families[vid] = fam
+    if not model.menus:
+        return None
+
+    graph_users = {vid: [u for u in graph.users_of(vid)
+                         if not isinstance(u, SinkId)]
+                   for vid in model.order}
+
+    dp: Dict[GraphId, Dict[str, float]] = {}
+    back: Dict[GraphId, Dict[str, Optional[str]]] = {}
+    chain_parent: Dict[GraphId, GraphId] = {}
+    frozen: Dict[GraphId, str] = {}
+
+    def menu_rank(vid, fam) -> Tuple:
+        # deterministic tie-break: prefer the default family, then menu
+        # order — so a planner with nothing to win reproduces the
+        # default assignment exactly
+        return (0 if fam == default_families.get(vid) else 1,
+                MENU.index(fam))
+
+    def freeze(vid, extra=None) -> None:
+        """Finalize ``vid``'s family (greedy frontier merge): pick the
+        cheapest table entry — optionally biased by the freezing
+        consumer's ``extra(family)`` cost — then walk the chain
+        backpointers so every upstream link of the fan-out-free chain
+        is assigned its matching optimal family."""
+        if vid in frozen or vid not in dp:
+            return
+        table = dp[vid]
+        best = min(
+            table,
+            key=lambda f: (table[f] + (extra(f) if extra else 0.0),)
+            + menu_rank(vid, f))
+        if table[best] == _INF:
+            best = default_families[vid]  # every entry infeasible
+        cur, fam = vid, best
+        while cur is not None:
+            frozen[cur] = fam
+            parent = chain_parent.get(cur)
+            fam = back.get(cur, {}).get(fam) if parent is not None else None
+            cur = parent
+
+    for vid in model.order:
+        deps = model.data_deps(vid)
+        choosable_deps = [d for d in deps if d in model.menus]
+        if vid in model.menus:
+            chain = None
+            if len(choosable_deps) == 1:
+                (u,) = choosable_deps
+                if len(graph_users.get(u, ())) == 1 and u in dp \
+                        and u not in frozen:
+                    chain = u
+            # non-chain parents are frozen here (greedy frontier merge)
+            for d in choosable_deps:
+                if d is not chain:
+                    freeze(d)
+            table: Dict[str, float] = {}
+            bptr: Dict[str, Optional[str]] = {}
+            for fam in model.menus[vid]:
+                node = model.node_cost(vid, fam)
+                if chain is not None:
+                    u_spec = model.specs.get(chain)
+                    u_bytes = model.vbytes(u_spec)
+                    best_g, best_cost = None, _INF
+                    for g, gc in dp[chain].items():
+                        c = gc + _with_penalty(_transition_bytes(
+                            g, fam, u_bytes, mesh, u_spec=u_spec))
+                        if c < best_cost or (
+                                c == best_cost and best_g is not None
+                                and menu_rank(chain, g)
+                                < menu_rank(chain, best_g)):
+                            best_g, best_cost = g, c
+                    table[fam] = best_cost + node
+                    bptr[fam] = best_g
+                else:
+                    base = 0.0
+                    for d in choosable_deps:
+                        d_spec = model.specs.get(d)
+                        base += _with_penalty(_transition_bytes(
+                            frozen.get(d), fam, model.vbytes(d_spec),
+                            mesh, u_spec=d_spec))
+                    table[fam] = base + node
+                    bptr[fam] = None
+            dp[vid] = table
+            back[vid] = bptr
+            if chain is not None:
+                chain_parent[vid] = chain
+        else:
+            # a non-choice consumer terminates its producers' chains;
+            # freezing is demand- and host-aware so a chain's last link
+            # is chosen knowing what its consumer will charge
+            demands = model.demands(vid, {})
+            all_deps = (graph.get_dependencies(vid)
+                        if isinstance(vid, NodeId) else ())
+            for d in choosable_deps:
+                d_bytes = model.vbytes(model.specs.get(d))
+                if model.is_host(vid):
+                    freeze(d, extra=lambda f, b=d_bytes:
+                           _gather_bytes(f, b, mesh))
+                elif demands:
+                    try:
+                        i = list(all_deps).index(d)
+                    except ValueError:
+                        i = -1
+                    demand = demands[i] if 0 <= i < len(demands) else None
+                    freeze(d, extra=lambda f, dm=demand, b=d_bytes:
+                           _with_penalty(_demand_bytes(dm, f, b, mesh)))
+                else:
+                    freeze(d)
+
+    for vid in model.order:
+        if vid in dp and vid not in frozen:
+            freeze(vid)  # chain tails feeding only sinks
+
+    default_obj, default_bytes, default_boundary = model.score(
+        default_families)
+
+    # Greedy frontier merge can freeze a shared producer (the
+    # train/apply input both chains hang off) before either consumer's
+    # preference is known. Two cheap repairs, both scored by the same
+    # function: the uniform data-parallel assignment as an alternative
+    # seed, then a bounded coordinate-descent sweep (try each family at
+    # each vertex, keep strict improvements) — chains stay exact via the
+    # DP, diamonds get polished globally.
+    def pick(fams_a, obj_a, fams_b):
+        obj_b, _, _ = model.score(fams_b)
+        return (fams_b, obj_b) if obj_b < obj_a else (fams_a, obj_a)
+
+    best_fams = dict(frozen)
+    best_obj, _, _ = model.score(best_fams)
+    uniform = {
+        vid: (FAMILY_DATA if FAMILY_DATA in model.menus[vid]
+              else default_families[vid])
+        for vid in model.menus
+    }
+    best_fams, best_obj = pick(best_fams, best_obj, uniform)
+    for _sweep in range(3):
+        changed = False
+        for vid in model.order:
+            if vid not in model.menus:
+                continue
+            for fam in model.menus[vid]:
+                if fam == best_fams.get(vid):
+                    continue
+                trial = dict(best_fams)
+                trial[vid] = fam
+                trial_obj, _, _ = model.score(trial)
+                if trial_obj < best_obj:
+                    best_fams, best_obj = trial, trial_obj
+                    changed = True
+        if not changed:
+            break
+
+    frozen = best_fams
+    planned_obj, planned_bytes, planned_boundary = model.score(frozen)
+
+    # the plan wins only when BOTH the full objective (bytes +
+    # per-reshard penalties + feasibility) and the pure byte total are
+    # strictly better — the reported savings are honest collective
+    # bytes, and `improved` is exactly "frozen differs from default"
+    if not (planned_obj < default_obj and planned_bytes < default_bytes):
+        # the optimizer found no strict win: the plan IS the default
+        frozen = dict(default_families)
+        planned_bytes, planned_boundary = default_bytes, default_boundary
+
+    choices = {vid: model.menus[vid][fam] for vid, fam in frozen.items()}
+    return ShardingPlan(
+        mesh=mesh,
+        families=frozen,
+        default_families=default_families,
+        choices=choices,
+        default_shardings=default_shardings,
+        planned_cost_bytes=planned_bytes,
+        default_cost_bytes=default_bytes,
+        planned_boundary=planned_boundary,
+        default_boundary=default_boundary,
+    )

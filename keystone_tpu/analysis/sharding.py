@@ -259,6 +259,7 @@ def sharding_pass(
     *,
     mesh=None,
     rules: Sequence = (),
+    plan: Optional[Dict[GraphId, ShardedValue]] = None,
     replicated_threshold_bytes: int = DEFAULT_REPLICATED_THRESHOLD,
 ) -> Tuple[Dict[GraphId, Optional[ShardedValue]], List[Diagnostic],
            Dict[NodeId, int]]:
@@ -267,9 +268,23 @@ def sharding_pass(
     Returns ``(shardings, diagnostics, boundary_costs)`` where
     ``boundary_costs[vid]`` is the priced bytes of collective traffic
     the placement implies at that stage's boundary (KP601 all-to-all,
-    KP603 all-gather). Pure spec arithmetic — zero device work."""
+    KP603 all-gather), priced through the shared
+    `parallel.mesh.collective_cost` formula. Pure spec arithmetic —
+    zero device work.
+
+    ``plan`` is the sharding planner's chosen assignment
+    (`analysis.planner.plan_sharding`): a vid → `ShardedValue` map that
+    REPLACES default propagation and declarative rules on the vids it
+    covers. Planned placements are the placement *decision*, not an
+    adversarial pin, so deviating from what propagation would have
+    chosen is not an implicit reshard (the planner already priced and
+    enforces those moves explicitly); demand checks (KP601), host
+    gathers (KP603), replication (KP602), and divisibility (KP604)
+    still lint the planned placement — a plan that violates an operator
+    demand fails loudly here."""
     mesh = mesh or meshlib.current_mesh()
     rules = _as_rules(rules)
+    plan = plan or {}
     order, _ = toposort(graph)
     shardings: Dict[GraphId, Optional[ShardedValue]] = {}
     diags: List[Diagnostic] = []
@@ -283,7 +298,8 @@ def sharding_pass(
 
     for vid in order:
         if isinstance(vid, SourceId):
-            shardings[vid] = seed_sharding(specs.get(vid), mesh)
+            shardings[vid] = plan.get(vid) or seed_sharding(
+                specs.get(vid), mesh)
             continue
         if isinstance(vid, SinkId):
             shardings[vid] = shardings.get(graph.get_sink_dependency(vid))
@@ -351,17 +367,53 @@ def sharding_pass(
                         and dep_sv.max_shards(mesh) > 1
                     )
                     if bad:
-                        moved = dep_spec.nbytes
-                        add_cost(vid, moved)
+                        # meeting a replication demand is an all-gather
+                        # of the whole value; a sharding demand is an
+                        # all-to-all between layouts
+                        if demand == DEMAND_REPLICATED:
+                            cost = meshlib.collective_cost(
+                                "all_gather", dep_spec.nbytes,
+                                shards=dep_sv.max_shards(mesh), mesh=mesh)
+                        else:
+                            cost = meshlib.collective_cost(
+                                "all_to_all", dep_spec.nbytes,
+                                shards=max(dep_sv.max_shards(mesh),
+                                           data_shards),
+                                mesh=mesh)
+                        add_cost(vid, cost.bytes_moved)
                         diags.append(Diagnostic(
                             "KP601", Severity.WARNING,
                             f"implicit reshard: dependency {i} "
                             f"({_label(graph, deps[i])}@{deps[i]}) arrives "
                             f"as {spec_str(dep_sv)} but this stage demands "
-                            f"a {demand} layout — XLA inserts an "
-                            f"all-to-all of ≈{_fmt_bytes(moved)} at this "
-                            "boundary",
+                            f"a {demand} layout — XLA inserts "
+                            f"{'an all-gather' if cost.kind == 'all_gather' else 'an all-to-all'} "
+                            f"of ≈{_fmt_bytes(cost.bytes_moved)} "
+                            "at this boundary",
                             vertex=vid, label=label))
+
+        # ---- planner assignment: the chosen placement IS the decision.
+        # It replaces both the default rule and declarative pins on the
+        # vids it covers (the planner already priced its deviations and
+        # enforces them explicitly — with_sharding_constraint / reshard
+        # — so they are not *implicit* reshards); everything below
+        # (KP602/KP603/KP604, demand checks above) still lints it.
+        planned = plan.get(vid)
+        if planned is not None and isinstance(out_spec, DataSpec) \
+                and is_known(out_spec.element) and out_spec.on_device:
+            problem = _sharded_value_problem(planned, out_spec, mesh)
+            if problem is not None:
+                diags.append(Diagnostic(
+                    "KP605", Severity.ERROR,
+                    f"planner assignment {spec_str(planned)} on this "
+                    f"stage but {problem}; the assignment is ignored "
+                    "here",
+                    vertex=vid, label=label))
+                planned = None
+        else:
+            planned = None
+        if planned is not None:
+            assigned = planned
 
         # ---- default rule when neither hook nor rule decided the output
         if assigned is None:
@@ -373,8 +425,8 @@ def sharding_pass(
         # seed_sharding/_default_out_sharding): pinning a device spec on
         # one would divide per-device bytes by shards that don't exist
         # and fabricate KP603 all-gathers downstream.
-        if isinstance(out_spec, DataSpec) and is_known(out_spec.element) \
-                and out_spec.on_device:
+        if planned is None and isinstance(out_spec, DataSpec) \
+                and is_known(out_spec.element) and out_spec.on_device:
             for rule in rules:
                 if not rule.matches(label, anchor):
                     continue
@@ -396,14 +448,19 @@ def sharding_pass(
                     kind=out_spec.kind)
                 if assigned is not None and not _same_placement(
                         assigned, pinned, mesh):
-                    moved = out_spec.nbytes
-                    add_cost(vid, moved)
+                    cost = meshlib.collective_cost(
+                        "all_to_all", out_spec.nbytes,
+                        shards=max(assigned.max_shards(mesh),
+                                   pinned.max_shards(mesh),
+                                   data_shards),
+                        mesh=mesh)
+                    add_cost(vid, cost.bytes_moved)
                     diags.append(Diagnostic(
                         "KP601", Severity.WARNING,
                         f"implicit reshard: propagation gives this stage "
                         f"{spec_str(assigned)} but partition rule "
                         f"{rule.pattern!r} pins {spec_str(pinned)} — the "
-                        f"boundary moves ≈{_fmt_bytes(moved)} "
+                        f"boundary moves ≈{_fmt_bytes(cost.bytes_moved)} "
                         "(all-to-all) to honor the rule",
                         vertex=vid, label=label))
                 assigned = pinned
@@ -418,13 +475,16 @@ def sharding_pass(
                 if dep_sv is None or not isinstance(dep_spec, DataSpec):
                     continue
                 if dep_sv.max_shards(mesh) > 1 and dep_spec.nbytes:
-                    gathered += dep_spec.nbytes
+                    cost = meshlib.collective_cost(
+                        "all_gather", dep_spec.nbytes,
+                        shards=dep_sv.max_shards(mesh), mesh=mesh)
+                    gathered += cost.bytes_moved
                     diags.append(Diagnostic(
                         "KP603", Severity.WARNING,
                         f"host-code stage consumes device-sharded "
                         f"{_label(graph, d)}@{d} ({spec_str(dep_sv)}): "
                         f"every shard all-gathers to the host "
-                        f"(≈{_fmt_bytes(dep_spec.nbytes)}); keep the "
+                        f"(≈{_fmt_bytes(cost.bytes_moved)}); keep the "
                         "stage on device or reshard explicitly",
                         vertex=vid, label=label))
             add_cost(vid, gathered)

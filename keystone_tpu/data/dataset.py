@@ -206,6 +206,20 @@ class Dataset:
         return Dataset(data, count=count if count is not None else self.count,
                        mesh=self.mesh, _placed=True)
 
+    def reshard(self, spec) -> "Dataset":
+        """New Dataset with every leaf moved to ``spec`` (a batch-level
+        `PartitionSpec`; entries beyond a leaf's rank are trimmed) via
+        `parallel.collectives.reshard` — the explicit spelling of a
+        placement decision, used by the sharding planner to seed plan
+        inputs from the chosen plan instead of the static default.
+        Leaves already laid out as ``spec`` are returned as-is (the
+        identity short-circuit), so resharding to the current placement
+        builds no program and moves nothing."""
+        from ..parallel.collectives import reshard_tree
+
+        return Dataset(reshard_tree(self.data, spec, mesh=self.mesh),
+                       count=self.count, mesh=self.mesh, _placed=True)
+
     def cache(self) -> "Dataset":
         """Device arrays are already materialized (≈ `.cache()` + action).
         NOT a timing fence — production Cacher nodes call this on every
@@ -286,10 +300,25 @@ class HostDataset:
         idx = np.linspace(0, len(self.items) - 1, num=m, dtype=np.int64)
         return HostDataset([self.items[i] for i in idx])
 
-    def stack(self, dtype=None, mesh=None) -> Dataset:
-        """Stack fixed-shape items into a device `Dataset`."""
+    def stack(self, dtype=None, mesh=None, spec=None) -> Dataset:
+        """Stack fixed-shape items into a device `Dataset`. ``spec``
+        overrides the static `leaf_sharding` default at this
+        host→device seam with an explicit batch-level `PartitionSpec`
+        (the sharding planner's chosen placement for the stacked
+        value). The host array is padded and placed DIRECTLY into the
+        requested layout (one `collectives.reshard` device_put from
+        host) — never staged through the default placement first."""
+        from ..parallel.collectives import reshard_tree
+
         arr = np.stack([np.asarray(x, dtype=dtype) for x in self.items])
-        return Dataset(arr, mesh=mesh)
+        if spec is None:
+            return Dataset(arr, mesh=mesh)
+        mesh = mesh or meshlib.current_mesh()
+        count = arr.shape[0]
+        shards = mesh.shape.get(meshlib.DATA_AXIS, 1)
+        padded = -(-count // shards) * shards if count else shards
+        placed = reshard_tree(_pad_to(arr, padded), spec, mesh=mesh)
+        return Dataset(placed, count=count, mesh=mesh, _placed=True)
 
     def numpy(self):
         return self.items

@@ -164,15 +164,20 @@ EXAMPLES: Dict[str, Callable] = {
 def _plan_context(plan: str):
     """(optimizer, overlap_on, concurrent_on, megafusion_on) for a
     named plan. ``optimized`` pins megafusion OFF so it remains the
-    PR-4/5 plan bit for bit; ``megafused`` is the library default."""
+    PR-4/5 plan bit for bit; the three historical baselines also pin
+    the sharding planner OFF (it post-dates them — PR 9); ``megafused``
+    is the library default, planner included."""
     from .workflow.optimizer import DefaultOptimizer
 
     if plan == "serial_unfused":
-        return DefaultOptimizer(fuse=False), False, False, False
+        return DefaultOptimizer(fuse=False, sharding_planner=False), \
+            False, False, False
     if plan == "legacy":
-        return DefaultOptimizer(fuse_apply=False), True, False, False
+        return DefaultOptimizer(fuse_apply=False, sharding_planner=False), \
+            True, False, False
     if plan == "optimized":
-        return DefaultOptimizer(megafuse=False), True, True, False
+        return DefaultOptimizer(megafuse=False, sharding_planner=False), \
+            True, True, False
     if plan == "megafused":
         return DefaultOptimizer(), True, True, True
     raise ValueError(f"unknown plan {plan!r}; expected one of {PLANS}")

@@ -307,6 +307,16 @@ class FusedBatchTransformer(Transformer):
     #: optimizer passes (or hand-fused example featurizers) can extend it
     fusable = True
 
+    #: the sharding planner's chosen output placement (a batch-level
+    #: `PartitionSpec`), set by `ShardingPlannerRule` on a tagged copy
+    #: when the plan deviates from the default: `_build_program` lowers
+    #: it into a `with_sharding_constraint` on the program output and
+    #: the program cache keys on it, so the chosen layout is baked into
+    #: the compiled executable (and never collides with the unplanned
+    #: form's cache entry). None (the default) compiles exactly the
+    #: PR-8 program.
+    planned_out_spec = None
+
     def __init__(self, stages: Sequence[Transformer], microbatch: int = 2048):
         self.stages = list(stages)
         self.microbatch = microbatch
@@ -369,6 +379,7 @@ class FusedBatchTransformer(Transformer):
             n_shards,
             min(self.microbatch, padded_count // n_shards),
             mesh,
+            self.planned_out_spec,
         )
 
     def _program_cache(self, statics):
@@ -533,6 +544,20 @@ class FusedBatchTransformer(Transformer):
                 )
         else:
             fn = per_shard
+        planned = self.planned_out_spec
+        if planned is not None:
+            # the sharding planner's chosen output placement, enforced
+            # IN the program: the constraint is part of the traced
+            # computation, so the jaxpr carries it, AOT warmup lowers
+            # it, and the executable's output lands in the planned
+            # layout with no separate reshard dispatch
+            inner_fn = fn
+
+            def fn(flat_params, xs, ms):
+                ys = inner_fn(flat_params, xs, ms)
+                return jax.lax.with_sharding_constraint(
+                    ys, NamedSharding(mesh, planned))
+
         # every caller stores the result in a program cache keyed on the
         # chain's structure (_PROGRAM_CACHE / _instance_programs), so
         # this fresh closure compiles once per key, not once per call

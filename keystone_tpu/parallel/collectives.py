@@ -191,3 +191,24 @@ def reshard(x, spec: P, mesh=None):
         except (TypeError, ValueError):
             pass  # cross-mesh / exotic shardings: fall through and move
     return jax.device_put(x, target)
+
+
+def reshard_tree(tree, spec: P, mesh=None):
+    """`reshard` over a pytree: move every array leaf to ``spec``,
+    trimming trailing spec entries that exceed a leaf's rank (a
+    batch-level P('data', 'model') applied to a 1-D mask keeps only its
+    leading entry). The host↔device seam spelling of a planner
+    placement: seeding a `Dataset` from a chosen plan is one
+    `reshard_tree` call, and leaves already laid out correctly move
+    nothing (the identity short-circuit above)."""
+    mesh = mesh or meshlib.current_mesh()
+    entries = tuple(spec) if spec is not None else ()
+
+    def one(x):
+        ndim = getattr(x, "ndim", None)
+        if ndim is None:
+            return x
+        leaf_spec = P(*entries[:ndim])
+        return reshard(x, leaf_spec, mesh=mesh)
+
+    return jax.tree_util.tree_map(one, tree)

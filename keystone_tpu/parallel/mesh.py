@@ -20,6 +20,7 @@ backend').
 from __future__ import annotations
 
 from contextlib import contextmanager
+from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -174,6 +175,71 @@ def specs_equal(a, b) -> bool:
         return tuple(tuple(e) if isinstance(e, list) else e for e in entries)
 
     return norm(a) == norm(b)
+
+
+# ---------------------------------------------------------- collective cost
+#
+# ONE pricing function for boundary collectives, shared by the static
+# sharding linter (analysis/sharding.py KP601/KP603) and the sharding
+# planner (analysis/planner.py): lint prices and planner scores derive
+# from the same formula and the same calibrated ICI rate, so the two can
+# never diverge. `nbytes` is the full (fleet-wide) size of the value
+# being moved; `shards` how many ways its current layout splits it.
+
+
+@dataclass(frozen=True)
+class CollectiveCost:
+    """Priced boundary movement: ``bytes_moved`` is the fabric traffic
+    the collective implies (the number the KP6xx lints report and the
+    planner minimizes); ``seconds`` converts it through the calibrated
+    ICI ``network_weight`` (nodes/learning/cost_model.py — measured
+    calibration when present and platform-matched, analytic v5e rate
+    otherwise), the same seconds-per-all-reduced-byte rate the solver
+    cost models use."""
+
+    kind: str
+    bytes_moved: int
+    seconds: float
+
+
+def _network_weight() -> float:
+    # lazy: cost_model resolves calibration on first access and must not
+    # be imported at mesh-module import time (parallel is a low layer)
+    from ..nodes.learning import cost_model
+
+    return float(cost_model.NETWORK_WEIGHT)
+
+
+def collective_cost(kind: str, nbytes: Optional[int], shards: int = 0,
+                    mesh: Optional[Mesh] = None) -> CollectiveCost:
+    """Price one boundary collective over ``mesh``.
+
+    kinds:
+      - ``"all_to_all"`` — a reshard between two sharded layouts: each
+        device keeps 1/shards of its data and exchanges the rest, so the
+        fabric moves ``nbytes·(shards-1)/shards``.
+      - ``"all_gather"`` — every shard of a sharded value is collected
+        in one place (a host pull, or full replication): the whole value
+        crosses the boundary.
+      - ``"broadcast"`` — a replicated value is (re)distributed to every
+        other device: ``nbytes·(shards-1)/shards`` leaves the source.
+
+    ``shards`` defaults to the mesh's device count; ``shards <= 1`` (or
+    unknown ``nbytes``) prices to zero — moving a value that lives whole
+    on one device is not a collective."""
+    mesh = mesh or current_mesh()
+    if not shards:
+        shards = int(mesh.devices.size)
+    if not nbytes or shards <= 1:
+        return CollectiveCost(kind, 0, 0.0)
+    nbytes = int(nbytes)
+    if kind == "all_gather":
+        moved = nbytes
+    elif kind in ("all_to_all", "broadcast"):
+        moved = (nbytes * (shards - 1)) // shards
+    else:
+        raise ValueError(f"unknown collective kind {kind!r}")
+    return CollectiveCost(kind, moved, moved * _network_weight())
 
 
 def shard_leading_axis(x, mesh: Optional[Mesh] = None):

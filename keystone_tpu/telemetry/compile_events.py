@@ -70,6 +70,13 @@ def _on_duration(event: str, duration: float, **kwargs) -> None:
         histogram("compile.warm_secs").observe(duration)
     else:
         counter("dispatch.programs_compiled").inc()
+        from .instrument import process_dim
+
+        dim = process_dim()
+        if dim is not None:
+            # multi-host: every process compiles its own executables, so
+            # pod-level compile accounting carries a per-process axis
+            counter(f"dispatch.programs_compiled.{dim}").inc()
         histogram("compile.cold_secs").observe(duration)
     tracer = current_tracer()
     if tracer is not None:

@@ -131,12 +131,35 @@ def aggregate_spans(
     return agg
 
 
+def _per_process_counts(counters: Dict[str, Any], base: str) -> str:
+    """``" ; per-process: p0=12 p1=11"`` when the trace carries a
+    multi-host breakdown of ``base`` (``<base>.p<i>`` counters — see
+    `instrument.process_dim`), empty otherwise."""
+    prefix = base + ".p"
+    rows = [(name[len(base) + 1:], v.get("value", 0))
+            for name, v in counters.items() if name.startswith(prefix)]
+    if not rows:
+        return ""
+
+    def idx(dim: str):
+        # numeric process order (p10 after p2, not lexicographic)
+        try:
+            return (0, int(dim[1:]))
+        except ValueError:
+            return (1, 0)
+
+    rows.sort(key=lambda r: (idx(r[0]), r[0]))
+    return " ; per-process: " + " ".join(
+        f"{dim}={int(v)}" for dim, v in rows)
+
+
 def dispatch_summary(trace: Dict[str, Any]) -> Optional[str]:
     """One-line per-run dispatch digest from a trace's metrics snapshot
-    (programs executed, node forces, concurrent-scheduler activity), or
-    None when the trace predates the dispatch counters. Shared by the
-    trace CLI and `scripts/perf_table.py` so the two reports cannot
-    drift."""
+    (programs executed, node forces, concurrent-scheduler activity —
+    plus the per-process program counts when the trace came from a
+    multi-host mesh), or None when the trace predates the dispatch
+    counters. Shared by the trace CLI and `scripts/perf_table.py` so
+    the two reports cannot drift."""
     counters = trace.get("keystone", {}).get("metrics", {}).get("counters", {})
     programs = counters.get("dispatch.programs_executed", {}).get("value")
     if not programs:
@@ -152,6 +175,7 @@ def dispatch_summary(trace: Dict[str, Any]) -> Optional[str]:
         trips = counters.get("megafusion.scan_trips", {}).get("value", 0)
         line += (f"; megafused: {int(mega)} program(s), "
                  f"{int(trips)} in-program scan trip(s)")
+    line += _per_process_counts(counters, "dispatch.programs_executed")
     return line
 
 
@@ -194,7 +218,8 @@ def compile_summary(trace: Dict[str, Any]) -> Optional[str]:
     cold_s = hists.get("compile.cold_secs", {}).get("total", 0.0)
     warm_s = hists.get("compile.warm_secs", {}).get("total", 0.0)
     return (f"programs compiled: {cold_n} cold ({cold_s:.3f}s) + "
-            f"{hits} cache hit(s) ({warm_s:.3f}s retrieval)")
+            f"{hits} cache hit(s) ({warm_s:.3f}s retrieval)"
+            + _per_process_counts(counters, "dispatch.programs_compiled"))
 
 
 def _fmt_bytes(n: float) -> str:

@@ -35,6 +35,32 @@ from .metrics import counter, gauge
 from .spans import current_tracer
 
 
+#: cached per-process metric dimension: "" on single-process jobs (no
+#: extra counter), "p<index>" under a multi-host mesh, None = unresolved.
+#: Tests reset this to None to re-probe after monkeypatching.
+_proc_dim_cache: Optional[str] = None
+
+
+def process_dim() -> Optional[str]:
+    """The per-process dispatch/compile accounting dimension: ``p<i>``
+    when this is process ``i`` of a multi-host job, None on single-host
+    jobs (where a second counter would just duplicate the total).
+    Resolved once — `jax.process_index()` is constant for the life of a
+    process — and never initializes a backend that isn't already the
+    caller's problem (dispatch implies an initialized backend)."""
+    global _proc_dim_cache
+    if _proc_dim_cache is None:
+        try:
+            import jax
+
+            _proc_dim_cache = (
+                f"p{jax.process_index()}" if jax.process_count() > 1
+                else "")
+        except Exception:
+            _proc_dim_cache = ""
+    return _proc_dim_cache or None
+
+
 def record_dispatch(n: int = 1) -> None:
     """Count ``n`` executed XLA programs against
     ``dispatch.programs_executed`` — THE per-run dispatch budget the
@@ -49,8 +75,17 @@ def record_dispatch(n: int = 1) -> None:
     chunk dispatch, and the node-level module jits that bypass
     `map_batches` (scalers, label indicators, random features, normal
     equations). Always on (not gated on tracing): the `dispatch_count`
-    bench tier and the scheduler tests read the counter directly."""
+    bench tier and the scheduler tests read the counter directly.
+
+    Under a multi-host mesh each count also lands on
+    ``dispatch.programs_executed.p<i>`` — every host dispatches its own
+    SPMD program launches, so a pod-level trace must say which process
+    executed what (the telemetry CLI's dispatch summary and
+    ``perf_table.py --trace`` render the per-process breakdown)."""
     counter("dispatch.programs_executed").inc(n)
+    dim = process_dim()
+    if dim is not None:
+        counter(f"dispatch.programs_executed.{dim}").inc(n)
 
 
 def estimate_bytes(value) -> float:

@@ -118,6 +118,19 @@ class ExecutionConfig:
     dispatching per chunk. Ineligible plans (streaming single-consumer
     stages, host-code stages, fan-out) keep the per-program dispatch
     path and `validate()` says why (KP401).
+
+    ``sharding_planner`` (default on; env ``KEYSTONE_SHARDING_PLANNER=0``
+    reverts to the PR-8 plan bit-for-bit) turns on the sharding-aware
+    plan optimizer: after fusion/megafusion, `ShardingPlannerRule`
+    enumerates legal per-stage placements (data-sharded, model-sharded,
+    2-D data×model, replicated), prices each assignment with the KP6xx
+    boundary-collective cost model under the KP600 per-device budget
+    (`analysis.planner`), and — only when the chosen assignment
+    strictly beats the default placement's priced boundary bytes —
+    enforces it: ``with_sharding_constraint`` on fused/megafused
+    program outputs, explicit `collectives.reshard` of plan-input
+    datasets. A 1-device mesh, an unimproved plan, or a planner failure
+    all leave the plan untouched.
     """
 
     overlap: bool = True
@@ -131,6 +144,7 @@ class ExecutionConfig:
     aot_warmup: bool = True
     compile_cache_dir: Optional[str] = None
     megafusion: bool = True
+    sharding_planner: bool = True
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -233,6 +247,8 @@ def execution_config() -> ExecutionConfig:
             compile_cache_dir=_env_compile_cache_dir(),
             megafusion=os.environ.get("KEYSTONE_MEGAFUSION", "1").lower()
             not in _OFF,
+            sharding_planner=os.environ.get(
+                "KEYSTONE_SHARDING_PLANNER", "1").lower() not in _OFF,
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

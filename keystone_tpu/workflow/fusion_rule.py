@@ -106,6 +106,12 @@ class FusedChainOperator(Operator):
     #: different compiled form)
     _label_prefix = "Fused"
 
+    #: the sharding planner's chosen output placement (set by
+    #: `ShardingPlannerRule` on a tagged copy); `materialize` hands it
+    #: to the built fused transformer, whose program builder lowers it
+    #: into a with_sharding_constraint on the program output
+    planned_out_spec = None
+
     def _fused_cls(self):
         from ..nodes.util.fusion import FusedBatchTransformer
 
@@ -129,7 +135,10 @@ class FusedChainOperator(Operator):
         stages = [fitted[s.index] if isinstance(s, _FitSlot) else s
                   for s in self.stage_specs]
         if all(getattr(s, "fusable", False) for s in stages):
-            return self._fused_cls()(stages, microbatch=self.microbatch)
+            fused = self._fused_cls()(stages, microbatch=self.microbatch)
+            if self.planned_out_spec is not None:
+                fused.planned_out_spec = self.planned_out_spec
+            return fused
         return TransformerChain(stages)
 
     def abstract_eval(self, in_specs: List) -> object:

@@ -243,6 +243,125 @@ class NodeOptimizationRule(Rule):
         return graph, prefixes
 
 
+class ShardingPlannerRule(Rule):
+    """Sharding-aware plan optimizer: choose, price, and ENFORCE
+    per-stage placement as an optimizer decision (`analysis.planner` is
+    the pure decision core; this rule is the enforcement shell).
+
+    Runs after fusion/megafusion so the placement decision sees the
+    program boundaries that will actually execute. Reads
+    `ExecutionConfig.sharding_planner` (env ``KEYSTONE_SHARDING_PLANNER``,
+    default on) at optimization time and is a strict no-op on 1-device
+    meshes, on unbound/abstract graphs, when the planner cannot beat the
+    PR-8 default placement's priced boundary bytes, and on any planner
+    failure — so the kill switch (and every no-win case) reproduces the
+    PR-8 plan bit-for-bit.
+
+    Enforcement of a winning assignment:
+
+      - fused / megafused program operators (`FusedChainOperator`,
+        `FusedBatchTransformer`) whose chosen output placement deviates
+        from the default are replaced with tagged copies carrying
+        ``planned_out_spec``; the program builder lowers that into a
+        ``jax.lax.with_sharding_constraint`` on the program output (and
+        keys the program cache on it), so the chosen layout is baked
+        into the compiled XLA program;
+      - plan-input `DatasetOperator`s are re-seeded: the dataset is
+        moved to the chosen placement through `collectives.reshard`
+        (identity short-circuit — an unchanged placement moves
+        nothing), so execution starts from the planned layout instead
+        of the static default.
+
+    Operators are copied, never mutated in place: shared instances
+    reused across pipelines must not carry one plan's placement into
+    another's.
+    """
+
+    def apply(self, plan: Plan) -> Plan:
+        from .env import execution_config
+
+        cfg = execution_config()
+        if not cfg.sharding_planner:
+            return plan  # kill switch: the PR-8 plan, bit for bit
+        from ..parallel import mesh as meshlib
+
+        mesh = meshlib.current_mesh()
+        if int(mesh.devices.size) <= 1:
+            return plan
+        from ..telemetry import counter, span
+
+        graph, prefixes = plan
+        if not self._has_device_dataset(graph):
+            # nothing to place: the planner decides DATASET placement,
+            # and a datum/host-only plan has no device data boundary.
+            # Skipping also keeps the single-datum serving path free of
+            # the planner's abstract traces (spec_pass runs user apply
+            # bodies under eval_shape).
+            return plan
+        with span("sharding_planner", cat="phase",
+                  devices=int(mesh.devices.size)):
+            try:
+                from ..analysis.planner import plan_sharding
+                from ..analysis.propagate import spec_pass
+
+                specs, _ = spec_pass(graph, {})
+                splan = plan_sharding(
+                    graph, specs, mesh=mesh,
+                    hbm_budget_bytes=cfg.hbm_budget_bytes)
+            except Exception:
+                logger.debug("sharding planner failed; plan unchanged",
+                             exc_info=True)
+                return plan
+            if splan is None or not splan.improved:
+                return plan
+            counter("planner.boundary_bytes_saved").inc(splan.savings_bytes)
+            counter("planner.plans_enforced").inc()
+            logger.info(
+                "ShardingPlannerRule: enforcing plan, boundary bytes "
+                "%d -> %d (%d saved)", int(splan.default_cost_bytes),
+                int(splan.planned_cost_bytes), splan.savings_bytes)
+            graph = self._enforce(graph, splan, mesh)
+        return graph, prefixes
+
+    @staticmethod
+    def _has_device_dataset(graph: Graph) -> bool:
+        for vid in graph.operators:
+            op = graph.get_operator(vid)
+            if isinstance(op, DatasetOperator) \
+                    and getattr(op.dataset, "data", None) is not None:
+                return True
+        return False
+
+    @staticmethod
+    def _enforce(graph: Graph, splan, mesh) -> Graph:
+        import copy
+
+        from ..nodes.util.fusion import FusedBatchTransformer
+        from .fusion_rule import FusedChainOperator
+
+        for vid in splan.changed_vertices():
+            if vid not in getattr(graph, "operators", {}):
+                continue
+            op = graph.get_operator(vid)
+            spec = splan.spec_for(vid)
+            if spec is None:
+                continue
+            if isinstance(op, (FusedChainOperator, FusedBatchTransformer)):
+                tagged = copy.copy(op)
+                tagged.planned_out_spec = spec
+                graph = graph.set_operator(vid, tagged)
+            elif isinstance(op, DatasetOperator) \
+                    and hasattr(op.dataset, "reshard"):
+                try:
+                    reseeded = op.dataset.reshard(spec)
+                except Exception:
+                    continue  # placement stays default; the plan's
+                    # other enforcement points still apply
+                graph = graph.set_operator(
+                    vid, DatasetOperator(reseeded, name=op.name))
+        return graph
+
+
 class Optimizer(RuleExecutor):
     pass
 
@@ -254,7 +373,7 @@ class DefaultOptimizer(Optimizer):
 
     def __init__(self, samples_per_shard: int = 3, fuse: bool = True,
                  fusion_microbatch: int = 2048, fuse_apply: bool = True,
-                 megafuse: bool = True):
+                 megafuse: bool = True, sharding_planner: bool = True):
         from .fusion_rule import MegafusionRule, NodeFusionRule
 
         self._batches = [
@@ -279,6 +398,14 @@ class DefaultOptimizer(Optimizer):
                 # (KEYSTONE_MEGAFUSION) at optimization time.
                 fuse_rules.append(MegafusionRule(fusion_microbatch))
             self._batches.append(Batch("fuse", fuse_rules))
+        if sharding_planner:
+            # placement rides AFTER megafusion: the planner must see the
+            # program boundaries that will actually execute. Gated twice
+            # like megafusion: the constructor flag builds the PR-8
+            # optimizer exactly, and the rule reads
+            # `ExecutionConfig.sharding_planner`
+            # (KEYSTONE_SHARDING_PLANNER) at optimization time.
+            self._batches.append(Batch("place", [ShardingPlannerRule()]))
         self._batches.append(Batch("node-opt", [NodeOptimizationRule(samples_per_shard)]))
 
     @property

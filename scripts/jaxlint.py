@@ -81,6 +81,16 @@ system cannot (see ANALYSIS.md for the full catalog):
          explicit sharding/device argument (defaults to device 0,
          silently un-sharding whatever flows through a mesh hot path).
 
+  KJ010  output-layout-leak (under ``workflow/`` and ``nodes/``): a
+         ``jax.jit``/``pjit`` call passing ``in_shardings`` but
+         omitting ``out_shardings``. Pinning only the input layout
+         leaves the OUTPUT layout to XLA's partitioner — the caller
+         gets whatever placement compilation happened to pick, and the
+         next stage pays an unpriced reshard to recover the layout the
+         plan expected (exactly the implicit boundary move KP601 lints
+         and the sharding planner prices). A jit that constrains its
+         inputs must say where its outputs land.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -124,6 +134,10 @@ RULES = {
              "jax.device_put without an explicit sharding in a "
              "parallel-adjacent hot path (placement must be deliberate "
              "on a mesh)",
+    "KJ010": "jax.jit/pjit with in_shardings but no out_shardings: the "
+             "output layout leaks to XLA's partitioner and the caller "
+             "re-shards downstream (declare out_shardings so the "
+             "boundary layout is a decision, not an accident)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -774,6 +788,35 @@ def _check_bare_device_put(tree: ast.AST, path: str) -> Iterator[Finding]:
             "sharding (NamedSharding / data.dataset.leaf_sharding)")
 
 
+def _check_output_layout_leak(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ010 (under ``workflow/``/``nodes/``): a ``jax.jit``/``pjit``
+    call with an ``in_shardings=`` keyword but no ``out_shardings=``.
+    Half-constrained jits hand the output layout to XLA's partitioner:
+    whatever placement compilation picks, the caller inherits — and the
+    next stage boundary pays an implicit reshard to get back to the
+    layout the plan expected. A call deliberate enough to pin its input
+    layout must pin (or explicitly delegate) its output layout too."""
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        func = call.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name not in {"jit", "pjit"}:
+            continue
+        kwargs = {kw.arg for kw in call.keywords}
+        if "in_shardings" in kwargs and "out_shardings" not in kwargs:
+            yield Finding(
+                path, call.lineno, "KJ010",
+                f"`{name}(...)` passes in_shardings but no out_shardings; "
+                "the output layout leaks to XLA's partitioner and "
+                "downstream consumers re-shard implicitly — declare "
+                "out_shardings")
+
+
 def _attr_name(node: ast.AST) -> str:
     names = []
     while isinstance(node, (ast.Attribute, ast.Subscript)):
@@ -824,6 +867,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_scan_carry_realloc(tree, rel))
         findings.extend(_check_hot_path_state_write(tree, rel))
         findings.extend(_check_axis_literals(tree, rel))
+        findings.extend(_check_output_layout_leak(tree, rel))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
 

@@ -49,9 +49,43 @@ print(f"sharding audit: {len(examples)} example(s), {stages} stage rows, "
       "0 KP6xx findings OK")
 PY
 
+echo "== planner audit (chosen vs default placement over every example, 2x4 mesh) =="
+# The sharding planner's decision gate: on an 8-device CPU mesh arranged
+# 2 (data) x 4 (model), run the planner over every analyzable() example
+# and assert (1) the chosen placement's priced boundary bytes never
+# exceed the default placement's, (2) the planner strictly wins on at
+# least 2 examples, and (3) zero unsuppressed KP6xx findings UNDER the
+# chosen plan — the decided placement is clean, not just the default.
+PLANNER_JSON="$(mktemp /tmp/keystone_planner_audit.XXXXXX.json)"
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON"' EXIT
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m keystone_tpu.analysis --explain-sharding --plan --mesh-shape 2x4 \
+    --json > "$PLANNER_JSON"
+python - "$PLANNER_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+assert payload["devices"] == 8, payload["devices"]
+examples = payload["examples"]
+assert len(examples) >= 7, [e["example"] for e in examples]
+strict = 0
+for e in examples:
+    assert "build_error" not in e, e
+    assert e["findings"] == [], (e["example"], e["findings"])
+    planner = e.get("planner")
+    if planner is None:
+        continue  # nothing to decide (host-only pipeline)
+    assert planner["planned_cost_bytes"] <= planner["default_cost_bytes"], e
+    if planner["planned_cost_bytes"] < planner["default_cost_bytes"]:
+        strict += 1
+assert strict >= 2, f"planner strictly beat the default on only {strict} example(s)"
+saved = sum((e.get("planner") or {}).get("savings_bytes", 0) for e in examples)
+print(f"planner audit: {len(examples)} example(s), strict wins on {strict}, "
+      f"{saved:,} boundary bytes saved, 0 KP6xx under chosen plans OK")
+PY
+
 echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
 TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_SMOKE_TRACE="$TRACE_TMP" python - <<'PY'
 import json, os
 import numpy as np
@@ -75,7 +109,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
 
 echo "== dispatch smoke (example pipeline under the concurrent scheduler) =="
 DISPATCH_TRACE="$(mktemp /tmp/keystone_dispatch_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_TRACE="$DISPATCH_TRACE" KEYSTONE_CONCURRENT_DISPATCH=1 \
 python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance) run
@@ -107,7 +141,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
 echo "== compile smoke (warm second run performs 0 cold compiles) =="
 COMPILE_CACHE="$(mktemp -d /tmp/keystone_compile_smoke.XXXXXX)"
 COMPILE_TRACE="$(mktemp /tmp/keystone_compile_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$COMPILE_CACHE" \
 KEYSTONE_TRACE="$COMPILE_TRACE" python - <<'PY'
 # One example pipeline run TWICE against a fresh persistent-cache dir
@@ -151,7 +185,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
 echo "== megafusion smoke (1-program apply run; warm repeat stays 0-cold) =="
 MEGA_CACHE="$(mktemp -d /tmp/keystone_mega_smoke.XXXXXX)"
 MEGA_TRACE="$(mktemp /tmp/keystone_mega_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_MEGAFUSION=1 KEYSTONE_COMPILE_CACHE="$MEGA_CACHE" \
 KEYSTONE_TRACE="$MEGA_TRACE" python - <<'PY'
 # One example apply run TWICE under megafusion against a fresh

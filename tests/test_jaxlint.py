@@ -190,6 +190,53 @@ def test_kj009_flags_bare_device_put(tmp_path):
     assert jl.lint_file(elsewhere) == []
 
 
+def test_kj010_flags_in_shardings_without_out_shardings(tmp_path):
+    """KJ010: a jax.jit/pjit call pinning in_shardings but not
+    out_shardings leaks the output layout to XLA's partitioner (the
+    caller re-shards downstream); fully-specified and fully-unspecified
+    jits pass."""
+    jl = _jaxlint()
+    bad = tmp_path / "workflow" / "bad_layout.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax\n"
+        "from jax.experimental.pjit import pjit\n"
+        "\n"
+        "\n"
+        "def build(fn, sh):\n"
+        "    a = jax.jit(fn, in_shardings=(sh,))\n"              # KJ010
+        "    b = pjit(fn, in_shardings=(sh,))\n"                 # KJ010
+        "    c = jax.jit(fn, in_shardings=(sh,), out_shardings=sh)\n"
+        "    d = jax.jit(fn)\n"                                  # ok
+        "    e = jax.jit(fn, donate_argnums=(0,))\n"             # ok
+        "    return a, b, c, d, e\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ010"] * 2
+    assert sorted(f.line for f in findings) == [6, 7]
+
+    # outside nodes/ and workflow/, KJ010 does not apply
+    elsewhere = tmp_path / "scripts" / "ok_layout.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj010_suppression(tmp_path):
+    jl = _jaxlint()
+    src = tmp_path / "nodes" / "suppressed_layout.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "def build(fn, sh):\n"
+        "    return jax.jit(fn, in_shardings=(sh,))"
+        "  # keystone: ignore[KJ010]\n"
+    )
+    assert jl.lint_file(src) == []
+
+
 def test_kj008_flags_self_container_mutator_calls(tmp_path):
     """Review regression: `self.seen.append(x)` in a hot path races
     exactly like `self.seen[k] = x` and must be flagged; mutator calls

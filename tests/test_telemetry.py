@@ -403,3 +403,49 @@ def test_memo_and_prefix_counters_count_reuse():
         ])
         p(data).get()
     assert registry().counter("executor.node_forces").value > 0
+
+
+# --------------------------------------------------- per-process dimension
+
+
+def test_per_process_dispatch_dimension(monkeypatch):
+    """Under a multi-host mesh every dispatch also lands on a
+    per-process counter (dispatch.programs_executed.p<i>), and the
+    shared dispatch/compile summaries render the breakdown; single-host
+    jobs get no duplicate counter."""
+    from keystone_tpu.telemetry import instrument
+
+    # single-host: no per-process counter
+    monkeypatch.setattr(instrument, "_proc_dim_cache", "")
+    before = registry().counter("dispatch.programs_executed").value
+    instrument.record_dispatch()
+    assert registry().counter("dispatch.programs_executed").value == before + 1
+    assert not any(k.startswith("dispatch.programs_executed.p")
+                   for k in registry().counters)
+
+    # simulated process 1 of a multi-host job
+    monkeypatch.setattr(instrument, "_proc_dim_cache", "p1")
+    instrument.record_dispatch(3)
+    assert registry().counter("dispatch.programs_executed.p1").value == 3
+
+    from keystone_tpu.telemetry.export import dispatch_summary
+
+    trace = {"traceEvents": [], "keystone": {"metrics": registry().snapshot()}}
+    line = dispatch_summary(trace)
+    assert line is not None and "per-process: p1=3" in line
+
+
+def test_per_process_compile_summary_breakdown():
+    from keystone_tpu.telemetry.export import compile_summary
+
+    trace = {"traceEvents": [], "keystone": {"metrics": {
+        "counters": {
+            "dispatch.programs_compiled": {"value": 5},
+            "dispatch.programs_compiled.p0": {"value": 3},
+            "dispatch.programs_compiled.p1": {"value": 2},
+            "dispatch.compile_cache_hits": {"value": 0},
+        },
+        "histograms": {},
+    }}}
+    line = compile_summary(trace)
+    assert "5 cold" in line and "per-process: p0=3 p1=2" in line
