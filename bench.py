@@ -248,6 +248,19 @@ def finalize_record(detail):
         rec["error"] = "tier failures: " + "; ".join(
             f"{k}: {e}" for k, e in sorted(tier_errors.items()))
         return rec, False
+    # precision accuracy band: the mixed-precision policy's outputs must
+    # sit inside the declared tolerance band vs the serial unfused f32
+    # reference (dispatch_bench's `precision` plan verdict). A policy
+    # that busts the band is an accuracy regression, not a perf win —
+    # loud error, never the stale-fallback record.
+    dispatch_tier = detail.get("dispatch_count")
+    if isinstance(dispatch_tier, dict) \
+            and dispatch_tier.get("precision_in_band") is False:
+        rec["error"] = (
+            "precision policy busted the declared tolerance band vs the "
+            "serial unfused f32 reference (dispatch_count tier "
+            "precision_in_band=false)")
+        return rec, False
     return rec, detail.get("platform") != "cpu"
 
 

@@ -53,6 +53,13 @@ from .sharding import (
     sharding_pass,
 )
 from .planner import ShardingPlan, plan_sharding
+from .precision import (
+    PrecisionPlan,
+    plan_precision,
+    precision_pass,
+    reprice_memory,
+    shrink_to_band,
+)
 from .specs import (
     UNKNOWN,
     DataSpec,
@@ -190,7 +197,12 @@ __all__ = [
     "operator_effects",
     "memory_pass",
     "per_device_pass",
+    "plan_precision",
     "plan_sharding",
+    "precision_pass",
+    "PrecisionPlan",
+    "reprice_memory",
+    "shrink_to_band",
     "resolve_chunk_rows",
     "sharding_pass",
     "shape_struct",

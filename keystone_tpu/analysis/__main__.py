@@ -9,6 +9,8 @@ registry.
     python -m keystone_tpu.analysis --explain-sharding  # per-stage placement
     python -m keystone_tpu.analysis --explain-sharding --json
     python -m keystone_tpu.analysis --explain-sharding --plan --mesh-shape 2x4
+    python -m keystone_tpu.analysis --explain-precision # per-stage dtype plan
+    python -m keystone_tpu.analysis --explain-precision --json
     python -m keystone_tpu.analysis --list-rules
 
 Exit code 1 if any example produces ERROR-severity findings (or any
@@ -25,6 +27,15 @@ leaf's shard count), and the priced boundary collective cost (KP601
 all-to-all / KP603 all-gather bytes). Run it on a multi-device mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) to see real
 shard counts; a 1-device mesh degenerates to whole-value placement.
+
+``--explain-precision`` runs the mixed-precision policy planner
+(analysis/precision.py) per example: the rendered table shows each
+stage's chosen storage dtype, tolerance (and whether it was declared or
+eval_shape-probed), and the boundary bytes the policy saves; KP7xx
+findings are linted UNDER the chosen policy and the KP2xx memory model
+is re-priced with the decided dtypes (KP703 rows). Exit code 1 on any
+unsuppressed WARNING/ERROR KP7xx finding, or when a chosen policy
+prices WORSE than the all-f32 default.
 
 ``--plan`` (with ``--explain-sharding``) additionally runs the sharding
 planner (analysis/planner.py) per example: the rendered table compares
@@ -231,6 +242,110 @@ def _explain_sharding_main(args) -> int:
     return 1 if failed else 0
 
 
+def _explain_precision_main(args) -> int:
+    """Per-example precision explanation (KP7xx gate): run the
+    mixed-precision planner over each example's raw stage graph, render
+    the per-stage chosen dtype / bytes-saved / tolerance-source table,
+    lint the chosen policy (KP701/KP702), and re-price the KP2xx memory
+    model under the decided dtypes (KP703 rows). Fails on any
+    WARNING/ERROR KP7xx finding — the decided dtypes are proven clean,
+    not just the reference. (``planned ≤ default`` is an invariant of
+    ``plan_precision`` — it clamps to the all-f32 default on any
+    non-strict win — but the gate re-asserts it here so a planner
+    regression fails the audit instead of shipping silently.)"""
+    from . import as_source_spec
+    from .precision import (
+        format_plan,
+        plan_precision,
+        precision_pass,
+        reprice_memory,
+    )
+    from .propagate import spec_pass
+
+    names = args.examples or sorted(EXAMPLES)
+    unknown = [n for n in names if n not in EXAMPLES]
+    if unknown:
+        print(f"unknown example(s): {', '.join(unknown)}; "
+              f"known: {', '.join(sorted(EXAMPLES))}", file=sys.stderr)
+        return 2
+
+    failed = False
+    records = []
+    for name in names:
+        try:
+            pipeline, source_spec = build_example(name)
+            graph = pipeline.graph
+            specs, _ = spec_pass(
+                graph, {pipeline.source: as_source_spec(source_spec)})
+            pplan = plan_precision(graph, specs)
+            diags = []
+            repriced = None
+            if pplan is not None:
+                diags = precision_pass(graph, specs, pplan)
+                est0, est1, kp703 = reprice_memory(graph, specs, pplan)
+                diags.extend(kp703)
+                repriced = {
+                    "peak_bytes_default": int(est0.peak_bytes),
+                    "peak_bytes_planned": int(est1.peak_bytes),
+                }
+            diags = [d for d in diags if d.rule not in set(args.ignore)]
+            gate = [d for d in diags if d.severity >= Severity.WARNING]
+        except Exception as e:  # a factory bug is a failure, not a crash
+            if args.json:
+                records.append({"example": name, "build_error":
+                                f"{type(e).__name__}: {e}"})
+            else:
+                print(f"✗ {name}: failed to build/explain: "
+                      f"{type(e).__name__}: {e}")
+            failed = True
+            continue
+        # invariant re-assertion, not a reachable decision branch:
+        # plan_precision clamps any non-strict win to the all-f32
+        # default, so `over` only fires if that clamp regresses
+        over = (pplan is not None
+                and pplan.planned_cost_bytes > pplan.default_cost_bytes)
+        failed |= bool(gate) or over
+        if args.json:
+            rec = {"example": name, "findings": [
+                {"rule": d.rule, "severity": d.severity.name,
+                 "anchor": d.anchor, "message": d.message}
+                for d in diags
+            ]}
+            if pplan is not None:
+                rec["planner"] = {
+                    "planned_cost_bytes": int(pplan.planned_cost_bytes),
+                    "default_cost_bytes": int(pplan.default_cost_bytes),
+                    "savings_bytes": pplan.savings_bytes,
+                    "improved": pplan.improved,
+                    "changed_stages": len(pplan.changed_vertices()),
+                    "stages": pplan.rows(graph, specs),
+                }
+                if repriced:
+                    rec["planner"]["memory"] = repriced
+            else:
+                rec["planner"] = None  # nothing to decide
+            records.append(rec)
+        else:
+            mark = "✗" if (gate or over) else "✓"
+            if pplan is None:
+                print(f"{mark} {name}: no tolerant float boundary — "
+                      "policy stays all-f32")
+                continue
+            print(f"{mark} {name}: boundary bytes "
+                  f"{int(pplan.default_cost_bytes):,} (f32) → "
+                  f"{int(pplan.planned_cost_bytes):,} (chosen), "
+                  f"{pplan.savings_bytes:,} saved, "
+                  f"{len(pplan.changed_vertices())} stage(s) reduced")
+            print("  " + format_plan(pplan.rows(graph, specs))
+                  .replace("\n", "\n  "))
+            for d in diags:
+                if d.severity >= Severity.WARNING or args.strict:
+                    print(f"    {d}")
+    if args.json:
+        print(json.dumps({"examples": records}, indent=2))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m keystone_tpu.analysis", description=__doc__,
@@ -251,6 +366,13 @@ def main(argv=None) -> int:
                    help="render each example's per-stage partition table "
                         "(spec, per-device bytes, boundary collective "
                         "cost) and fail on any unsuppressed KP6xx finding")
+    p.add_argument("--explain-precision", action="store_true",
+                   help="run the mixed-precision policy planner per "
+                        "example and render the per-stage chosen dtype / "
+                        "bytes-saved / tolerance-source table; fail on "
+                        "any unsuppressed WARNING/ERROR KP7xx finding "
+                        "(planner ≤ all-f32 bytes is re-asserted as an "
+                        "invariant)")
     p.add_argument("--plan", action="store_true",
                    help="with --explain-sharding: run the sharding "
                         "planner per example and render chosen-vs-default "
@@ -275,6 +397,9 @@ def main(argv=None) -> int:
 
     if args.explain_sharding:
         return _explain_sharding_main(args)
+
+    if args.explain_precision:
+        return _explain_precision_main(args)
 
     names = args.examples or sorted(EXAMPLES)
     unknown = [n for n in names if n not in EXAMPLES]

@@ -71,6 +71,16 @@ RULES = {
              "cannot apply to the matched stage — more entries than the "
              "value has dimensions, or a mesh axis the current mesh does "
              "not have",
+    # precision tier (mixed-precision policy pass; see analysis/precision)
+    "KP701": "precision-policy-on-intolerant-stage: a reduced-precision "
+             "policy is pinned on a boundary whose producer or consumer "
+             "declares (or probes) exact f32/HIGHEST precision",
+    "KP702": "cast-thrash: a boundary stores bf16 but every consumer's "
+             "boundary is f32 and the halving saves less than the two "
+             "convert_element_type casts the flip pair costs",
+    "KP703": "dtype-dependent memory re-pricing: a chosen precision "
+             "policy changes a stage's static KP2xx residency (bf16 "
+             "halves the chosen float boundaries) — informational",
     # contract tier (registry-wide operator audit; see analysis/contracts)
     "KP501": "fusable-without-structural-fuse: a fusable stage's fused "
              "program key is id-keyed (opaque), so fused programs "

@@ -82,6 +82,11 @@ def reconcile_trace(trace: Dict[str, Any]) -> Dict[str, Any]:
             "observed_bytes": obs_b,
             "rel_error": rel,
             "spec": (s or {}).get("spec"),
+            # the propagated boundary dtype — uint8/int32 loader stages
+            # and precision-planner bf16 decisions are visible here, so
+            # a dtype-blind estimate can no longer hide behind a byte
+            # count that happens to match
+            "dtype": (s or {}).get("dtype"),
             "static_per_device_bytes": (s or {}).get("per_device_bytes"),
         })
     # nodes with both sides first, largest observation first — the head
@@ -122,8 +127,11 @@ def _fmt(n: Optional[float]) -> str:
 def format_reconciliation(rec: Dict[str, Any], top: int = 20) -> str:
     per_dev = any(r.get("static_per_device_bytes") is not None
                   for r in rec["rows"])
+    dtyped = any(r.get("dtype") is not None for r in rec["rows"])
     lines = ["== static vs observed memory (KP2xx calibration) =="]
     head = f"{'node':<40} {'static':>10} {'observed':>10} {'err %':>8}"
+    if dtyped:
+        head += f" {'dtype':>9}"
     if per_dev:
         head += f" {'per-dev':>10}"
     lines.append(head)
@@ -134,6 +142,8 @@ def format_reconciliation(rec: Dict[str, Any], top: int = 20) -> str:
             f"{r['label'][:40]:<40} {_fmt(r['static_bytes']):>10} "
             f"{_fmt(r['observed_bytes']):>10} {err:>8}"
         )
+        if dtyped:
+            line += f" {(r.get('dtype') or '—')[:9]:>9}"
         if per_dev:
             line += f" {_fmt(r.get('static_per_device_bytes')):>10}"
         lines.append(line)
@@ -143,6 +153,8 @@ def format_reconciliation(rec: Dict[str, Any], top: int = 20) -> str:
         err = f"{100 * pr:+.1f}%" if pr is not None else "—"
         line = (
             f"{'PEAK LIVE SET':<40} {_fmt(sp):>10} {_fmt(op_):>10} {err:>8}")
+        if dtyped:
+            line += f" {'—':>9}"
         if per_dev:
             line += f" {_fmt(rec.get('static_per_device_peak_bytes')):>10}"
         lines.append(line)

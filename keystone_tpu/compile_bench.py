@@ -65,10 +65,10 @@ def _run_example(name: str, ragged_test: bool, plan: str = "megafused"):
     from .telemetry import counter
     from .workflow.env import PipelineEnv, config_override
 
-    optimizer, _, _, megafuse_on = _plan_context(plan)
+    optimizer, _, _, overrides = _plan_context(plan)
     PipelineEnv.reset()
     try:
-        with config_override(megafusion=megafuse_on):
+        with config_override(**overrides):
             PipelineEnv.get().set_optimizer(optimizer)
             predictor, train, test = EXAMPLES[name]()
             if ragged_test:
@@ -215,6 +215,13 @@ def compile_count_report(
         out["plan_breakdown"].append(breakdown_row(
             measure_example_compiles(name, ragged_test=False,
                                      plan="optimized")))
+        # the precision column: the policy-on serving path must stay
+        # warm — 0 cold compiles with the bf16 casts baked in (the
+        # planned program is cache-keyed and AOT-warmable like any
+        # other)
+        out["plan_breakdown"].append(breakdown_row(
+            measure_example_compiles(name, ragged_test=False,
+                                     plan="precision")))
     out["host_chunk"] = measure_host_chunk_compiles()
     runs = [r for e in out["examples"].values() for r in e.values()]
     # per-example: an example counts only when BOTH its runs (multiple

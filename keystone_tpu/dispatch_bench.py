@@ -15,9 +15,17 @@ plans and checks the outputs are identical:
   - ``optimized`` — the PR-4/5 plan: expanded fusable coverage, fusion
     through fan-out-free estimator apply boundaries
     (`FusedChainOperator`), concurrent DAG dispatch, megafusion OFF;
-  - ``megafused`` — the current default plan: ``optimized`` plus
+  - ``megafused`` — the PR-9 default plan: ``optimized`` plus
     whole-plan megafusion (`MegafusionRule`): the entire apply path,
-    chunk loop included, collapses into ONE scan-bodied program.
+    chunk loop included, collapses into ONE scan-bodied program;
+  - ``precision`` — ``megafused`` plus the mixed-precision policy pass
+    (`PrecisionPlannerRule`, enforcement floor dropped to 0 so the
+    small bench instances actually bake their policies): same program
+    count, halved tolerant stage boundaries. Its outputs are gated
+    against the serial unfused f32 reference with the declared
+    tolerance band (`analysis.precision.DEFAULT_BAND_*`), not exact
+    equality — the ``precision_in_band`` verdict `bench.finalize_record`
+    fails records on.
 
 Each measurement reports the *fit run* (first application: estimator
 fits + train apply) and the *apply run* (re-applying the fitted
@@ -36,7 +44,8 @@ from typing import Callable, Dict, List, Tuple
 
 import numpy as np
 
-PLANS = ("serial_unfused", "legacy", "optimized", "megafused")
+PLANS = ("serial_unfused", "legacy", "optimized", "megafused",
+         "precision")
 
 
 # ---------------------------------------------------------------- examples
@@ -162,24 +171,35 @@ EXAMPLES: Dict[str, Callable] = {
 
 
 def _plan_context(plan: str):
-    """(optimizer, overlap_on, concurrent_on, megafusion_on) for a
+    """(optimizer, overlap_on, concurrent_on, config_overrides) for a
     named plan. ``optimized`` pins megafusion OFF so it remains the
-    PR-4/5 plan bit for bit; the three historical baselines also pin
-    the sharding planner OFF (it post-dates them — PR 9); ``megafused``
-    is the library default, planner included."""
+    PR-4/5 plan bit for bit; the historical baselines also pin the
+    sharding planner OFF (it post-dates them — PR 9) and every plan up
+    to ``megafused`` pins the precision planner OFF (it post-dates them
+    — PR 10); ``precision`` is the full default stack with the
+    enforcement floor dropped so the small bench instances bake their
+    policies."""
     from .workflow.optimizer import DefaultOptimizer
 
     if plan == "serial_unfused":
-        return DefaultOptimizer(fuse=False, sharding_planner=False), \
-            False, False, False
+        return DefaultOptimizer(fuse=False, sharding_planner=False,
+                                precision_planner=False), \
+            False, False, dict(megafusion=False, precision_planner=False)
     if plan == "legacy":
-        return DefaultOptimizer(fuse_apply=False, sharding_planner=False), \
-            True, False, False
+        return DefaultOptimizer(fuse_apply=False, sharding_planner=False,
+                                precision_planner=False), \
+            True, False, dict(megafusion=False, precision_planner=False)
     if plan == "optimized":
-        return DefaultOptimizer(megafuse=False, sharding_planner=False), \
-            True, True, False
+        return DefaultOptimizer(megafuse=False, sharding_planner=False,
+                                precision_planner=False), \
+            True, True, dict(megafusion=False, precision_planner=False)
     if plan == "megafused":
-        return DefaultOptimizer(), True, True, True
+        return DefaultOptimizer(precision_planner=False), True, True, \
+            dict(megafusion=True, precision_planner=False)
+    if plan == "precision":
+        return DefaultOptimizer(), True, True, \
+            dict(megafusion=True, precision_planner=True,
+                 precision_min_savings_bytes=0)
     raise ValueError(f"unknown plan {plan!r}; expected one of {PLANS}")
 
 
@@ -194,13 +214,13 @@ def measure_example(name: str, plan: str) -> Dict:
         overlap_override,
     )
 
-    optimizer, overlap_on, concurrent_on, megafuse_on = _plan_context(plan)
+    optimizer, overlap_on, concurrent_on, overrides = _plan_context(plan)
     PipelineEnv.reset()
     try:
         PipelineEnv.get().set_optimizer(optimizer)
         with overlap_override(overlap_on), \
                 dispatch_override(concurrent_on), \
-                config_override(megafusion=megafuse_on):
+                config_override(**overrides):
             predictor, train, test = EXAMPLES[name]()
             c = counter("dispatch.programs_executed")
             before = c.value
@@ -245,15 +265,19 @@ def dispatch_count_report(
     embedded in the trace metadata, so ``perf_table.py --trace`` and the
     telemetry CLI render the 2→1 reduction without spelunking the raw
     trace."""
+    from .analysis.precision import DEFAULT_BAND_ATOL, DEFAULT_BAND_RTOL
+
     out: Dict = {"examples": {}, "plans": list(PLANS),
                  "plan_breakdown": []}
     reductions: List[float] = []
     mega_one = 0
+    precision_in_band = True
     for name in examples:
         runs = {plan: measure_example(name, plan) for plan in PLANS}
         base = runs["serial_unfused"]
         mega = runs["megafused"]
         outputs_match = True
+        in_band = True
         if check_outputs:
             for r in (runs["legacy"], runs["optimized"], mega):
                 try:
@@ -265,6 +289,24 @@ def dispatch_count_report(
                         rtol=1e-5, atol=1e-5)
                 except AssertionError:
                     outputs_match = False
+            # the precision plan is gated with the DECLARED band, not
+            # exact equality: bf16 boundaries legitimately round, and
+            # the policy is only shippable inside the band (argmax
+            # outputs are int — the band degenerates to equality there,
+            # with a small tie-flip allowance)
+            for side in ("train_pred", "test_pred"):
+                a, b = runs["precision"][side], base[side]
+                if np.issubdtype(a.dtype, np.integer):
+                    if np.mean(a == b) < 0.95:
+                        in_band = False
+                else:
+                    try:
+                        np.testing.assert_allclose(
+                            a, b, rtol=DEFAULT_BAND_RTOL,
+                            atol=DEFAULT_BAND_ATOL)
+                    except AssertionError:
+                        in_band = False
+            precision_in_band &= in_band
         apply_ratio = (base["apply_run_programs"] / mega["apply_run_programs"]
                        if mega["apply_run_programs"] else float("inf"))
         reductions.append(apply_ratio)
@@ -282,9 +324,12 @@ def dispatch_count_report(
                 runs["optimized"]["apply_run_programs"]
                 / max(1, mega["apply_run_programs"]), 2),
             "outputs_match_serial_unfused": bool(outputs_match),
+            "precision_in_band": bool(in_band),
         }
         # the per-plan breakdown row: one flat record per example, the
-        # shape perf_table.py / the trace CLI print verbatim
+        # shape perf_table.py / the trace CLI print verbatim (the
+        # `precision` column is the policy-on apply-run program count —
+        # same 1-program shape as megafused, halved boundaries inside)
         out["plan_breakdown"].append({
             "example": name,
             **{p: runs[p]["apply_run_programs"] for p in PLANS},
@@ -298,4 +343,5 @@ def dispatch_count_report(
         reductions) >= 2 else None
     out["all_outputs_match"] = all(
         e["outputs_match_serial_unfused"] for e in out["examples"].values())
+    out["precision_in_band"] = bool(precision_in_band)
     return out

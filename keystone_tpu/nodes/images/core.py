@@ -51,6 +51,9 @@ class Convolver(Transformer):
     """
 
     fusable = True
+    #: featurize conv: the fused kernel's numerics story (PERF.md) —
+    #: bf16 boundary storage tolerated; the FOLD below stays HIGHEST
+    precision_tolerance = "tolerant"
 
     def __init__(
         self,
@@ -127,6 +130,7 @@ class SymmetricRectifier(Transformer):
     (SymmetricRectifier.scala:7-32)."""
 
     fusable = True
+    precision_tolerance = "tolerant"  # elementwise two-sided ReLU
 
     def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
         self.max_val = max_val
@@ -161,6 +165,7 @@ class Pooler(Transformer):
     (Pooler.scala:21-69) — `lax.reduce_window` on TPU."""
 
     fusable = True
+    precision_tolerance = "tolerant"  # windowed sum/max over featurize
 
     def __init__(self, stride: int, pool_size: int, pixel_fn=None, pool_fn="sum"):
         self.stride = stride
@@ -212,6 +217,7 @@ class ImageVectorizer(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks
+    precision_tolerance = "tolerant"  # reshape: values untouched
 
     def apply(self, x):
         return jnp.ravel(x)
@@ -228,6 +234,7 @@ class PixelScaler(Transformer):
 
     fusable = True
     chunkable = True  # per-item host map: distributes over chunks
+    precision_tolerance = "tolerant"  # uint8 decode: 8 significant bits
 
     def apply(self, x):
         return jnp.asarray(x, jnp.float32) / 255.0
@@ -248,10 +255,13 @@ class PixelScaler(Transformer):
         return self.apply
 
     def fuse(self):
+        # uint8 pixel decode: the f32 widening IS this stage's job (the
+        # input has 8 significant bits; downstream boundaries may still
+        # be halved by the precision planner)
         return (
             ("PixelScaler",),
             (),
-            lambda p, x: jnp.asarray(x, jnp.float32) / 255.0,
+            lambda p, x: jnp.asarray(x, jnp.float32) / 255.0,  # keystone: ignore[KJ011]
         )
 
 
@@ -273,9 +283,11 @@ class GrayScaler(Transformer):
         def fn(p, x):
             if x.shape[-1] == 1:
                 return x
-            w = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+            # uint8 pixel decode (see PixelScaler.fuse): widening to f32
+            # is the stage's contract, not a policy leak
+            w = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)  # keystone: ignore[KJ011]
             return jnp.sum(
-                jnp.asarray(x, jnp.float32) * w, axis=-1, keepdims=True)
+                jnp.asarray(x, jnp.float32) * w, axis=-1, keepdims=True)  # keystone: ignore[KJ011]
 
         return (("GrayScaler",), (), fn)
 

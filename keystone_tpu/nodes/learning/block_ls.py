@@ -196,6 +196,7 @@ class BlockLinearMapper(Transformer):
 
     fusable = True   # pad + GEMM: traceable, joins fused chains
     chunkable = True  # per-row GEMM: distributes over host chunks
+    precision_tolerance = "exact"  # solver apply: f32/HIGHEST inputs
 
     def __init__(self, W, b=None, block_size: Optional[int] = None):
         self.W = W
@@ -275,6 +276,10 @@ class BlockLinearMapper(Transformer):
 
 class BlockLeastSquaresEstimator(LabelEstimator):
     """BCD least squares with L2 (BlockLinearMapper.scala:199-283)."""
+
+    #: solver: normal-equation accumulation pins f32/HIGHEST inputs
+    #: (`_normal_equations` runs under default_matmul_precision highest)
+    precision_tolerance = "exact"
 
     def __init__(
         self,

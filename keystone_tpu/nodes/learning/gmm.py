@@ -111,6 +111,8 @@ class GaussianMixtureModelEstimator(Estimator):
     """Local EM with k-means++ (or random) init and variance floors
     (GaussianMixtureModelEstimator.scala:25-203)."""
 
+    precision_tolerance = "exact"  # moments/decomposition: f32 inputs
+
     def __init__(
         self,
         k: int,

@@ -165,6 +165,8 @@ class KernelBlockLinearMapper(Transformer):
     """Apply a kernel model to test data block-by-block with incremental
     accumulation (KernelBlockLinearMapper.scala:28-90)."""
 
+    precision_tolerance = "exact"  # kernel solve apply: f32 inputs
+
     def __init__(self, train_X, alpha, gamma: float, block_size: int = 4096):
         self.train_X = jnp.asarray(train_X)
         self.alpha = jnp.asarray(alpha)
@@ -209,6 +211,8 @@ class KernelBlockLinearMapper(Transformer):
 class KernelRidgeRegression(LabelEstimator):
     """Dual KRR via Gauss-Seidel BCD over permuted sample blocks
     (KernelRidgeRegression.scala:37-275)."""
+
+    precision_tolerance = "exact"  # solver: f32/HIGHEST inputs
 
     def __init__(self, gamma: float, lam: float, block_size: int = 2048,
                  num_epochs: int = 1, seed: int = 0,

@@ -87,6 +87,9 @@ def kmeans_pp_init(X: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarra
 
 
 class KMeansPlusPlusEstimator(Estimator):
+
+    precision_tolerance = "exact"  # moments/decomposition: f32 inputs
+
     def __init__(self, num_means: int, num_iters: int = 20, seed: int = 0):
         self.num_means = num_means
         self.num_iters = num_iters

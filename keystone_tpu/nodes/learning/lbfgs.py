@@ -140,6 +140,8 @@ class DenseLBFGSwithL2(LabelEstimator):
     """Least-squares + L2 via L-BFGS on dense features
     (LBFGS.scala `DenseLBFGSwithL2`)."""
 
+    precision_tolerance = "exact"  # solver: f32/HIGHEST inputs
+
     def __init__(
         self,
         lam: float = 0.0,
@@ -525,6 +527,8 @@ class SparseLBFGSwithL2(LabelEstimator):
     in both routes (the reference appends a ones column,
     LBFGS.scala:223-247).
     """
+
+    precision_tolerance = "exact"  # solver: f32/HIGHEST inputs
 
     def __init__(
         self,

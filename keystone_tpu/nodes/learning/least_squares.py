@@ -35,6 +35,8 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
     """Pick the cheapest least-squares solver for the measured workload
     (LeastSquaresEstimator.scala:26-86)."""
 
+    precision_tolerance = "exact"  # whichever solver wins, it pins f32
+
     def __init__(
         self,
         lam: float = 0.0,

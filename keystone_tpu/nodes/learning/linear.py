@@ -40,6 +40,7 @@ class LinearMapper(Transformer):
     is a single sharded GEMM (LinearMapper.scala:18-63)."""
 
     chunkable = True  # per-row GEMM: distributes over host chunks
+    precision_tolerance = "exact"  # solver apply: f32/HIGHEST inputs
 
     def __init__(self, W, b=None, feature_scaler=None):
         self.W = W
@@ -132,6 +133,7 @@ class LinearMapEstimator(LabelEstimator):
     (LinearMapper.scala:69-161)."""
 
     fusable_fit = True  # always fits a traceable LinearMapper
+    precision_tolerance = "exact"  # exact normal equations
 
     def __init__(self, lam: float = 0.0, fit_intercept: bool = True):
         self.lam = lam

@@ -137,6 +137,8 @@ def _pca_fit_spec(dims: int, label: str, train_spec=None):
 class PCAEstimator(Estimator):
     """Local PCA via SVD (PCA.scala:162-247)."""
 
+    precision_tolerance = "exact"  # moments/decomposition: f32 inputs
+
     def __init__(self, dims: int, sample_rows: Optional[int] = 100_000):
         self.dims = dims
         self.sample_rows = sample_rows
@@ -183,6 +185,8 @@ def _tsqr_r(X, n_shards: int):
 
 class DistributedPCAEstimator(Estimator):
     """PCA via TSQR + SVD of R (DistributedPCA.scala:20-74)."""
+
+    precision_tolerance = "exact"  # moments/decomposition: f32 inputs
 
     def __init__(self, dims: int):
         self.dims = dims
@@ -239,6 +243,8 @@ def _randomized_components(X, key, k: int, q: int):
 
 class ApproximatePCAEstimator(Estimator):
     """Randomized sketch PCA (ApproximatePCA.scala:22-85)."""
+
+    precision_tolerance = "exact"  # moments/decomposition: f32 inputs
 
     def __init__(self, dims: int, oversample: int = 10, q: int = 2, seed: int = 0):
         self.dims = dims

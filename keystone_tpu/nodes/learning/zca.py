@@ -71,6 +71,9 @@ def _fit_zca_np(X: np.ndarray, eps: float):
 
 
 class ZCAWhitenerEstimator(Estimator):
+
+    precision_tolerance = "exact"  # moments/decomposition: f32 inputs
+
     def __init__(self, eps: float = 0.1):
         self.eps = eps
 

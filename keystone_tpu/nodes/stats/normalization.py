@@ -20,6 +20,7 @@ class NormalizeRows(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+    precision_tolerance = "tolerant"  # per-item norm: featurize scale
 
     def __init__(self, eps: float = 2.2e-16):
         self.eps = eps
@@ -29,20 +30,22 @@ class NormalizeRows(Transformer):
         return x / jnp.maximum(norm, self.eps)
 
     def fuse(self):
-        # eps rides as a traced scalar; the batch form normalizes each
-        # ITEM (all axes but the leading) — identical to vmap(apply)
+        # eps rides as a traced scalar matched to the input dtype in
+        # the body; the batch form normalizes each ITEM (all axes but
+        # the leading) — identical to vmap(apply)
         def fn(p, xb):
             axes = tuple(range(1, xb.ndim))
             norms = jnp.sqrt(jnp.sum(xb * xb, axis=axes, keepdims=True))
-            return xb / jnp.maximum(norms, p[0])
+            return xb / jnp.maximum(norms, jnp.asarray(p[0], xb.dtype))
 
-        return (("NormalizeRows",), (jnp.float32(self.eps),), fn)
+        return (("NormalizeRows",), (np.float64(self.eps),), fn)
 
 
 class SignedHellingerMapper(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+    precision_tolerance = "tolerant"  # elementwise sign·sqrt
 
     def apply(self, x):
         return jnp.sign(x) * jnp.sqrt(jnp.abs(x))

@@ -32,6 +32,9 @@ class CosineRandomFeatures(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+    #: random-projection featurize: bf16 storage/compute tolerated (the
+    #: bandwidth-bound hot path the precision planner halves)
+    precision_tolerance = "tolerant"
 
     def __init__(
         self,
@@ -87,6 +90,7 @@ class RandomSignNode(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+    precision_tolerance = "tolerant"  # elementwise ±1 flip
 
     def __init__(self, dim: int, seed: int = 0):
         rng = np.random.default_rng(seed)
@@ -111,11 +115,21 @@ class PaddedFFT(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+    precision_tolerance = "tolerant"  # featurize transform
 
     def apply(self, x):
         n = x.shape[-1]
         padded = 1 << max(int(np.ceil(np.log2(n))), 0)
-        return jnp.fft.rfft(x, n=padded).real[..., : padded // 2]
+        return jnp.fft.rfft(self._widen(x), n=padded).real[..., : padded // 2]
+
+    @staticmethod
+    def _widen(x):
+        """RFFT only accepts f32/f64: a bf16-stored boundary (the
+        precision planner's halving) upcasts at entry — bf16 storage,
+        f32 compute. The widened value never leaves the program."""
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float64:
+            return x.astype(jnp.float32)  # keystone: ignore[KJ011]
+        return x
 
     def fuse(self):
         # shape-only state: the pad width derives from the traced input
@@ -123,6 +137,7 @@ class PaddedFFT(Transformer):
         def fn(p, x):
             n = x.shape[-1]
             padded = 1 << max(int(np.ceil(np.log2(n))), 0)
+            x = PaddedFFT._widen(x)
             return jnp.fft.rfft(x, n=padded).real[..., : padded // 2]
 
         return (("PaddedFFT",), (), fn)
@@ -133,6 +148,7 @@ class LinearRectifier(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks (KP302)
+    precision_tolerance = "tolerant"  # elementwise max/sub
 
     def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
         self.max_val = max_val
@@ -142,8 +158,11 @@ class LinearRectifier(Transformer):
         return jnp.maximum(self.max_val, x - self.alpha)
 
     def fuse(self):
-        # thresholds ride as traced scalars: rectifiers with different
-        # values share one compiled program
+        # thresholds ride as traced scalars matched to the INPUT dtype
+        # inside the program: a pinned-f32 scalar would silently promote
+        # a bf16 boundary back to f32 and defeat any precision policy
+        # (the KJ011 class of bug)
         return (("LinearRectifier",),
-                (jnp.float32(self.max_val), jnp.float32(self.alpha)),
-                lambda p, x: jnp.maximum(p[0], x - p[1]))
+                (np.float64(self.max_val), np.float64(self.alpha)),
+                lambda p, x: jnp.maximum(
+                    jnp.asarray(p[0], x.dtype), x - jnp.asarray(p[1], x.dtype)))

@@ -48,6 +48,11 @@ class StandardScalerModel(Transformer):
     #: fused programs must keep that invariant — mask-less reductions
     #: downstream (`_moments`, `_normal_equations`) rely on it
     fuse_masks_output = True
+    #: moments stage: standardized features feed solvers; a bf16
+    #: boundary here would round exactly the values the normal
+    #: equations accumulate — pinned f32 (the precision planner's
+    #: EXACT class)
+    precision_tolerance = "exact"
 
     def __init__(self, mean, std=None):
         self.mean = mean
@@ -85,6 +90,7 @@ class StandardScaler(Estimator):
     #: the fit always yields a traceable StandardScalerModel, so the
     #: optimizer may fuse through this estimator's apply boundary
     fusable_fit = True
+    precision_tolerance = "exact"  # `_moments` is an exact reduction
 
     def __init__(self, normalize_std_dev: bool = True, eps: float = 1e-12):
         self.normalize_std_dev = normalize_std_dev

@@ -54,6 +54,7 @@ class ClassLabelIndicatorsFromInt(Transformer):
     #: unfused batch path masks padded rows to zero (`_int_indicators`);
     #: the fusion builder re-applies the mask so label sums stay exact
     fuse_masks_output = True
+    precision_tolerance = "exact"  # label stage: ±1 targets feed solvers
 
     def __init__(self, num_classes: int):
         if num_classes < 2:
@@ -92,6 +93,7 @@ class ClassLabelIndicatorsFromIntArray(Transformer):
     fusable = True
     chunkable = True
     fuse_masks_output = True  # see ClassLabelIndicatorsFromInt
+    precision_tolerance = "exact"  # label stage: ±1 targets feed solvers
 
     def __init__(self, num_classes: int):
         self.num_classes = num_classes
@@ -125,6 +127,9 @@ class MaxClassifier(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks
+    #: index stage: a bf16 score vector can flip near-tie argmaxes, so
+    #: the boundary INTO the classifier stays f32
+    precision_tolerance = "exact"
 
     def abstract_apply(self, elem):
         from ...analysis.specs import SpecMismatchError, shape_struct
@@ -161,6 +166,10 @@ class VectorCombiner(Transformer):
     """Concatenate the tuple of branch outputs produced by gather
     (VectorCombiner.scala)."""
 
+    #: value-preserving plumbing: the consumers behind the concat decide
+    #: precision tolerance (analysis.precision looks through this stage)
+    precision_passthrough = True
+
     def apply(self, xs):
         return jnp.concatenate([jnp.asarray(x) for x in xs], axis=-1)
 
@@ -178,6 +187,10 @@ class Cacher(Transformer):
     cross-pipeline reuse (Cacher.scala:15-25 + ExtractSaveablePrefixes)."""
 
     saveable = True
+    #: value-preserving plumbing: the consumers behind the cache decide
+    #: precision tolerance — a cached feature matrix feeding an exact
+    #: solver must stay f32 even though the cache tolerates anything
+    precision_passthrough = True
 
     def __init__(self, name: str = ""):
         self.name = name
@@ -235,6 +248,7 @@ class MatrixVectorizer(Transformer):
 
     fusable = True
     chunkable = True  # pure per-item fn: distributes over chunks
+    precision_tolerance = "tolerant"  # reshape: values untouched
 
     def apply(self, x):
         return jnp.ravel(x)
@@ -246,6 +260,8 @@ class MatrixVectorizer(Transformer):
 
 
 class Identity(Transformer):
+    precision_passthrough = True  # see Cacher
+
     def apply(self, x):
         return x
 

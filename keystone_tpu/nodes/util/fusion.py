@@ -42,6 +42,7 @@ class _RectifyPoolStage(Transformer):
     Pallas one-pass kernel on TPU (ops/pallas_kernels.py), XLA elsewhere."""
 
     fusable = True
+    precision_tolerance = "tolerant"  # both fused members are tolerant
 
     def __init__(self, alpha: float, max_val: float, pool: int, stride: int):
         self.alpha = alpha
@@ -82,6 +83,7 @@ class _ConvRectifyPoolStage(Transformer):
     Default-on for TPU; KEYSTONE_DISABLE_FUSED_CONV=1 forces XLA."""
 
     fusable = True
+    precision_tolerance = "tolerant"  # all three fused members are
 
     def __init__(self, conv, alpha: float, max_val: float, pool: int, stride: int):
         self.alpha = alpha
@@ -278,6 +280,14 @@ class _GatherConcatStage(Transformer):
     def chunkable(self) -> bool:
         return all(getattr(b, "chunkable", False) for b in self.branches)
 
+    @property
+    def precision_tolerance(self):
+        """Tolerant iff every branch declares tolerance — the collapsed
+        diamond inherits the weakest member's contract."""
+        tols = {getattr(b, "precision_tolerance", None)
+                for b in self.branches}
+        return "tolerant" if tols == {"tolerant"} else "exact"
+
     def apply(self, x):
         return jnp.concatenate(
             [jnp.asarray(b.apply(x)) for b in self.branches], axis=-1)
@@ -307,6 +317,15 @@ class FusedBatchTransformer(Transformer):
     #: optimizer passes (or hand-fused example featurizers) can extend it
     fusable = True
 
+    @property
+    def precision_tolerance(self):
+        """A fused chain tolerates reduced precision iff EVERY member
+        does — the precision planner treats the whole program as one
+        stage when it appears inside a larger graph."""
+        tols = {getattr(s, "precision_tolerance", None)
+                for s in self.stages}
+        return "tolerant" if tols == {"tolerant"} else "exact"
+
     #: the sharding planner's chosen output placement (a batch-level
     #: `PartitionSpec`), set by `ShardingPlannerRule` on a tagged copy
     #: when the plan deviates from the default: `_build_program` lowers
@@ -316,6 +335,26 @@ class FusedBatchTransformer(Transformer):
     #: form's cache entry). None (the default) compiles exactly the
     #: PR-8 program.
     planned_out_spec = None
+
+    #: the precision planner's chosen per-stage storage dtypes (set by
+    #: `PrecisionPlannerRule` on a tagged copy): a tuple of dtype names
+    #: or None, one per PEEPHOLED stage output. `_build_program` bakes
+    #: each entry into the traced chunk body as a
+    #: ``convert_element_type`` cast after that stage — jaxpr-visible,
+    #: AOT-warmable, and part of the program cache key, so a planned
+    #: program never collides with the unplanned form's entry. The LAST
+    #: entry RESTORES the unplanned trail's output dtype (the program's
+    #: visible output dtype never changes — downstream consumers see
+    #: exactly the PR-9 dtypes). None (the default) compiles exactly
+    #: the PR-9 program.
+    planned_precision = None
+
+    #: the precision planner's matmul-precision scope (e.g.
+    #: ``"bfloat16"``): when set, the traced chunk body runs under
+    #: `jax.default_matmul_precision`, so every dot the program
+    #: contains carries the reduced precision in its jaxpr. Also part
+    #: of the program cache key.
+    planned_matmul_precision = None
 
     def __init__(self, stages: Sequence[Transformer], microbatch: int = 2048):
         self.stages = list(stages)
@@ -380,6 +419,8 @@ class FusedBatchTransformer(Transformer):
             min(self.microbatch, padded_count // n_shards),
             mesh,
             self.planned_out_spec,
+            self.planned_precision,
+            self.planned_matmul_precision,
         )
 
     def _program_cache(self, statics):
@@ -506,10 +547,31 @@ class FusedBatchTransformer(Transformer):
         n_chunks = -(-local_n // chunk)
         padded_local = n_chunks * chunk
 
+        # the precision planner's chosen per-stage storage dtypes: one
+        # entry per fused stage (aligned with `fns` — both derive from
+        # the same `_peephole` pass); a stale/misaligned tag is ignored
+        # rather than mis-cast
+        planned_prec = self.planned_precision
+        if planned_prec is not None and len(planned_prec) != len(fns):
+            planned_prec = None
+        matmul_prec = self.planned_matmul_precision
+
         def chunk_fn(params, xb, mb):
-            for f, p in zip(fns, params):
+            for i, (f, p) in enumerate(zip(fns, params)):
                 xb = f(p, xb, mb)
+                if planned_prec is not None and planned_prec[i] is not None \
+                        and jnp.issubdtype(xb.dtype, jnp.floating):
+                    # the chosen boundary storage dtype, baked into the
+                    # traced program (convert_element_type in the jaxpr)
+                    xb = xb.astype(jnp.dtype(planned_prec[i]))
             return xb
+
+        if matmul_prec is not None:
+            inner_chunk = chunk_fn
+
+            def chunk_fn(params, xb, mb):
+                with jax.default_matmul_precision(matmul_prec):
+                    return inner_chunk(params, xb, mb)
 
         def per_shard(flat_params, xs, ms):
             # xs: (local_n, ...) shard rows; ms: (local_n,) valid mask

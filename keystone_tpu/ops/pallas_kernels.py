@@ -276,7 +276,16 @@ def folded_conv_reference(images, kernel_hwio, colsum, bias, normalize: bool):
     """The folded conv: filter bank with ZCA pre-applied, patch-mean
     subtraction as a rank-1 correction via a uniform conv, plus bias.
     Single source of truth — nodes/images/core.py's Convolver and the
-    fused peephole's fallback both call this."""
+    fused peephole's fallback both call this.
+
+    Mixed-precision contract: `lax.conv_general_dilated` requires both
+    operands to share a dtype, so when the precision planner stores the
+    activation boundary in bf16 the filter bank follows the activation
+    dtype (bf16 inputs, f32 accumulation via `preferred_element_type` —
+    the MXU discipline); the conv output is always f32."""
+    if jnp.issubdtype(images.dtype, jnp.floating) \
+            and kernel_hwio.dtype != images.dtype:
+        kernel_hwio = kernel_hwio.astype(images.dtype)
     dn = lax.conv_dimension_numbers(
         images.shape, kernel_hwio.shape, ("NHWC", "HWIO", "NHWC")
     )
@@ -385,6 +394,13 @@ def conv_rectify_pool(
     canary compile. The single entry point for
     Convolver>>Rectifier>>Pooler semantics — the fusion peephole and
     the driver graft entry both route through it."""
+    # precision-planner boundaries may hand bf16 activations to an f32
+    # filter bank: the kernel follows the activation dtype here so BOTH
+    # paths (Pallas GEMM, XLA conv) see matching operand dtypes; the
+    # accumulator stays f32 in each.
+    if jnp.issubdtype(images.dtype, jnp.floating) \
+            and kernel_hwio.dtype != images.dtype:
+        kernel_hwio = kernel_hwio.astype(images.dtype)
     if use_fused_conv() and _fused_conv_canary_ok(
         images.shape[1], images.shape[2], images.shape[3],
         kernel_hwio.shape[3], pool, stride, normalize,

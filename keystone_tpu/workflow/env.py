@@ -131,6 +131,25 @@ class ExecutionConfig:
     program outputs, explicit `collectives.reshard` of plan-input
     datasets. A 1-device mesh, an unimproved plan, or a planner failure
     all leave the plan untouched.
+
+    ``precision_planner`` (default on; env ``KEYSTONE_PRECISION_PLANNER=0``
+    reverts to the PR-9 plan bit-for-bit) turns on the mixed-precision
+    policy pass: after the sharding planner, `PrecisionPlannerRule`
+    assigns each fused/megafused program's internal stage boundaries a
+    storage dtype from the legal menu (bf16 where every adjacent stage
+    declares/probes tolerance, f32 everywhere a solver, moments stage,
+    or label stage pins exactness — `analysis.precision`), prices each
+    assignment by the bytes the boundary moves, and bakes winning
+    policies into the compiled program as ``convert_element_type``
+    casts (cache-keyed, AOT-warmable, jaxpr-visible). A no-win plan, a
+    planner failure, or the kill switch leave the program untouched.
+
+    ``precision_min_savings_bytes`` (env
+    ``KEYSTONE_PRECISION_MIN_SAVINGS_BYTES``, default 1 MiB) is the
+    enforcement floor: a policy is only baked into a program when its
+    priced savings clear it. Tiny pipelines (tests, smoke runs) stay
+    bit-identical to the PR-9 programs by construction; real featurize
+    workloads clear the floor trivially. 0 enforces every strict win.
     """
 
     overlap: bool = True
@@ -145,6 +164,8 @@ class ExecutionConfig:
     compile_cache_dir: Optional[str] = None
     megafusion: bool = True
     sharding_planner: bool = True
+    precision_planner: bool = True
+    precision_min_savings_bytes: int = 1 << 20
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -249,6 +270,10 @@ def execution_config() -> ExecutionConfig:
             not in _OFF,
             sharding_planner=os.environ.get(
                 "KEYSTONE_SHARDING_PLANNER", "1").lower() not in _OFF,
+            precision_planner=os.environ.get(
+                "KEYSTONE_PRECISION_PLANNER", "1").lower() not in _OFF,
+            precision_min_savings_bytes=max(0, int(os.environ.get(
+                "KEYSTONE_PRECISION_MIN_SAVINGS_BYTES", str(1 << 20)))),
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

@@ -108,6 +108,24 @@ def _submit_warmup(op, element, count) -> None:
     _spawn_warm_thread(run, "keystone-aot-warmup")
 
 
+def _spec_dtype_name(spec) -> Optional[str]:
+    """The boundary dtype of a propagated DataSpec ("float32",
+    "uint8", ...; mixed pytrees join with "+"), or None when unknown —
+    the trace/reconcile tables' dtype column. Delegates to the
+    precision module's formatter so this column and the
+    ``--explain-precision`` table can never disagree on a boundary."""
+    try:
+        from ..analysis.precision import _elem_dtype_name
+        from ..analysis.specs import DataSpec, is_known
+
+        if not isinstance(spec, DataSpec) or not is_known(spec.element):
+            return None
+        name = _elem_dtype_name(spec)
+        return None if name == "?" else name
+    except Exception:
+        return None
+
+
 def concurrent_relation(graph: Graph):
     """The scheduler's concurrently-schedulable relation, exposed for
     static analysis (the KP511 interference pass): a predicate
@@ -257,6 +275,12 @@ class GraphExecutor:
                         "vertex": vid.id,
                         "bytes": int(nbytes),
                     }
+                    dt = _spec_dtype_name(specs.get(vid))
+                    if dt is not None:
+                        # the propagated boundary dtype: the precision
+                        # planner's decisions (and the uint8/int32
+                        # loader stages) show up in the reconcile table
+                        entry["dtype"] = dt
                     sv = shardings.get(vid)
                     if sv is not None:
                         entry["spec"] = spec_str(sv)

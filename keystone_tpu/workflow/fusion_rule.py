@@ -112,6 +112,15 @@ class FusedChainOperator(Operator):
     #: into a with_sharding_constraint on the program output
     planned_out_spec = None
 
+    #: the precision planner's chosen per-stage storage dtypes (set by
+    #: `PrecisionPlannerRule` on a tagged copy: one dtype name or None
+    #: per PEEPHOLED stage output) and its matmul-precision scope;
+    #: `materialize` hands both to the built fused transformer, whose
+    #: program builder bakes the casts (and the
+    #: jax.default_matmul_precision scope) into the traced program
+    planned_precision = None
+    planned_matmul_precision = None
+
     def _fused_cls(self):
         from ..nodes.util.fusion import FusedBatchTransformer
 
@@ -138,6 +147,11 @@ class FusedChainOperator(Operator):
             fused = self._fused_cls()(stages, microbatch=self.microbatch)
             if self.planned_out_spec is not None:
                 fused.planned_out_spec = self.planned_out_spec
+            if self.planned_precision is not None:
+                fused.planned_precision = self.planned_precision
+            if self.planned_matmul_precision is not None:
+                fused.planned_matmul_precision = \
+                    self.planned_matmul_precision
             return fused
         return TransformerChain(stages)
 

@@ -455,6 +455,10 @@ class GatherTransformerOperator(TransformerOperator):
     dataset zip utility; for the single path the inputs are simply collected.
     """
 
+    #: value-preserving plumbing: the precision analyzer looks through
+    #: the zip — the combiner/solver behind it decides dtype tolerance
+    precision_passthrough = True
+
     @property
     def label(self) -> str:
         return "Gather"

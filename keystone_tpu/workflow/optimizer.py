@@ -362,6 +362,122 @@ class ShardingPlannerRule(Rule):
         return graph
 
 
+class PrecisionPlannerRule(Rule):
+    """Mixed-precision policy pass: choose, price, and ENFORCE per-stage
+    storage dtypes as an optimizer decision (`analysis.precision` is the
+    pure decision core; this rule is the enforcement shell — the PR-9
+    placement pattern applied to precision).
+
+    Runs after `ShardingPlannerRule` so the dtype decision sees the
+    program boundaries (and placements) that will actually execute.
+    Reads `ExecutionConfig.precision_planner` (env
+    ``KEYSTONE_PRECISION_PLANNER``, default on) at optimization time and
+    is a strict no-op on plans with no fused program, on unbound or
+    abstract graphs, when no policy clears the
+    ``precision_min_savings_bytes`` enforcement floor, and on any
+    planner failure — so the kill switch (and every no-win case)
+    reproduces the PR-9 plan bit-for-bit.
+
+    Enforcement of a winning policy: each fused/megafused program
+    operator whose internal stage trail admits a priced bf16 win is
+    replaced with a tagged copy carrying ``planned_precision`` (one
+    storage dtype per peepholed stage output); the program builder
+    lowers that into ``convert_element_type`` casts between stages —
+    cache-keyed like ``planned_out_spec``, AOT-warmable, and visible in
+    the compiled jaxpr. When every stage of the program tolerates
+    reduced compute the tagged copy additionally carries
+    ``planned_matmul_precision="bfloat16"``, baking a
+    `jax.default_matmul_precision` scope into the traced program. The
+    program's FINAL output dtype is never changed, so downstream
+    consumers (and the pipeline's visible output) see exactly the PR-9
+    dtypes.
+
+    Operators are copied, never mutated in place: shared instances
+    reused across pipelines must not carry one plan's policy into
+    another's.
+    """
+
+    def apply(self, plan: Plan) -> Plan:
+        from .env import execution_config
+
+        cfg = execution_config()
+        if not cfg.precision_planner:
+            return plan  # kill switch: the PR-9 plan, bit for bit
+        graph, prefixes = plan
+        from .fusion_rule import FusedChainOperator
+
+        from ..nodes.util.fusion import FusedBatchTransformer
+
+        targets = [
+            vid for vid in sorted(graph.operators, key=lambda n: n.id)
+            if isinstance(graph.get_operator(vid),
+                          (FusedChainOperator, FusedBatchTransformer))
+        ]
+        if not targets:
+            return plan
+        if not ShardingPlannerRule._has_device_dataset(graph):
+            # the policy prices DATASET boundaries (plan_stage_precision
+            # requires a device dataset data dep), so a datum/host-only
+            # serving plan can never enforce anything — skip it before
+            # spec_pass runs user apply bodies under eval_shape (the
+            # same guard the sharding planner carries)
+            return plan
+        from ..telemetry import counter, span
+
+        with span("precision_planner", cat="phase",
+                  programs=len(targets)):
+            try:
+                from ..analysis.precision import plan_stage_precision
+                from ..analysis.propagate import spec_pass
+
+                specs, _ = spec_pass(graph, {})
+                total_saved = 0
+                tagged = 0
+                for vid in targets:
+                    op = graph.get_operator(vid)
+                    if getattr(op, "planned_precision", None) is not None:
+                        continue  # already planned (re-optimization)
+                    decided = plan_stage_precision(graph, vid, op, specs)
+                    if decided is None:
+                        continue
+                    storage, saved = decided
+                    if saved < cfg.precision_min_savings_bytes:
+                        continue  # below the enforcement floor: the
+                        # program stays bit-identical to PR 9
+                    import copy
+
+                    new_op = copy.copy(op)
+                    new_op.planned_precision = storage
+                    if self._all_compute_tolerant(graph, vid, op):
+                        new_op.planned_matmul_precision = "bfloat16"
+                    graph = graph.set_operator(vid, new_op)
+                    total_saved += saved
+                    tagged += 1
+            except Exception:
+                logger.debug("precision planner failed; plan unchanged",
+                             exc_info=True)
+                return plan
+            if not tagged:
+                return plan
+            counter("planner.bytes_halved").inc(total_saved)
+            counter("planner.precision_policies_enforced").inc(tagged)
+            logger.info(
+                "PrecisionPlannerRule: enforcing bf16 storage on %d "
+                "program(s), %d boundary bytes saved", tagged, total_saved)
+        return graph, prefixes
+
+    @staticmethod
+    def _all_compute_tolerant(graph: Graph, vid, op) -> bool:
+        from ..analysis.precision import TOLERANT, stage_tolerance
+
+        stage_specs = getattr(op, "stage_specs", None)
+        if stage_specs is None:
+            stage_specs = list(getattr(op, "stages", []))
+        return bool(stage_specs) and all(
+            stage_tolerance(s, graph, vid) == TOLERANT
+            for s in stage_specs)
+
+
 class Optimizer(RuleExecutor):
     pass
 
@@ -373,7 +489,8 @@ class DefaultOptimizer(Optimizer):
 
     def __init__(self, samples_per_shard: int = 3, fuse: bool = True,
                  fusion_microbatch: int = 2048, fuse_apply: bool = True,
-                 megafuse: bool = True, sharding_planner: bool = True):
+                 megafuse: bool = True, sharding_planner: bool = True,
+                 precision_planner: bool = True):
         from .fusion_rule import MegafusionRule, NodeFusionRule
 
         self._batches = [
@@ -406,6 +523,16 @@ class DefaultOptimizer(Optimizer):
             # `ExecutionConfig.sharding_planner`
             # (KEYSTONE_SHARDING_PLANNER) at optimization time.
             self._batches.append(Batch("place", [ShardingPlannerRule()]))
+        if precision_planner:
+            # precision rides AFTER placement: the dtype decision must
+            # see the fused program boundaries (and their placements)
+            # that will actually execute. Gated twice like the sharding
+            # planner: the constructor flag builds the PR-9 optimizer
+            # exactly, and the rule reads
+            # `ExecutionConfig.precision_planner`
+            # (KEYSTONE_PRECISION_PLANNER) at optimization time.
+            self._batches.append(Batch("precision",
+                                       [PrecisionPlannerRule()]))
         self._batches.append(Batch("node-opt", [NodeOptimizationRule(samples_per_shard)]))
 
     @property

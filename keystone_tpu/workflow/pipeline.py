@@ -409,9 +409,23 @@ class Transformer(TransformerOperator, Chainable):
     chunks, or None) makes the stage a stream *producer* — the bucketed
     host-batch dispatchers (SIFT, grid descriptors) yield each chunk as
     it comes off the device instead of materializing the whole stage.
+
+    Precision hooks (`analysis.precision`): ``precision_tolerance``
+    declares what the mixed-precision policy pass may do to this
+    stage's boundaries — ``"tolerant"`` (bf16 storage and compute are
+    fine: elementwise/featurize stages), ``"compute"`` (f32 storage
+    required, bf16 matmul acceptable), ``"exact"`` (f32/HIGHEST,
+    non-negotiable: solvers, moments, label/index stages), or None
+    (undeclared — the analyzer probes the stage with an eval_shape
+    sensitivity check and pins anything it cannot prove tolerant).
+    ``precision_passthrough = True`` marks value-preserving plumbing
+    (caches, combiners, identity): the analyzer looks *through* such
+    stages, so the consumers behind them decide tolerance.
     """
 
     chunkable = False
+    precision_tolerance = None
+    precision_passthrough = False
 
     def apply(self, x: Any) -> Any:
         raise NotImplementedError

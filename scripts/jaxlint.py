@@ -91,6 +91,20 @@ system cannot (see ANALYSIS.md for the full catalog):
          and the sharding planner prices). A jit that constrains its
          inputs must say where its outputs land.
 
+  KJ011  literal-precision-cast (under ``workflow/`` and ``nodes/``):
+         a literal ``jnp.float32(...)`` / ``.astype(jnp.float32)`` /
+         ``asarray(..., jnp.float32)`` inside a ``fuse()``,
+         ``_chunk_loop``, or ``_build_program`` body. Fused-program
+         code runs under the
+         mixed-precision policy pass (analysis/precision.py): a pinned
+         f32 cast — or an f32 scalar param, which jnp promotion
+         silently widens a bf16 tensor against — re-promotes a halved
+         boundary back to f32 and defeats the policy without any
+         diagnostic. Match the input dtype
+         (``jnp.asarray(c, x.dtype)``) instead; genuine kernel
+         constraints (RFFT accepts only f32/f64, uint8 pixel decode)
+         carry an explicit suppression.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -138,6 +152,11 @@ RULES = {
              "output layout leaks to XLA's partitioner and the caller "
              "re-shards downstream (declare out_shardings so the "
              "boundary layout is a decision, not an accident)",
+    "KJ011": "literal float32 cast inside a fuse()/_chunk_loop body: a "
+             "pinned jnp.float32/astype(jnp.float32) in fused-program "
+             "code silently promotes bf16 boundaries back to f32 and "
+             "defeats any precision policy (match the input dtype, or "
+             "suppress with a kernel-constraint rationale)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -817,6 +836,69 @@ def _check_output_layout_leak(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "out_shardings")
 
 
+def _is_f32_literal(node: ast.AST) -> bool:
+    """`jnp.float32` / `np.float32` attribute, bare `float32`, or the
+    string constant "float32"."""
+    if isinstance(node, ast.Attribute) and node.attr == "float32" \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id in (_NUMPY_NAMES | _JNP_NAMES):
+        return True
+    if isinstance(node, ast.Name) and node.id == "float32":
+        return True
+    return isinstance(node, ast.Constant) and node.value == "float32"
+
+
+def _check_literal_precision_cast(tree: ast.AST, path: str
+                                  ) -> Iterator[Finding]:
+    """KJ011 (under ``workflow/``/``nodes/``): literal f32 casts inside
+    ``fuse()`` / ``_chunk_loop`` bodies — the code that becomes part of
+    a fused XLA program. Three forms: ``x.astype(jnp.float32)``,
+    a direct ``jnp.float32(...)`` call (an f32 scalar param silently
+    promotes a bf16 tensor), and ``asarray(..., jnp.float32)`` /
+    ``dtype=jnp.float32`` call arguments. ``_build_program`` counts as
+    a fused body too — its nested chunk_fn/per_shard closures are
+    traced into the same XLA program the planner tags. Dtype literals
+    OUTSIDE fused bodies (loaders, abstract_eval specs, host decode
+    paths) are not this rule's business."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef) \
+                or fn.name not in {"fuse", "_chunk_loop", "_build_program"}:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Attribute) and func.attr == "astype" \
+                    and sub.args and _is_f32_literal(sub.args[0]):
+                yield Finding(
+                    path, sub.lineno, "KJ011",
+                    "literal .astype(float32) in a fused-program body "
+                    "defeats the precision policy; cast to the input's "
+                    "dtype instead")
+                continue
+            if _is_f32_literal(func):
+                yield Finding(
+                    path, sub.lineno, "KJ011",
+                    "literal float32(...) scalar in a fused-program "
+                    "body: jnp promotion widens bf16 tensors against "
+                    "f32 scalars — build the scalar from the input "
+                    "dtype instead")
+                continue
+            literal_args = [a for a in sub.args if _is_f32_literal(a)]
+            literal_kwargs = [kw for kw in sub.keywords
+                              if kw.arg == "dtype"
+                              and _is_f32_literal(kw.value)]
+            if literal_args or literal_kwargs:
+                name = _call_name(func) or "?"
+                line = (literal_args[0].lineno if literal_args
+                        else literal_kwargs[0].value.lineno)
+                yield Finding(
+                    path, line, "KJ011",
+                    f"literal float32 dtype in `{name}(...)` inside a "
+                    "fused-program body defeats the precision policy; "
+                    "derive the dtype from the input instead")
+
+
 def _attr_name(node: ast.AST) -> str:
     names = []
     while isinstance(node, (ast.Attribute, ast.Subscript)):
@@ -868,6 +950,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_hot_path_state_write(tree, rel))
         findings.extend(_check_axis_literals(tree, rel))
         findings.extend(_check_output_layout_leak(tree, rel))
+        findings.extend(_check_literal_precision_cast(tree, rel))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
 

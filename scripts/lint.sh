@@ -83,9 +83,42 @@ print(f"planner audit: {len(examples)} example(s), strict wins on {strict}, "
       f"{saved:,} boundary bytes saved, 0 KP6xx under chosen plans OK")
 PY
 
+echo "== precision audit (chosen per-stage dtypes over every example) =="
+# The mixed-precision policy planner's decision gate: run the planner
+# over every analyzable() example and assert (1) the chosen policy's
+# priced boundary bytes never exceed the all-f32 default's, (2) the
+# planner strictly wins on at least 2 examples, and (3) zero
+# unsuppressed WARNING/ERROR KP7xx findings under the chosen policies —
+# the decided dtypes are clean, not just the f32 reference.
+PRECISION_JSON="$(mktemp /tmp/keystone_precision_audit.XXXXXX.json)"
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON"' EXIT
+JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --explain-precision \
+    --json > "$PRECISION_JSON"
+python - "$PRECISION_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+examples = payload["examples"]
+assert len(examples) >= 7, [e["example"] for e in examples]
+strict = 0
+for e in examples:
+    assert "build_error" not in e, e
+    gate = [f for f in e["findings"] if f["severity"] != "INFO"]
+    assert gate == [], (e["example"], gate)
+    planner = e.get("planner")
+    if planner is None:
+        continue  # nothing to decide (no tolerant float boundary)
+    assert planner["planned_cost_bytes"] <= planner["default_cost_bytes"], e
+    if planner["planned_cost_bytes"] < planner["default_cost_bytes"]:
+        strict += 1
+assert strict >= 2, f"precision planner strictly won on only {strict} example(s)"
+saved = sum((e.get("planner") or {}).get("savings_bytes", 0) for e in examples)
+print(f"precision audit: {len(examples)} example(s), strict wins on {strict}, "
+      f"{saved:,} boundary bytes saved, 0 KP7xx under chosen policies OK")
+PY
+
 echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
 TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_SMOKE_TRACE="$TRACE_TMP" python - <<'PY'
 import json, os
 import numpy as np
@@ -109,7 +142,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
 
 echo "== dispatch smoke (example pipeline under the concurrent scheduler) =="
 DISPATCH_TRACE="$(mktemp /tmp/keystone_dispatch_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_TRACE="$DISPATCH_TRACE" KEYSTONE_CONCURRENT_DISPATCH=1 \
 python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance) run
@@ -141,7 +174,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
 echo "== compile smoke (warm second run performs 0 cold compiles) =="
 COMPILE_CACHE="$(mktemp -d /tmp/keystone_compile_smoke.XXXXXX)"
 COMPILE_TRACE="$(mktemp /tmp/keystone_compile_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$COMPILE_CACHE" \
 KEYSTONE_TRACE="$COMPILE_TRACE" python - <<'PY'
 # One example pipeline run TWICE against a fresh persistent-cache dir
@@ -185,7 +218,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
 echo "== megafusion smoke (1-program apply run; warm repeat stays 0-cold) =="
 MEGA_CACHE="$(mktemp -d /tmp/keystone_mega_smoke.XXXXXX)"
 MEGA_TRACE="$(mktemp /tmp/keystone_mega_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_MEGAFUSION=1 KEYSTONE_COMPILE_CACHE="$MEGA_CACHE" \
 KEYSTONE_TRACE="$MEGA_TRACE" python - <<'PY'
 # One example apply run TWICE under megafusion against a fresh

@@ -617,6 +617,75 @@ def test_kj008_suppression(tmp_path):
     assert jl.lint_file(f) == []
 
 
+def test_kj011_flags_literal_f32_casts_in_fused_bodies(tmp_path):
+    """KJ011: literal float32 casts/scalars inside `fuse()` /
+    `_chunk_loop` bodies silently re-promote bf16 boundaries and defeat
+    the precision policy. All three forms flag: `.astype(jnp.float32)`,
+    a bare `jnp.float32(...)` scalar (jnp promotion widens bf16 tensors
+    against it), and a `dtype=jnp.float32` / positional-dtype call
+    argument. Dtype-matched casts and code outside fused bodies pass."""
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "bad_precision.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def fuse(self):\n"
+        "        def fn(p, x):\n"
+        "            a = x.astype(jnp.float32)\n"            # KJ011
+        "            b = a - jnp.float32(0.5)\n"             # KJ011
+        "            c = jnp.asarray(b, jnp.float32)\n"      # KJ011
+        "            d = jnp.zeros(3, dtype=jnp.float32)\n"  # KJ011
+        "            e = jnp.asarray(0.5, x.dtype)\n"        # ok: matched
+        "            return c + d.sum() + e\n"
+        "        return ((\"T\",), (), fn)\n"
+        "\n"
+        "    def _chunk_loop(self, fn, params, xs, ms):\n"
+        "        return fn(params, xs.astype(jnp.float32), ms)\n"  # KJ011
+        "\n"
+        "    def _build_program(self, mesh, shards, n, treedef, fns):\n"
+        "        def per_shard(flat, xs, ms):\n"
+        "            return xs.astype(jnp.float32)\n"           # KJ011
+        "        return per_shard\n"
+        "\n"
+        "    def apply(self, x):\n"
+        "        return x.astype(jnp.float32)\n"  # ok: not a fused body\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ011"] * 6
+    assert sorted(f.line for f in findings) == [7, 8, 9, 10, 16, 20]
+
+    # outside nodes/ and workflow/, KJ011 does not apply
+    elsewhere = tmp_path / "loaders" / "ok_precision.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj011_suppression(tmp_path):
+    """A genuine kernel constraint (RFFT accepts only f32/f64, uint8
+    pixel decode) suppresses line-by-line with a rationale."""
+    jl = _jaxlint()
+    src = tmp_path / "workflow" / "suppressed_precision.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def fuse(self):\n"
+        "        def fn(p, x):\n"
+        "            # rfft accepts only f32/f64: widening is the\n"
+        "            # kernel's contract, not a policy leak\n"
+        "            return x.astype(jnp.float32)"
+        "  # keystone: ignore[KJ011]\n"
+        "        return ((\"T\",), (), fn)\n"
+    )
+    assert jl.lint_file(src) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""
