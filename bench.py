@@ -261,6 +261,19 @@ def finalize_record(detail):
             "serial unfused f32 reference (dispatch_count tier "
             "precision_in_band=false)")
         return rec, False
+    # decision-ledger verdict: every enforced optimizer decision the
+    # measured plans made must appear in the ledger with a prediction
+    # the observed program counts agree with (dispatch_count tier's
+    # `decisions_reconciled`). A plan the ledger cannot account for is
+    # an observability regression, not a perf win.
+    if isinstance(dispatch_tier, dict) \
+            and dispatch_tier.get("decisions_reconciled") is False:
+        rec["error"] = (
+            "optimizer decisions and the decision ledger disagree: a "
+            "megafused 1-program apply run lacks a matching megafusion "
+            "decision record (dispatch_count tier "
+            "decisions_reconciled=false)")
+        return rec, False
     return rec, detail.get("platform") != "cpu"
 
 
@@ -432,6 +445,19 @@ ACC_BAND = (0.72, 0.96)
 
 V5E_PEAK_FLOPS = 1.97e14  # bf16 MXU
 V5E_PEAK_BW = 8.19e11     # HBM bytes/s
+
+
+def _ledger_artifact():
+    """The decision-ledger JSONL path this run appends to: explicit
+    ``KEYSTONE_LEDGER``, else the traced run's default
+    ``<trace>.ledger.jsonl`` companion, else None (untraced, unarmed
+    runs keep decisions in memory only)."""
+    try:
+        from keystone_tpu.telemetry import ledger
+
+        return ledger.resolve_ledger_path()
+    except Exception:
+        return None
 
 
 def _roofline(flops, bytes_, seconds):
@@ -887,6 +913,12 @@ def child_main(args):
         # the path so BENCH rounds keep span-level detail
         # (`scripts/perf_table.py --trace <path>` to render).
         "trace_artifact": os.environ.get("KEYSTONE_TRACE") or None,
+        # The decision ledger the same run appends (KEYSTONE_LEDGER, or
+        # derived alongside the trace artifact): every optimizer
+        # decision the tiers enforced, with predicted costs —
+        # `python -m keystone_tpu.telemetry --ledger <path>` renders it,
+        # `--diff` compares two rounds' ledgers.
+        "ledger_artifact": _ledger_artifact(),
     }
     # Checkpoint: a wedge during the staged/flagship phases still leaves
     # a live headline measurement in the parent's hands.

@@ -478,6 +478,12 @@ class ShardingPlan:
     default_cost_bytes: float
     planned_boundary: Dict[NodeId, int] = field(default_factory=dict)
     default_boundary: Dict[NodeId, int] = field(default_factory=dict)
+    #: every complete assignment the solver actually scored, priced by
+    #: the shared cost function: ``[{"entry", "objective", "cost_bytes"},
+    #: ...]`` — the decision ledger's alternatives menu (the candidates
+    #: used to be computed and thrown away; now they are the audit
+    #: trail of what the chosen plan beat).
+    scored_candidates: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def improved(self) -> bool:
@@ -718,12 +724,24 @@ def plan_sharding(
         return (fams_b, obj_b) if obj_b < obj_a else (fams_a, obj_a)
 
     best_fams = dict(frozen)
-    best_obj, _, _ = model.score(best_fams)
+    best_obj, dp_bytes, _ = model.score(best_fams)
     uniform = {
         vid: (FAMILY_DATA if FAMILY_DATA in model.menus[vid]
               else default_families[vid])
         for vid in model.menus
     }
+    uniform_obj, uniform_bytes, _ = model.score(uniform)
+    # the scored-candidate menu the ledger exposes: every complete
+    # assignment priced by the same function (the chosen plan's own
+    # entry is appended after descent below)
+    scored_candidates = [
+        {"entry": "default", "objective": float(default_obj),
+         "cost_bytes": float(default_bytes)},
+        {"entry": "chain_dp", "objective": float(best_obj),
+         "cost_bytes": float(dp_bytes)},
+        {"entry": "uniform_data", "objective": float(uniform_obj),
+         "cost_bytes": float(uniform_bytes)},
+    ]
     best_fams, best_obj = pick(best_fams, best_obj, uniform)
     for _sweep in range(3):
         changed = False
@@ -744,6 +762,9 @@ def plan_sharding(
 
     frozen = best_fams
     planned_obj, planned_bytes, planned_boundary = model.score(frozen)
+    scored_candidates.append(
+        {"entry": "local_descent", "objective": float(planned_obj),
+         "cost_bytes": float(planned_bytes)})
 
     # the plan wins only when BOTH the full objective (bytes +
     # per-reshard penalties + feasibility) and the pure byte total are
@@ -765,4 +786,5 @@ def plan_sharding(
         default_cost_bytes=default_bytes,
         planned_boundary=planned_boundary,
         default_boundary=default_boundary,
+        scored_candidates=scored_candidates,
     )

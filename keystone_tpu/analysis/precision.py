@@ -597,20 +597,53 @@ def stage_tolerance(stage, graph: Graph = None, vid: NodeId = None,
     return declared_tolerance(stage) or EXACT
 
 
+def stage_policy_menu(saved: List[Optional[int]],
+                      legal: List[bool]) -> List[Dict[str, Any]]:
+    """The priced candidate menu `_plan_path` decides over: one entry
+    per maximal legal bf16 run of boundaries, carrying the bytes the
+    run would save, the cast penalty it must clear, and whether the DP
+    kept it. This is the decision core's own scoring made visible —
+    the decision ledger records it as the alternatives the chosen
+    policy beat (a rejected run IS a priced alternative: enabling it
+    would cost ``2·CAST_PENALTY_BYTES − saved`` net bytes)."""
+    menu: List[Dict[str, Any]] = []
+    i = 0
+    while i < len(saved):
+        if not legal[i] or not saved[i]:
+            i += 1
+            continue
+        j = i
+        total = 0
+        while j < len(saved) and legal[j] and saved[j]:
+            total += saved[j]
+            j += 1
+        menu.append({
+            "entry": f"bf16_boundaries_{i}..{j - 1}",
+            "bytes_saved": int(total),
+            "cast_penalty_bytes": 2 * CAST_PENALTY_BYTES,
+            "kept": total > 2 * CAST_PENALTY_BYTES,
+        })
+        i = j
+    return menu
+
+
 def plan_stage_precision(
     graph: Graph,
     vid: NodeId,
     op,
     specs: Dict[GraphId, Any],
-) -> Optional[Tuple[Tuple[Optional[str], ...], int]]:
+) -> Optional[Tuple[Tuple[Optional[str], ...], int, List[Dict[str, Any]]]]:
     """Per-internal-boundary storage policy for one fused/megafused
-    program operator: ``(storage_names, savings_bytes)`` where
+    program operator: ``(storage_names, savings_bytes, menu)`` where
     ``storage_names[i]`` is the dtype name stage ``i``'s output is cast
     to inside the program (None = untouched), aligned with the
     operator's PEEPHOLED stage list (the list `_build_program`
-    executes). The program's final output boundary always stays
-    untouched so downstream consumers see exactly the PR-9 dtypes.
-    Returns None when the trail cannot be priced (unknown elements)."""
+    executes), and ``menu`` is the `stage_policy_menu` of priced
+    candidate runs the chain DP scored (kept and rejected — the
+    decision ledger's alternatives). The program's final output
+    boundary always stays untouched so downstream consumers see
+    exactly the PR-9 dtypes. Returns None when the trail cannot be
+    priced (unknown elements)."""
     from ..nodes.util.fusion import _peephole
     from ..workflow.fusion_rule import _FitSlot
 
@@ -676,6 +709,7 @@ def plan_stage_precision(
         for i in range(n - 1)
     ] + [False]
     keep = _plan_path(saved_bytes, legal)
+    menu = stage_policy_menu(saved_bytes, legal)
 
     # Every kept bf16 run must be RESTORED at its exit boundary: the
     # fused stage bodies deliberately follow their input dtype (the
@@ -702,6 +736,14 @@ def plan_stage_precision(
                 storage[k] = "bfloat16"
                 savings += saved_bytes[k] or 0
             storage[j] = exit_restore
+        else:
+            # the DP kept the run but the exit boundary cannot re-assert
+            # its dtype: the run is dropped — the menu must say so, or
+            # the ledger would record an alternative as chosen
+            for entry in menu:
+                if entry["entry"] == f"bf16_boundaries_{i}..{j - 1}":
+                    entry["kept"] = False
+                    entry["dropped"] = "unrestorable_exit_boundary"
         i = j
     # defensive: always re-assert the program's visible output dtype
     # when it is known (a same-dtype astype is an identity, so an
@@ -710,7 +752,7 @@ def plan_stage_precision(
         storage[n - 1] = restore_names[n - 1]
     if not savings:
         return None
-    return tuple(storage), int(savings)
+    return tuple(storage), int(savings), menu
 
 
 # ------------------------------------------------------------------- lints

@@ -1,4 +1,5 @@
-"""Static-vs-observed memory reconciliation.
+"""Static-vs-observed reconciliation: memory bytes AND optimizer
+decisions.
 
 "Memory Safe Computations with XLA Compiler" (arxiv 2206.14148) builds
 its case on compile-time memory estimates being *checked* against
@@ -16,6 +17,21 @@ Keys are ``"<vertex_id>:<label>"``: vertex ids are per-graph, so the
 label disambiguates the common fit-graph/apply-graph id collisions; a
 node forced in several executors under the same key keeps its largest
 observed force (peak residency is what the static model predicts).
+
+PR 11 widens the loop from memory bytes to the whole decision space
+(`telemetry.ledger` records what the optimizer decided and predicted;
+this module says what the run observably did):
+
+  - `reconcile_decisions` joins a run's decision ledger against its
+    trace — predicted vs observed programs-executed / programs-compiled
+    / megafused programs / baked casts at the run level, and per
+    decision the matching span forces and boundary bytes;
+  - `cost_model_drift` recomputes the calibrated cost-weight residuals
+    from observed span timings (seconds-per-byte over the run's node
+    forces vs the `nodes.learning.cost_model` weights), the
+    recalibration input the unified plan optimizer needs — and
+    `drift_cost_weights` packages it as a
+    `nodes.learning.calibrate.CostWeights`.
 """
 
 from __future__ import annotations
@@ -112,6 +128,289 @@ def reconcile_trace(trace: Dict[str, Any]) -> Dict[str, Any]:
         "static_per_device_peak_bytes": (
             (ks.get("static_memory") or {}).get("per_device_peak_bytes")),
     }
+
+
+# ------------------------------------------------- decision reconciliation
+
+
+def _node_spans_by_label(trace: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """label → {forces, out_bytes(max)} over ``cat="node"`` spans (the
+    fit/apply vertex-id split collapsed — decisions key on labels)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("cat") != "node":
+            continue
+        name = e.get("name", "")
+        if name.startswith("force "):
+            name = name[len("force "):]
+        rec = out.setdefault(name, {"forces": 0, "out_bytes": 0.0})
+        rec["forces"] += 1
+        rec["out_bytes"] = max(
+            rec["out_bytes"],
+            float(e.get("args", {}).get("out_bytes", 0.0) or 0.0))
+    return out
+
+
+def _counter_value(trace: Dict[str, Any], name: str) -> Optional[float]:
+    c = (trace.get("keystone", {}).get("metrics", {})
+         .get("counters", {}).get(name))
+    return float(c["value"]) if c and "value" in c else None
+
+
+def reconcile_decisions(run: Dict[str, Any]) -> Dict[str, Any]:
+    """Join a run's decision ledger (`telemetry.ledger.read_ledger`)
+    against its trace: what was decided and predicted vs what the run
+    observably did.
+
+    Returns ``{"rows", "run_predicted", "run_observed", "residuals"}``:
+
+      - ``rows`` — one row per decision: ``{seq, kind, labels,
+        predicted, observed, residuals}``. Fusion/megafusion rows
+        observe the fused program's span forces and output bytes
+        (megafused programs via their ``megafused_program`` spans);
+        placement rows observe the changed stages' boundary bytes and
+        carry the predicted-minus-observed byte residual; precision
+        rows observe their program's span bytes.
+      - ``run_predicted`` / ``run_observed`` / ``residuals`` — the
+        run-level predicted-vs-observed join: ``programs_executed``
+        (sum of the megafusion decisions' chosen program counts — exact
+        on a trace covering one apply run of a fully megafused plan,
+        which is what the exactness tests pin), ``programs_compiled``
+        (cold-compile upper bound vs the compile counter),
+        ``megafused_programs``, ``casts_baked``, and
+        ``boundary_bytes_saved`` (predicted only — the savings the
+        placement/precision decisions priced).
+
+    Registry counters in a trace are process-cumulative: reset the
+    registry (or use a fresh process) when a run-exact join is needed —
+    the bench child processes and the lint smoke both do."""
+    from ..telemetry.ledger import decision_key
+
+    trace = run.get("trace") or {}
+    decisions = run.get("decisions") or []
+    by_label = _node_spans_by_label(trace)
+    mega_spans = [
+        e for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and e.get("name") == "megafused_program"
+    ]
+
+    unique: Dict = {}
+    for d in decisions:
+        unique.setdefault(decision_key(d), d)
+
+    rows: List[Dict[str, Any]] = []
+    for d in decisions:
+        pred = d.get("predicted") or {}
+        observed: Dict[str, Any] = {}
+        residuals: Dict[str, Any] = {}
+        labels = d.get("labels") or []
+        kind = d.get("kind")
+        if kind == "megafusion":
+            n = len(mega_spans)
+            n_mega_decisions = sum(
+                1 for k in unique if k[0] == "megafusion")
+            observed["programs_executed"] = n
+            if n and n_mega_decisions == 1 \
+                    and "programs_per_apply" in pred:
+                # exact only when the trace covers one apply run of the
+                # one megafused program — the pinned-test shape; a
+                # longer trace shows the positive residual honestly
+                residuals["programs_per_apply"] = (
+                    pred["programs_per_apply"] - n)
+            trips = sum(
+                float(e.get("args", {}).get("scan_trips", 0) or 0)
+                for e in mega_spans)
+            if trips:
+                observed["scan_trips"] = int(trips)
+        elif kind == "fusion":
+            # the fused program's span label embeds its member labels
+            hits = [v for lbl, v in by_label.items()
+                    if labels and labels[0] in lbl]
+            if hits:
+                observed["forces"] = sum(h["forces"] for h in hits)
+                observed["out_bytes"] = max(h["out_bytes"] for h in hits)
+        elif kind == "placement":
+            total = 0.0
+            found = False
+            for lbl in labels:
+                for span_lbl, v in by_label.items():
+                    if lbl and lbl in span_lbl:
+                        total += v["out_bytes"]
+                        found = True
+                        break
+            if found:
+                observed["boundary_bytes"] = total
+                if "boundary_bytes" in pred:
+                    residuals["boundary_bytes"] = (
+                        float(pred["boundary_bytes"]) - total)
+        elif kind == "precision":
+            hits = [v for lbl, v in by_label.items()
+                    if labels and labels[0] in lbl]
+            if hits:
+                observed["out_bytes"] = max(h["out_bytes"] for h in hits)
+        rows.append({
+            "seq": d.get("seq"),
+            "kind": kind,
+            "labels": labels,
+            "predicted": pred,
+            "observed": observed,
+            "residuals": residuals,
+        })
+
+    run_predicted: Dict[str, Any] = {}
+    mega_unique = [d for k, d in unique.items() if k[0] == "megafusion"]
+    if mega_unique:
+        run_predicted["programs_executed"] = sum(
+            int((d.get("chosen") or {}).get("programs", 1))
+            for d in mega_unique)
+        run_predicted["megafused_programs"] = len(mega_unique)
+    compile_max = sum(
+        int((d.get("predicted") or {}).get("cold_compiles_max", 0))
+        for k, d in unique.items() if k[0] in ("fusion", "megafusion"))
+    if compile_max:
+        run_predicted["programs_compiled_max"] = compile_max
+    casts = sum(
+        int((d.get("predicted") or {}).get("casts_baked", 0))
+        for k, d in unique.items() if k[0] == "precision")
+    if any(k[0] == "precision" for k in unique):
+        run_predicted["casts_baked"] = casts
+    saved = sum(
+        int((d.get("predicted") or {}).get("boundary_bytes_saved", 0))
+        + int((d.get("predicted") or {}).get("policy_bytes_saved", 0))
+        for d in unique.values())
+    if saved:
+        run_predicted["boundary_bytes_saved"] = saved
+
+    run_observed: Dict[str, Any] = {}
+    for metric, counter_name in (
+            ("programs_executed", "dispatch.programs_executed"),
+            ("programs_compiled", "dispatch.programs_compiled"),
+            ("megafused_programs", "megafusion.programs"),
+            ("casts_baked", "precision.casts_baked")):
+        v = _counter_value(trace, counter_name)
+        if v is not None:
+            run_observed[metric] = v
+
+    residuals: Dict[str, Any] = {}
+    for metric in set(run_predicted) & set(run_observed):
+        residuals[metric] = run_predicted[metric] - run_observed[metric]
+    if "programs_compiled_max" in run_predicted \
+            and "programs_compiled" in run_observed:
+        residuals["programs_compiled"] = (
+            run_predicted["programs_compiled_max"]
+            - run_observed["programs_compiled"])
+
+    return {
+        "rows": rows,
+        "run_predicted": run_predicted,
+        "run_observed": run_observed,
+        "residuals": residuals,
+    }
+
+
+def format_decision_reconciliation(rec: Dict[str, Any]) -> str:
+    lines = ["== decisions: predicted vs observed (run level) =="]
+    keys = sorted(set(rec["run_predicted"]) | set(rec["run_observed"]))
+    if not keys:
+        lines.append("(no run-level quantities on both sides)")
+    for k in keys:
+        p = rec["run_predicted"].get(k)
+        o = rec["run_observed"].get(k)
+        r = rec["residuals"].get(k)
+        lines.append(
+            f"{k:<24} predicted={'—' if p is None else p:>12} "
+            f"observed={'—' if o is None else o:>12} "
+            f"residual={'—' if r is None else r}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------- cost-model drift
+
+
+def cost_model_drift(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Recompute the cost-weight residuals from observed span timings —
+    the trace-recalibration input the unified plan optimizer's priced
+    menus need (ROADMAP). Every optimizer decision since PR 8 is priced
+    by ``cost = cpu_weight·flops + mem_weight·bytes +
+    network_weight·collective_bytes``; a run's node spans carry
+    ``seconds`` and ``out_bytes``, so the observed seconds-per-byte over
+    the run bounds the effective ``mem_weight`` (HBM + transport) the
+    plan actually experienced. FLOPs and collective bytes are not span
+    observables, so ``cpu_weight``/``network_weight`` report unmeasured
+    (``implied=None``) and keep their current values in the suggestion —
+    a MULTICHIP run's collective spans can widen this later.
+
+    Returns ``{"rows": [{weight, current, implied, ratio}],
+    "suggested": {cpu_weight, mem_weight, network_weight},
+    "observed_bytes", "observed_seconds", "spans"}``."""
+    from ..nodes.learning import cost_model
+
+    total_b = 0.0
+    total_s = 0.0
+    n = 0
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("cat") != "node":
+            continue
+        args = e.get("args", {})
+        b = float(args.get("out_bytes", 0.0) or 0.0)
+        s = float(args.get("seconds", 0.0) or 0.0)
+        if b > 0 and s > 0:
+            total_b += b
+            total_s += s
+            n += 1
+    implied_mem = (total_s / total_b) if total_b else None
+    current = {
+        "cpu_weight": float(cost_model.CPU_WEIGHT),
+        "mem_weight": float(cost_model.MEM_WEIGHT),
+        "network_weight": float(cost_model.NETWORK_WEIGHT),
+    }
+    rows = []
+    for name, implied in (("cpu_weight", None),
+                          ("mem_weight", implied_mem),
+                          ("network_weight", None)):
+        rows.append({
+            "weight": name,
+            "current": current[name],
+            "implied": implied,
+            "ratio": (implied / current[name]) if implied else None,
+        })
+    suggested = dict(current)
+    if implied_mem:
+        suggested["mem_weight"] = implied_mem
+    return {
+        "rows": rows,
+        "suggested": suggested,
+        "observed_bytes": total_b,
+        "observed_seconds": total_s,
+        "spans": n,
+    }
+
+
+def drift_cost_weights(trace: Dict[str, Any]):
+    """The drift report as a `nodes.learning.calibrate.CostWeights` —
+    the exact type `calibrate.calibrate_cost_weights` returns, so the
+    recalibration feed is drop-in for every `CostModel.cost(...)`
+    consumer."""
+    from ..nodes.learning.calibrate import CostWeights
+
+    s = cost_model_drift(trace)["suggested"]
+    return CostWeights(s["cpu_weight"], s["mem_weight"],
+                       s["network_weight"])
+
+
+def format_drift(drift: Dict[str, Any]) -> str:
+    lines = ["== cost-model drift (observed span timings vs calibrated "
+             "weights) =="]
+    for r in drift["rows"]:
+        implied = (f"{r['implied']:.3e}" if r["implied"] else "unmeasured")
+        ratio = (f"×{r['ratio']:.2f}" if r["ratio"] else "—")
+        lines.append(
+            f"{r['weight']:<16} current={r['current']:.3e} "
+            f"implied={implied:>12} drift={ratio}")
+    lines.append(
+        f"({drift['spans']} span(s), {_fmt(drift['observed_bytes'])} over "
+        f"{drift['observed_seconds']:.4f}s)")
+    return "\n".join(lines)
 
 
 def _fmt(n: Optional[float]) -> str:

@@ -62,7 +62,7 @@ def _run_example(name: str, ragged_test: bool, plan: str = "megafused"):
     optimizer plan (``megafused`` — the default plan — or
     ``optimized``, the PR-4/5 plan, for breakdown rows)."""
     from .dispatch_bench import _plan_context
-    from .telemetry import counter
+    from .telemetry import metrics_delta
     from .workflow.env import PipelineEnv, config_override
 
     optimizer, _, _, overrides = _plan_context(plan)
@@ -80,13 +80,12 @@ def _run_example(name: str, ragged_test: bool, plan: str = "megafused"):
 
                 n = test.count - max(1, test.n_shards // 2) - 1
                 test = Dataset(test.numpy(), count=n)
-            execd = counter("dispatch.programs_executed")
             t0 = time.perf_counter()
             before = _snapshot()
             train_pred = np.asarray(predictor(train).get().numpy())
             mid = _snapshot()
-            e_before = execd.value
-            test_pred = np.asarray(predictor(test).get().numpy())
+            with metrics_delta() as d_apply:
+                test_pred = np.asarray(predictor(test).get().numpy())
             seconds = time.perf_counter() - t0
             after = _snapshot()
             return {
@@ -94,7 +93,8 @@ def _run_example(name: str, ragged_test: bool, plan: str = "megafused"):
                 "seconds": round(seconds, 4),
                 "compiles": _delta(before, after),
                 "apply_compiles": _delta(mid, after),
-                "apply_programs_executed": int(execd.value - e_before),
+                "apply_programs_executed": int(
+                    d_apply.counter("dispatch.programs_executed")),
                 "train_pred": train_pred,
                 "test_pred": test_pred,
             }

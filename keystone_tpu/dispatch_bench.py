@@ -205,8 +205,10 @@ def _plan_context(plan: str):
 
 def measure_example(name: str, plan: str) -> Dict:
     """Run one example under one plan from a clean `PipelineEnv`,
-    returning program counts and the (host) predictions of both runs."""
-    from .telemetry import counter
+    returning program counts, the (host) predictions of both runs, and
+    the optimizer decisions the window recorded (the decision-ledger
+    slice the `decisions_reconciled` bench verdict audits)."""
+    from .telemetry import ledger, metrics_delta
     from .workflow.env import (
         PipelineEnv,
         config_override,
@@ -216,21 +218,22 @@ def measure_example(name: str, plan: str) -> Dict:
 
     optimizer, overlap_on, concurrent_on, overrides = _plan_context(plan)
     PipelineEnv.reset()
+    mark = ledger.session_mark()
     try:
         PipelineEnv.get().set_optimizer(optimizer)
         with overlap_override(overlap_on), \
                 dispatch_override(concurrent_on), \
                 config_override(**overrides):
             predictor, train, test = EXAMPLES[name]()
-            c = counter("dispatch.programs_executed")
-            before = c.value
-            train_pred = np.asarray(predictor(train).get().numpy())
-            fit_programs = c.value - before
-            before = c.value
-            test_pred = np.asarray(predictor(test).get().numpy())
-            apply_programs = c.value - before
+            with metrics_delta() as d:
+                train_pred = np.asarray(predictor(train).get().numpy())
+            fit_programs = d.counter("dispatch.programs_executed")
+            with metrics_delta() as d:
+                test_pred = np.asarray(predictor(test).get().numpy())
+            apply_programs = d.counter("dispatch.programs_executed")
     finally:
         PipelineEnv.reset()
+    decisions = ledger.session_since(mark)
     from .telemetry import current_tracer
 
     tracer = current_tracer()
@@ -249,6 +252,7 @@ def measure_example(name: str, plan: str) -> Dict:
         "apply_run_programs": int(apply_programs),
         "train_pred": train_pred,
         "test_pred": test_pred,
+        "decisions": decisions,
     }
 
 
@@ -267,11 +271,14 @@ def dispatch_count_report(
     trace."""
     from .analysis.precision import DEFAULT_BAND_ATOL, DEFAULT_BAND_RTOL
 
+    from .telemetry.ledger import decision_key
+
     out: Dict = {"examples": {}, "plans": list(PLANS),
                  "plan_breakdown": []}
     reductions: List[float] = []
     mega_one = 0
     precision_in_band = True
+    decisions_reconciled = True
     for name in examples:
         runs = {plan: measure_example(name, plan) for plan in PLANS}
         base = runs["serial_unfused"]
@@ -311,6 +318,21 @@ def dispatch_count_report(
                        if mega["apply_run_programs"] else float("inf"))
         reductions.append(apply_ratio)
         mega_one += int(mega["apply_run_programs"] == 1)
+        # the decision-ledger verdict: a megafused plan that executed its
+        # apply run as ONE program must have RECORDED that decision, and
+        # the record's prediction must say exactly that — the enforced
+        # plan and the ledger cannot disagree (bench.finalize_record
+        # fails records where they do)
+        mega_uniq: Dict = {}
+        for d in mega.get("decisions") or []:
+            if d.get("kind") == "megafusion":
+                mega_uniq.setdefault(decision_key(d), d)
+        ex_reconciled = bool(
+            mega["apply_run_programs"] != 1 or (
+                mega_uniq and all(
+                    (d.get("predicted") or {}).get("programs_per_apply") == 1
+                    for d in mega_uniq.values())))
+        decisions_reconciled &= ex_reconciled
         out["examples"][name] = {
             "apply_run_programs": {
                 p: runs[p]["apply_run_programs"] for p in PLANS},
@@ -325,6 +347,10 @@ def dispatch_count_report(
                 / max(1, mega["apply_run_programs"]), 2),
             "outputs_match_serial_unfused": bool(outputs_match),
             "precision_in_band": bool(in_band),
+            "decisions_reconciled": ex_reconciled,
+            "decision_counts": {
+                p: _kind_counts(runs[p].get("decisions") or [])
+                for p in PLANS},
         }
         # the per-plan breakdown row: one flat record per example, the
         # shape perf_table.py / the trace CLI print verbatim (the
@@ -344,4 +370,13 @@ def dispatch_count_report(
     out["all_outputs_match"] = all(
         e["outputs_match_serial_unfused"] for e in out["examples"].values())
     out["precision_in_band"] = bool(precision_in_band)
+    out["decisions_reconciled"] = bool(decisions_reconciled)
+    return out
+
+
+def _kind_counts(decisions: List[Dict]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for d in decisions:
+        k = str(d.get("kind"))
+        out[k] = out.get(k, 0) + 1
     return out

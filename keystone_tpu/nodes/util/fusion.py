@@ -555,6 +555,15 @@ class FusedBatchTransformer(Transformer):
         if planned_prec is not None and len(planned_prec) != len(fns):
             planned_prec = None
         matmul_prec = self.planned_matmul_precision
+        if planned_prec is not None:
+            # the OBSERVED side of the precision decision's cast count:
+            # each non-None entry becomes one convert_element_type in
+            # the traced program, counted at build time (the ledger's
+            # predicted `casts_baked` reconciles against this)
+            from ...telemetry import counter as _counter
+
+            _counter("precision.casts_baked").inc(
+                sum(1 for p in planned_prec if p is not None))
 
         def chunk_fn(params, xb, mb):
             for i, (f, p) in enumerate(zip(fns, params)):

@@ -25,12 +25,15 @@ from .metrics import (
     Counter,
     Gauge,
     Histogram,
+    MetricsDelta,
     MetricsRegistry,
     counter,
     gauge,
     histogram,
+    metrics_delta,
     registry,
 )
+from . import ledger
 from .spans import (
     SpanRecord,
     Tracer,
@@ -64,8 +67,9 @@ from .compile_events import compiles_snapshot, install_compile_listeners
 install_compile_listeners()
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "counter", "gauge", "histogram", "registry",
+    "Counter", "Gauge", "Histogram", "MetricsDelta", "MetricsRegistry",
+    "counter", "gauge", "histogram", "ledger", "metrics_delta",
+    "registry",
     "SpanRecord", "Tracer", "capabilities", "current_tracer",
     "record_capability", "set_tracer", "span", "telemetry_active",
     "trace_run",

@@ -1,12 +1,30 @@
-"""Trace summary CLI.
+"""Trace summary + decision ledger CLI.
 
     python -m keystone_tpu.telemetry run.json [--top N] [--json]
+    python -m keystone_tpu.telemetry --ledger <run> [--json]
+    python -m keystone_tpu.telemetry --diff <run_a> <run_b> [--json]
 
-Prints the span digest (top nodes by self-time, solver iteration and
-stream-chunk totals), overlap queue-stall totals, bytes moved, and —
-when the trace carries the static analyzer's estimates — the
-static-vs-observed memory reconciliation table that calibrates the
-KP2xx model (see OBSERVABILITY.md; rule catalog in ANALYSIS.md).
+The trace form prints the span digest (top nodes by self-time, solver
+iteration and stream-chunk totals), overlap queue-stall totals, bytes
+moved, and — when the trace carries the static analyzer's estimates —
+the static-vs-observed memory reconciliation table that calibrates the
+KP2xx model.
+
+``--ledger`` renders a run's decision ledger (a ``KEYSTONE_LEDGER``
+JSONL file or a trace whose metadata embeds the decisions): one row per
+optimizer decision — chosen entry, best-priced runner-up, predicted
+cost — joined, when the run's trace is reachable, with the observed
+values and residuals (`analysis.reconcile.reconcile_decisions`) plus
+the cost-model drift report (`cost_model_drift`).
+
+``--diff`` is run-over-run regression detection between two runs'
+ledgers: config kill-switch flips are named by env var (an injected
+``KEYSTONE_MEGAFUSION=0`` reads as exactly that), removed/added
+decisions, prediction drift, and observed regressions from the two
+reconciliations. Exit code 1 when any regression is reported — the
+lint-gate contract (a run diffed against itself exits 0).
+
+See OBSERVABILITY.md; rule catalog in ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -18,18 +36,109 @@ import sys
 from .export import aggregate_spans, load_trace, summarize
 
 
+def _read_run(path: str):
+    from .ledger import read_ledger
+
+    try:
+        return read_ledger(path)
+    except (OSError, ValueError) as e:
+        print(f"error: {path}: {e}", file=sys.stderr)
+        return None
+
+
+def _reconcile(run):
+    if not run.get("trace"):
+        return None
+    try:
+        from ..analysis.reconcile import reconcile_decisions
+
+        return reconcile_decisions(run)
+    except Exception:
+        return None
+
+
+def _ledger_main(path: str, as_json: bool) -> int:
+    from .ledger import render_ledger
+
+    run = _read_run(path)
+    if run is None:
+        return 2
+    rec = _reconcile(run)
+    drift = None
+    if run.get("trace"):
+        try:
+            from ..analysis.reconcile import cost_model_drift
+
+            drift = cost_model_drift(run["trace"])
+        except Exception:
+            drift = None
+    if as_json:
+        json.dump({
+            "header": run["header"],
+            "decisions": run["decisions"],
+            "reconciliation": rec,
+            "cost_model_drift": drift,
+        }, sys.stdout, indent=1, default=str)
+        print()
+        return 0
+    print(render_ledger(run, reconciliation=rec))
+    if rec is not None:
+        from ..analysis.reconcile import format_decision_reconciliation
+
+        print()
+        print(format_decision_reconciliation(rec))
+    if drift is not None:
+        from ..analysis.reconcile import format_drift
+
+        print()
+        print(format_drift(drift))
+    return 0
+
+
+def _diff_main(path_a: str, path_b: str, as_json: bool) -> int:
+    from .ledger import diff_runs, format_diff
+
+    run_a = _read_run(path_a)
+    run_b = _read_run(path_b)
+    if run_a is None or run_b is None:
+        return 2
+    diff = diff_runs(run_a, run_b,
+                     reconciliation_a=_reconcile(run_a),
+                     reconciliation_b=_reconcile(run_b))
+    if as_json:
+        json.dump(diff, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        print(format_diff(diff))
+    return 1 if diff["regressions"] else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m keystone_tpu.telemetry",
         description=__doc__.splitlines()[0],
     )
-    p.add_argument("trace", help="Chrome trace JSON written by trace_run / "
-                                 "KEYSTONE_TRACE")
+    p.add_argument("trace", nargs="?",
+                   help="Chrome trace JSON written by trace_run / "
+                        "KEYSTONE_TRACE")
     p.add_argument("--top", type=int, default=15,
                    help="rows per section (default 15)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable digest (perf_table.py input)")
+    p.add_argument("--ledger", metavar="RUN",
+                   help="render a run's decision ledger (JSONL file or "
+                        "decision-carrying trace) with the "
+                        "predicted-vs-observed reconciliation")
+    p.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
+                   help="run-over-run regression detection between two "
+                        "runs' ledgers (exit 1 on any regression)")
     args = p.parse_args(argv)
+    if args.diff:
+        return _diff_main(args.diff[0], args.diff[1], args.as_json)
+    if args.ledger:
+        return _ledger_main(args.ledger, args.as_json)
+    if not args.trace:
+        p.error("a trace path, --ledger, or --diff is required")
     try:
         trace = load_trace(args.trace)
     except (OSError, ValueError) as e:

@@ -222,6 +222,41 @@ def compile_summary(trace: Dict[str, Any]) -> Optional[str]:
             + _per_process_counts(counters, "dispatch.programs_compiled"))
 
 
+def decision_summary(trace: Dict[str, Any]) -> Optional[str]:
+    """One-line digest of the optimizer decisions embedded in the trace
+    metadata (`telemetry.ledger.record_decision` appends them under
+    ``keystone.decisions``): per-kind counts plus the predicted savings
+    totals, ending with the CLI pointer that renders the full
+    per-decision table. None when the trace carries no decisions."""
+    decisions = trace.get("keystone", {}).get("decisions") or []
+    if not decisions:
+        return None
+    from .ledger import decision_key
+
+    # dedup by (kind, labels): each optimizer invocation (fit graph,
+    # apply graph, plan sweeps) re-records the same decision — counting
+    # raw records would inflate the digest vs reconcile_decisions
+    unique: Dict = {}
+    for d in decisions:
+        unique.setdefault(decision_key(d), d)
+    kinds: Dict[str, int] = {}
+    bytes_saved = 0
+    for d in unique.values():
+        k = str(d.get("kind"))
+        kinds[k] = kinds.get(k, 0) + 1
+        pred = d.get("predicted") or {}
+        for key in ("boundary_bytes_saved", "policy_bytes_saved"):
+            v = pred.get(key)
+            if isinstance(v, (int, float)):
+                bytes_saved += int(v)
+    parts = [f"{kinds[k]} {k}" for k in sorted(kinds)]
+    line = (f"optimizer decisions: {len(unique)} distinct "
+            f"({', '.join(parts)}; {len(decisions)} record(s))")
+    if bytes_saved:
+        line += f", {_fmt_bytes(bytes_saved)} predicted saved"
+    return line + " — `--ledger` renders the per-decision table"
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -280,6 +315,10 @@ def summarize(trace: Dict[str, Any], top: int = 15) -> str:
         lines.extend(breakdown)
         if compiles:
             lines.append(compiles)
+    decisions = decision_summary(trace)
+    if decisions:
+        lines.append("\n== decisions ==")
+        lines.append(decisions)
     moved = counters.get("overlap.bytes_pulled", {}).get("value")
     if moved:
         lines.append(f"\nbytes pulled off device: {_fmt_bytes(moved)}")

@@ -132,9 +132,16 @@ def _record_node(label, vertex, profiler, dt, nbytes, failed,
     if streamed:
         tracer = current_tracer()
         if tracer is not None and t0_rel is not None:
+            # ts is the FIRST-pull timestamp (the drain window's start,
+            # not the completion time the record is written at) and dur
+            # stays the cumulative pull time — the consumer's
+            # between-chunk work is excluded from the stage's cost, so
+            # self-time math holds; drain_window_s carries the real
+            # first-pull→exhaustion extent for timeline readers
             tracer.record_complete(
                 f"force {label}", "node", t0_rel, dt, error=failed,
                 vertex=vertex, out_bytes=nbytes, seconds=round(dt, 6),
+                drain_window_s=round(max(0.0, tracer.now() - t0_rel), 6),
                 streamed=True)
     if profiler is not None:
         profiler.on_force(label, dt, nbytes, failed=failed, vertex=vertex)

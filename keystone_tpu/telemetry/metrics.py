@@ -198,6 +198,60 @@ def registry() -> MetricsRegistry:
     return _registry
 
 
+class MetricsDelta:
+    """Counter deltas over one measured window, against the
+    process-cumulative registry.
+
+    Every per-example measurement used to hand-roll
+    ``before = c.value; ...; c.value - before`` against the cumulative
+    counters; this is that idiom, once::
+
+        with metrics_delta() as d:
+            predictor(test).get()
+        programs = d.counter("dispatch.programs_executed")
+
+    ``counter(name)`` is the window's increment (0.0 for a counter that
+    did not exist or did not move); ``counters()`` is every nonzero
+    delta. Gauges and histograms are cumulative-by-design (high-water
+    marks, streaming totals) and are deliberately not delta'd here —
+    read their snapshots directly. Reentrant and thread-compatible: the
+    baseline is captured once at ``__enter__`` and never mutated."""
+
+    def __init__(self, reg: Optional[MetricsRegistry] = None):
+        self._registry = reg or _registry
+        self._base: Dict[str, float] = {}
+
+    def __enter__(self) -> "MetricsDelta":
+        with _LOCK:
+            self._base = {
+                name: c.value for name, c in self._registry.counters.items()
+            }
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def counter(self, name: str) -> float:
+        c = self._registry.counters.get(name)
+        current = c.value if c is not None else 0.0
+        return current - self._base.get(name, 0.0)
+
+    def counters(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        with _LOCK:
+            for name, c in self._registry.counters.items():
+                d = c.value - self._base.get(name, 0.0)
+                if d:
+                    out[name] = d
+        return out
+
+
+def metrics_delta(reg: Optional[MetricsRegistry] = None) -> MetricsDelta:
+    """Snapshot-delta context over the process-cumulative counter
+    registry (see `MetricsDelta`)."""
+    return MetricsDelta(reg)
+
+
 def counter(name: str) -> Counter:
     return _registry.counter(name)
 

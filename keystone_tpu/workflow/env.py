@@ -150,6 +150,17 @@ class ExecutionConfig:
     priced savings clear it. Tiny pipelines (tests, smoke runs) stay
     bit-identical to the PR-9 programs by construction; real featurize
     workloads clear the floor trivially. 0 enforces every strict win.
+
+    ``ledger_path`` (env ``KEYSTONE_LEDGER``) arms the decision ledger's
+    JSONL artifact: every optimizer decision (fusion chain, megafusion,
+    placement, precision policy) is appended as one structured record —
+    kind, affected vertices, the chosen entry AND its priced
+    alternatives, predicted cost in the shared units — after a run
+    header that snapshots the optimizer config (the ``--diff``
+    kill-switch channel). None defers to the default: a traced run
+    writes ``<trace_path>.ledger.jsonl`` alongside the trace artifact;
+    an untraced, unarmed run keeps records in memory only (see
+    `keystone_tpu.telemetry.ledger` and OBSERVABILITY.md).
     """
 
     overlap: bool = True
@@ -166,6 +177,7 @@ class ExecutionConfig:
     sharding_planner: bool = True
     precision_planner: bool = True
     precision_min_savings_bytes: int = 1 << 20
+    ledger_path: Optional[str] = None
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -274,6 +286,7 @@ def execution_config() -> ExecutionConfig:
                 "KEYSTONE_PRECISION_PLANNER", "1").lower() not in _OFF,
             precision_min_savings_bytes=max(0, int(os.environ.get(
                 "KEYSTONE_PRECISION_MIN_SAVINGS_BYTES", str(1 << 20)))),
+            ledger_path=os.environ.get("KEYSTONE_LEDGER") or None,
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

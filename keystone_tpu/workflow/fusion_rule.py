@@ -54,6 +54,35 @@ from .operators import (
 from .optimizer import Plan, Rule
 
 
+def _record_fusion_decision(kind: str, rule: str, chain, labels,
+                            chosen_entry: str, programs_before: int) -> None:
+    """One ledger record per enforced fusion rewrite: the chain's
+    vertices/labels, the chosen program shape, the per-stage dispatch
+    alternative it beat, and the predicted program arithmetic in the
+    shared units (programs-per-apply; one cold compile upper-bounds the
+    fresh program — the persistent cache may serve it warm). Never
+    raises: a ledger bug must not break the rewrite it records."""
+    try:
+        from ..telemetry import ledger
+
+        ledger.record_decision(
+            kind=kind,
+            rule=rule,
+            vertices=[n.id for n in chain],
+            labels=list(labels),
+            chosen={"entry": chosen_entry, "programs": 1,
+                    "members": len(chain)},
+            alternatives=[{"entry": "per_stage_dispatch",
+                           "programs": programs_before,
+                           "cost_programs": programs_before}],
+            predicted={"programs_per_apply": 1,
+                       "programs_eliminated": max(0, programs_before - 1),
+                       "cold_compiles_max": 1},
+        )
+    except Exception:
+        pass
+
+
 class _FitSlot:
     """Placeholder in a fused chain's stage list: 'the transformer fitted
     by estimator dependency ``index``' (resolved at force time)."""
@@ -432,6 +461,12 @@ class MegafusionRule(Rule):
         for chain in chains:
             if any(n not in graph.operators for n in chain):
                 continue
+            _record_fusion_decision(
+                "megafusion", type(self).__name__, chain,
+                [graph.get_operator(n).label for n in chain],
+                "megafused_scan_program",
+                max(1, sum(1 for n in chain
+                           if self._member_kind(graph, n) != "cache")))
             head_data_dep = self._data_dep(graph, chain[0])
             est_deps: List = []
             stage_specs: List = []
@@ -476,9 +511,13 @@ def megafusion_blockers(graph: Graph) -> List[Tuple[NodeId, str, str]]:
     Consumed by the analyzer's KP401 diagnostics so `validate()`
     explains fallbacks."""
     from ..analysis.hazards import _is_stream_origin
+    from ..telemetry import ledger
     from .operators import TransformerOperator
 
-    fused_graph = NodeFusionRule().apply((graph, {}))[0]
+    # this is an ANALYSIS re-run on a throwaway graph: no executor will
+    # enforce these rewrites, so they must not reach the run's ledger
+    with ledger.suppressed():
+        fused_graph = NodeFusionRule().apply((graph, {}))[0]
     kinds = {
         n: MegafusionRule._member_kind(fused_graph, n)
         for n in fused_graph.operators
@@ -621,6 +660,12 @@ class NodeFusionRule(Rule):
             if graph.get_dependencies(kid) != (g,):
                 continue
             (src,) = srcs
+            _record_fusion_decision(
+                "fusion", type(self).__name__, list(deps) + [g, kid],
+                [graph.get_operator(b).label for b in deps]
+                + [graph.get_operator(g).label,
+                   graph.get_operator(kid).label],
+                "gather_concat_program", len(deps) + 1)
             stage = _GatherConcatStage([graph.get_operator(b) for b in deps])
             graph = graph.set_operator(
                 kid, FusedBatchTransformer([stage], microbatch=self.microbatch))
@@ -692,6 +737,10 @@ class NodeFusionRule(Rule):
         for chain in chains:
             if any(n not in graph.operators for n in chain):
                 continue  # already rewritten by an overlapping chain
+            _record_fusion_decision(
+                "fusion", type(self).__name__, chain,
+                [graph.get_operator(n).label for n in chain],
+                "fused_chain_program", len(chain))
             head_data_dep = self._data_dep(graph, chain[0])
             est_deps: List = []
             stage_specs: List = []

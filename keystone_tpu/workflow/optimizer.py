@@ -320,8 +320,50 @@ class ShardingPlannerRule(Rule):
                 "ShardingPlannerRule: enforcing plan, boundary bytes "
                 "%d -> %d (%d saved)", int(splan.default_cost_bytes),
                 int(splan.planned_cost_bytes), splan.savings_bytes)
+            self._record_decision(graph, splan)
             graph = self._enforce(graph, splan, mesh)
         return graph, prefixes
+
+    @staticmethod
+    def _record_decision(graph: Graph, splan) -> None:
+        """One ledger record per enforced placement plan: the changed
+        stages, the chosen family assignment, the planner's own scored
+        candidate menu as the priced alternatives (the decision cores
+        already score these — expose them instead of discarding), and
+        the predicted boundary-byte arithmetic in the shared
+        `collective_cost` units. Never raises: a ledger bug must not
+        break the enforcement it records."""
+        try:
+            from ..analysis.propagate import _label
+            from ..telemetry import ledger
+
+            changed = splan.changed_vertices()
+            chosen_cost = float(splan.planned_cost_bytes)
+            alternatives = [c for c in splan.scored_candidates
+                            if c.get("cost_bytes") != chosen_cost]
+            if not alternatives:
+                alternatives = [
+                    {"entry": "default",
+                     "cost_bytes": float(splan.default_cost_bytes)}]
+            ledger.record_decision(
+                kind="placement",
+                rule="ShardingPlannerRule",
+                vertices=[getattr(v, "id", -1) for v in changed],
+                labels=[_label(graph, v) for v in changed],
+                chosen={
+                    "entry": "planned_assignment",
+                    "families": {str(v): splan.families.get(v)
+                                 for v in changed},
+                    "cost_bytes": chosen_cost,
+                },
+                alternatives=alternatives,
+                predicted={
+                    "boundary_bytes": chosen_cost,
+                    "boundary_bytes_saved": int(splan.savings_bytes),
+                },
+            )
+        except Exception:
+            logger.debug("placement decision not recorded", exc_info=True)
 
     @staticmethod
     def _has_device_dataset(graph: Graph) -> bool:
@@ -440,7 +482,7 @@ class PrecisionPlannerRule(Rule):
                     decided = plan_stage_precision(graph, vid, op, specs)
                     if decided is None:
                         continue
-                    storage, saved = decided
+                    storage, saved, menu = decided
                     if saved < cfg.precision_min_savings_bytes:
                         continue  # below the enforcement floor: the
                         # program stays bit-identical to PR 9
@@ -451,6 +493,8 @@ class PrecisionPlannerRule(Rule):
                     if self._all_compute_tolerant(graph, vid, op):
                         new_op.planned_matmul_precision = "bfloat16"
                     graph = graph.set_operator(vid, new_op)
+                    self._record_decision(graph, vid, op, storage, saved,
+                                          menu)
                     total_saved += saved
                     tagged += 1
             except Exception:
@@ -465,6 +509,59 @@ class PrecisionPlannerRule(Rule):
                 "PrecisionPlannerRule: enforcing bf16 storage on %d "
                 "program(s), %d boundary bytes saved", tagged, total_saved)
         return graph, prefixes
+
+    @staticmethod
+    def _record_decision(graph: Graph, vid, op, storage, saved: int,
+                         menu=None) -> None:
+        """One ledger record per program operator that received a baked
+        storage policy: the chosen per-stage dtype trail, the priced
+        alternatives it beat — the all-f32 reference (priced by the
+        same `policy_nbytes` arithmetic: keeping f32 forgoes exactly
+        ``saved`` bytes) plus the decision core's own candidate-run
+        menu (`analysis.precision.stage_policy_menu`: every maximal
+        legal bf16 run the chain DP scored, kept or rejected) — and
+        the predicted cast count (the casts the program builder will
+        bake — `precision.casts_baked` observes the real number).
+        Never raises: a ledger bug must not break the enforcement it
+        records."""
+        try:
+            from ..telemetry import ledger
+
+            casts = sum(1 for s in storage if s is not None)
+            alternatives = [{
+                "entry": "f32_reference",
+                "bytes_saved": 0,
+                "cost_bytes_extra": int(saved),
+            }]
+            for cand in menu or []:
+                if cand.get("kept"):
+                    continue  # part of (or superseded by) the chosen trail
+                alternatives.append({
+                    "entry": cand["entry"],
+                    "bytes_saved": int(cand.get("bytes_saved", 0)),
+                    "cast_penalty_bytes": int(
+                        cand.get("cast_penalty_bytes", 0)),
+                    "rejected": cand.get("dropped", "below_cast_penalty"),
+                })
+            ledger.record_decision(
+                kind="precision",
+                rule="PrecisionPlannerRule",
+                vertices=[getattr(vid, "id", -1)],
+                labels=[op.label],
+                chosen={
+                    "entry": "bf16_storage",
+                    "storage": [s for s in storage],
+                    "bytes_saved": int(saved),
+                    "cost_bytes_extra": 0,
+                },
+                alternatives=alternatives,
+                predicted={
+                    "policy_bytes_saved": int(saved),
+                    "casts_baked": casts,
+                },
+            )
+        except Exception:
+            logger.debug("precision decision not recorded", exc_info=True)
 
     @staticmethod
     def _all_compute_tolerant(graph: Graph, vid, op) -> bool:

@@ -105,6 +105,20 @@ system cannot (see ANALYSIS.md for the full catalog):
          constraints (RFFT accepts only f32/f64, uint8 pixel decode)
          carry an explicit suppression.
 
+  KJ012  dynamic-metric-name (under ``workflow/`` and ``nodes/``):
+         ``telemetry.counter/gauge/histogram(...)`` called with a
+         non-literal name (f-string, ``%``/``+`` formatting,
+         ``.format()``, or a variable) in hot-path code. The metrics
+         registry is process-wide and created-on-first-use: a name
+         formatted per vertex/label/chunk mints a NEW counter per
+         distinct value — unbounded cardinality that grows the
+         registry (and every trace's embedded snapshot) for the life
+         of the process. Use one literal name and carry the dimension
+         in a span arg instead; the sanctioned low-cardinality case
+         (per-process ``dispatch.*.p<i>`` accounting) lives in
+         ``telemetry/instrument.py``, outside this rule's scope, and
+         any genuine in-scope exception carries a suppression.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -157,6 +171,12 @@ RULES = {
              "code silently promotes bf16 boundaries back to f32 and "
              "defeats any precision policy (match the input dtype, or "
              "suppress with a kernel-constraint rationale)",
+    "KJ012": "telemetry counter/gauge/histogram called with a "
+             "dynamically formatted name in a hot path: the registry "
+             "is process-wide and created-on-first-use, so a per-"
+             "vertex/label name mints unbounded metric cardinality "
+             "(use one literal name; carry the dimension in a span "
+             "arg)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -899,6 +919,77 @@ def _check_literal_precision_cast(tree: ast.AST, path: str
                     "derive the dtype from the input instead")
 
 
+#: the telemetry metric factories whose name argument KJ012 audits
+#: (alias-tolerant: ``from ..telemetry import counter as _counter`` is
+#: still the same registry entry point).
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _check_dynamic_metric_name(tree: ast.AST, path: str
+                               ) -> Iterator[Finding]:
+    """KJ012 (under ``workflow/``/``nodes/``): a
+    ``counter/gauge/histogram`` call whose metric name is not a string
+    literal. The registry is process-wide and created-on-first-use: a
+    name formatted from a vertex id, label, or chunk index mints a new
+    metric per distinct value — unbounded cardinality that grows the
+    registry (and every trace's embedded metrics snapshot) for the
+    life of the process. Both the module-level factories and
+    registry/attribute forms (``telemetry.counter``,
+    ``registry().gauge``) are matched; leading-underscore import
+    aliases too. The attribute form is matched only on telemetry
+    receivers (``telemetry.*`` / ``metrics.*`` modules, ``registry()``
+    calls) so numeric APIs sharing a name — ``np.histogram``,
+    ``jnp.histogram`` — never false-positive. A literal first argument
+    (or ``name=`` literal) is the pass condition — constant-folding of
+    f-strings is deliberately NOT attempted: an f-string with no
+    placeholders is still a smell worth normalizing."""
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        func = call.func
+        if isinstance(func, ast.Name):
+            fname = func.id
+        elif isinstance(func, ast.Attribute):
+            fname = func.attr
+            # the receiver must be the telemetry layer: a module whose
+            # dotted name ends in telemetry/metrics, or a registry()
+            # call — np.histogram / jnp.histogram are not metrics
+            recv = func.value
+            if isinstance(recv, ast.Call):
+                rf = recv.func
+                rname = (rf.id if isinstance(rf, ast.Name)
+                         else rf.attr if isinstance(rf, ast.Attribute)
+                         else "")
+                if rname.lstrip("_") != "registry":
+                    continue
+            else:
+                last = (recv.attr if isinstance(recv, ast.Attribute)
+                        else recv.id if isinstance(recv, ast.Name)
+                        else "")
+                if last.lstrip("_") not in ("telemetry", "metrics"):
+                    continue
+        else:
+            continue
+        if fname.lstrip("_") not in _METRIC_FACTORIES:
+            continue
+        arg = call.args[0] if call.args else None
+        if arg is None:
+            for kw in call.keywords:
+                if kw.arg == "name":
+                    arg = kw.value
+                    break
+        if arg is None:
+            continue
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            continue
+        yield Finding(
+            path, call.lineno, "KJ012",
+            f"`{fname}(...)` with a dynamically formatted metric name "
+            "in a hot path: per-value names mint unbounded registry "
+            "cardinality — use one literal name and carry the "
+            "dimension in a span arg")
+
+
 def _attr_name(node: ast.AST) -> str:
     names = []
     while isinstance(node, (ast.Attribute, ast.Subscript)):
@@ -951,6 +1042,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_axis_literals(tree, rel))
         findings.extend(_check_output_layout_leak(tree, rel))
         findings.extend(_check_literal_precision_cast(tree, rel))
+        findings.extend(_check_dynamic_metric_name(tree, rel))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
 

@@ -259,4 +259,93 @@ print(f"megafusion smoke: {rows[0]}; run2 +0 cold OK")
 PY
 JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$MEGA_TRACE" >/dev/null
 
+echo "== ledger smoke (decision records match enforced plan tags; self-diff clean) =="
+LEDGER_TRACE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.json)"
+LEDGER_FILE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.jsonl)"
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+KEYSTONE_TRACE="$LEDGER_TRACE" KEYSTONE_LEDGER="$LEDGER_FILE" python - <<'PY'
+# One example pipeline (the dispatch-bench MnistRandomFFT instance,
+# full default stack: megafusion + sharding planner + precision with
+# the floor dropped) run end-to-end with the trace AND the decision
+# ledger armed. The gate: the JSONL ledger parses, EVERY enforced plan
+# tag in the executed graphs (fused/megafused program operators,
+# planned_out_spec placements, planned_precision policies) has a
+# matching decision record of the right kind covering its vertex, and
+# every record carries chosen + >=1 priced alternative + predicted cost.
+import os
+import numpy as np
+from keystone_tpu import PipelineEnv
+from keystone_tpu.dispatch_bench import EXAMPLES, _plan_context
+from keystone_tpu.telemetry import ledger
+from keystone_tpu.workflow.env import (
+    config_override, dispatch_override, overlap_override)
+
+optimizer, overlap_on, concurrent_on, overrides = _plan_context("precision")
+PipelineEnv.reset()
+PipelineEnv.get().set_optimizer(optimizer)
+with overlap_override(overlap_on), dispatch_override(concurrent_on), \
+        config_override(**overrides):
+    predictor, train, test = EXAMPLES["MnistRandomFFT"]()
+    fit_res = predictor(train)
+    fit_res.get()
+    apply_res = predictor(test)
+    apply_res.get()
+
+    run = ledger.read_ledger(os.environ["KEYSTONE_LEDGER"])
+    assert run["header"]["ledger_version"] == ledger.LEDGER_VERSION
+    assert run["header"]["config"]["megafusion"] is True, run["header"]
+    decisions = run["decisions"]
+    assert decisions, "armed run recorded no decisions"
+    for d in decisions:
+        assert d["enforced"], d
+        assert d["chosen"] and len(d["alternatives"]) >= 1, d
+        assert d["predicted"], d
+
+    # every enforced plan tag has a matching decision record
+    from keystone_tpu.nodes.util.fusion import FusedBatchTransformer
+    from keystone_tpu.workflow.fusion_rule import (
+        FusedChainOperator, MegafusedPlanOperator)
+    by_kind = {}
+    for d in decisions:
+        for v in d["vertices"]:
+            by_kind.setdefault(d["kind"], set()).add(int(v))
+    checked = {"fusion": 0, "megafusion": 0, "placement": 0,
+               "precision": 0}
+    for res in (fit_res, apply_res):
+        graph = res.executor.optimized_graph
+        for vid, op in graph.operators.items():
+            tags = []
+            if isinstance(op, MegafusedPlanOperator):
+                tags.append("megafusion")
+            elif isinstance(op, (FusedChainOperator, FusedBatchTransformer)):
+                tags.append("fusion")
+            if getattr(op, "planned_out_spec", None) is not None:
+                tags.append("placement")
+            if getattr(op, "planned_precision", None) is not None:
+                tags.append("precision")
+            for kind in tags:
+                vertices = by_kind.get(kind, set())
+                assert vid.id in vertices, (
+                    f"enforced {kind} tag on vertex {vid.id} "
+                    f"({op.label}) has no matching decision record "
+                    f"(recorded vertices: {sorted(vertices)})")
+                checked[kind] += 1
+    assert checked["fusion"] or checked["megafusion"], checked
+
+    # flush the ambient trace so the CLI can join decisions with
+    # observations on this same artifact
+    import keystone_tpu.telemetry.spans as spans
+    from keystone_tpu.telemetry.export import write_trace
+    tracer = spans.current_tracer()
+    assert tracer is not None, "KEYSTONE_TRACE did not arm the tracer"
+    write_trace(tracer, os.environ["KEYSTONE_TRACE"])
+PipelineEnv.reset()
+print("ledger smoke: " + ", ".join(
+    f"{k}={v}" for k, v in sorted(checked.items())) + " plan tags matched")
+PY
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --ledger "$LEDGER_FILE" >/dev/null
+# a run diffed against itself must report zero regressions (exit 0)
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --diff "$LEDGER_FILE" "$LEDGER_FILE"
+
 echo "lint: OK"

@@ -4,11 +4,18 @@ PERF.md.
 
 Usage: python scripts/perf_table.py [path=BENCH_LAST_GOOD.json]
        python scripts/perf_table.py --trace run.json [--top N]
+       python scripts/perf_table.py --ledger run.ledger.jsonl
 
 ``--trace`` renders a Chrome trace (written via KEYSTONE_TRACE /
 `trace_run`, e.g. the ``trace_artifact`` path a bench record carries) as
 a markdown per-node self-time table, so bench rounds can diff span-level
-detail across PRs (see OBSERVABILITY.md).
+detail across PRs (see OBSERVABILITY.md). When the trace embeds
+optimizer decisions, the decision tables are appended automatically.
+
+``--ledger`` renders a run's decision ledger (the ``ledger_artifact``
+path a bench record carries, or a decision-carrying trace) as the
+markdown predicted-vs-observed tables PERF.md rounds source their
+decision columns from.
 """
 
 import json
@@ -82,9 +89,74 @@ def trace_table(path, top=15):
             print("```\n" + format_reconciliation(rec) + "\n```")
     except Exception:
         pass
+    if trace.get("keystone", {}).get("decisions"):
+        print()
+        ledger_table(path)
+
+
+def _fmt_kv(d):
+    return "; ".join(
+        f"{k}={int(v) if isinstance(v, float) and v == int(v) else v}"
+        for k, v in sorted(d.items())
+        if not isinstance(v, (dict, list))) or "—"
+
+
+def ledger_table(path):
+    """Markdown predicted-vs-observed tables from a run's decision
+    ledger (a ``KEYSTONE_LEDGER`` JSONL file or a decision-carrying
+    trace) — the PERF.md round-table source: one run-level row per
+    reconciled quantity (programs executed/compiled, megafused
+    programs, baked casts) and one row per decision with the chosen
+    entry, the best-priced runner-up, and the observed/residual join
+    when the run's trace is reachable."""
+    sys.path.insert(0, ".")
+    from keystone_tpu.telemetry.ledger import read_ledger, runner_up
+
+    run = read_ledger(path)
+    rec = None
+    if run.get("trace") is not None:
+        try:
+            from keystone_tpu.analysis.reconcile import reconcile_decisions
+
+            rec = reconcile_decisions(run)
+        except Exception:
+            rec = None
+    print(f"**Optimizer decisions** ({len(run['decisions'])} recorded, "
+          f"`{path}`):\n")
+    if rec and (rec["run_predicted"] or rec["run_observed"]):
+        print("| Run quantity | Predicted | Observed | Residual |")
+        print("|---|---|---|---|")
+        keys = sorted(set(rec["run_predicted"]) | set(rec["run_observed"]))
+        for k in keys:
+            p = rec["run_predicted"].get(k, "—")
+            o = rec["run_observed"].get(k, "—")
+            r = rec["residuals"].get(k, "—")
+            print(f"| {k} | {p} | {o} | {r} |")
+        print()
+    obs_by_seq = {}
+    if rec:
+        obs_by_seq = {row["seq"]: row for row in rec["rows"]}
+    print("| Kind | Decision | Chosen | Runner-up | Predicted | "
+          "Observed | Residual |")
+    print("|---|---|---|---|---|---|---|")
+    for d in run["decisions"]:
+        labels = d.get("labels") or ["?"]
+        name = labels[0][:40] + (f" (+{len(labels) - 1})"
+                                 if len(labels) > 1 else "")
+        ru = runner_up(d)
+        row = obs_by_seq.get(d.get("seq")) or {}
+        print(f"| {d.get('kind')} | {name} "
+              f"| {(d.get('chosen') or {}).get('entry', '—')} "
+              f"| {(ru or {}).get('entry', '—')} "
+              f"| {_fmt_kv(d.get('predicted') or {})} "
+              f"| {_fmt_kv(row.get('observed') or {})} "
+              f"| {_fmt_kv(row.get('residuals') or {})} |")
+    print()
 
 
 def main():
+    if "--ledger" in sys.argv:
+        return ledger_table(sys.argv[sys.argv.index("--ledger") + 1])
     if "--trace" in sys.argv:
         i = sys.argv.index("--trace")
         path = sys.argv[i + 1]

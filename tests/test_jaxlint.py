@@ -686,6 +686,68 @@ def test_kj011_suppression(tmp_path):
     assert jl.lint_file(src) == []
 
 
+def test_kj012_flags_dynamic_metric_names(tmp_path):
+    """KJ012: `counter/gauge/histogram` with a non-literal metric name
+    in workflow/+nodes/ hot paths mints unbounded registry cardinality.
+    All the dynamic forms flag — f-string, %-format, concatenation,
+    `.format()`, a plain variable, attribute/alias call forms, and a
+    dynamic `name=` kwarg; literal names (positional or kwarg) pass."""
+    jl = _jaxlint()
+    bad = tmp_path / "workflow" / "bad_metrics.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "from keystone_tpu.telemetry import counter, gauge, histogram\n"
+        "from keystone_tpu.telemetry import counter as _counter\n"
+        "from keystone_tpu.telemetry import registry\n"
+        "from keystone_tpu import telemetry\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def force(vertex, label, name, data):\n"
+        "    counter(f'executor.forces.{vertex}').inc()\n"      # KJ012
+        "    counter('executor.forces.%d' % vertex).inc()\n"    # KJ012
+        "    gauge('live.' + label).add(1.0)\n"                 # KJ012
+        "    histogram('t.{}'.format(label)).observe(0.1)\n"    # KJ012
+        "    counter(name).inc()\n"                             # KJ012
+        "    _counter(f'x.{vertex}').inc()\n"                   # KJ012
+        "    telemetry.counter(f'y.{vertex}').inc()\n"          # KJ012
+        "    gauge(name='z.' + label).add(1.0)\n"               # KJ012
+        "    registry().counter(name).inc()\n"                  # KJ012
+        "    counter('executor.node_forces').inc()\n"           # ok
+        "    gauge(name='executor.live_bytes').add(1.0)\n"      # ok
+        "    np.histogram(data, bins=vertex)\n"                 # ok: numpy
+        "    return jnp.histogram(data, bins=vertex)\n"         # ok: jnp
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ012"] * 9
+    assert sorted(f.line for f in findings) == list(range(10, 19))
+
+    # outside workflow/ and nodes/ (e.g. telemetry/'s own sanctioned
+    # per-process dispatch accounting) the rule does not apply
+    elsewhere = tmp_path / "telemetry" / "ok_metrics.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj012_suppression(tmp_path):
+    """A genuinely bounded in-scope dimension suppresses per line."""
+    jl = _jaxlint()
+    src = tmp_path / "nodes" / "suppressed_metrics.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "from keystone_tpu.telemetry import counter\n"
+        "\n"
+        "\n"
+        "def record(dim):\n"
+        "    # bounded: dim is jax.process_index(), one per host\n"
+        "    counter(f'dispatch.per.{dim}').inc()"
+        "  # keystone: ignore[KJ012]\n"
+    )
+    assert jl.lint_file(src) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""

@@ -1,0 +1,456 @@
+"""Decision-ledger acceptance suite (PR 11).
+
+Covers the observability contract the ledger exists for:
+  - schema round-trip: decisions recorded through the real
+    `record_decision` path survive write → parse → render with the
+    chosen entry, ≥1 priced alternative, and predicted cost intact;
+  - both artifact forms load (`KEYSTONE_LEDGER` JSONL and a Chrome
+    trace whose metadata embeds the decisions);
+  - ``--diff`` names an injected ``KEYSTONE_MEGAFUSION=0`` kill-switch
+    flip (config flip + removed decision + suspect env), reports a
+    seeded prediction drift, and exits 0 on a self-diff;
+  - predicted-vs-observed exactness pins on MnistRandomFFT: the
+    megafused plan's ONE recorded megafusion decision predicts exactly
+    the 1 program the traced apply run executes (residual 0), the
+    cold-compile prediction upper-bounds the observed compiles, and a
+    warm re-apply observes exactly 0 cold compiles;
+  - the acceptance diff: a default (megafused) run vs a
+    ``KEYSTONE_MEGAFUSION=0`` run names the changed decision AND the
+    observed program-count regression.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from keystone_tpu import PipelineEnv
+from keystone_tpu.telemetry import ledger, registry, trace_run
+from keystone_tpu.telemetry.__main__ import main as telemetry_main
+from keystone_tpu.workflow.env import (
+    config_override,
+    dispatch_override,
+    overlap_override,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_session():
+    ledger.clear_session()
+    yield
+    ledger.clear_session()
+
+
+def _record_sample(kind="megafusion", labels=("Fused[A >> B]",),
+                   predicted=None):
+    return ledger.record_decision(
+        kind=kind,
+        rule="MegafusionRule" if kind == "megafusion" else "NodeFusionRule",
+        vertices=[3, 4, 5],
+        labels=list(labels),
+        chosen={"entry": "megafused_scan_program", "programs": 1,
+                "members": 3},
+        alternatives=[{"entry": "per_stage_dispatch", "programs": 5,
+                       "cost_programs": 5},
+                      {"entry": "pairwise_fusion", "programs": 3,
+                       "cost_programs": 3}],
+        predicted=predicted or {"programs_per_apply": 1,
+                                "programs_eliminated": 4,
+                                "cold_compiles_max": 1},
+    )
+
+
+# --------------------------------------------------------------------------
+# schema round-trip: write → parse → render
+
+
+def test_ledger_round_trip_jsonl(tmp_path):
+    rec = _record_sample()
+    assert rec is not None and rec["enforced"]
+    path = ledger.write_session(str(tmp_path / "run.ledger.jsonl"))
+
+    run = ledger.read_ledger(path)
+    header = run["header"]
+    assert header["ledger_version"] == ledger.LEDGER_VERSION
+    # the header snapshots every kill-switch field WITH its env name —
+    # the channel --diff uses to name a flip
+    assert set(header["config"]) == set(ledger.CONFIG_ENV)
+    assert header["config_env"]["megafusion"] == "KEYSTONE_MEGAFUSION"
+
+    (d,) = run["decisions"]
+    assert d["kind"] == "megafusion"
+    assert d["chosen"]["entry"] == "megafused_scan_program"
+    assert len(d["alternatives"]) >= 1
+    assert d["predicted"]["programs_per_apply"] == 1
+    assert d["seq"] == rec["seq"]
+
+    # the runner-up is the best-priced alternative the chosen entry beat
+    ru = ledger.runner_up(d)
+    assert ru["entry"] == "pairwise_fusion" and ru["cost_programs"] == 3
+
+    table = ledger.render_ledger(run)
+    assert "megafusion" in table and "megafused_scan_program" in table
+    assert "pairwise_fusion" in table  # runner-up column
+    assert "1 decision(s)" in table
+
+
+def test_ledger_jsonl_lines_are_independently_parseable(tmp_path):
+    """A killed run leaves a parseable prefix: every line is one JSON
+    object, header first."""
+    _record_sample()
+    _record_sample(kind="fusion", labels=("A", "B"))
+    path = ledger.write_session(str(tmp_path / "run.ledger.jsonl"))
+    lines = [json.loads(line) for line in
+             open(path).read().splitlines() if line.strip()]
+    assert len(lines) == 3
+    assert "ledger_version" in lines[0]
+    assert [ln["seq"] for ln in lines[1:]] == [1, 2]
+
+
+def test_ambient_jsonl_path_appends_incrementally(tmp_path):
+    """With `ExecutionConfig.ledger_path` armed (the KEYSTONE_LEDGER
+    channel), each record lands on disk at decision time — no explicit
+    flush required."""
+    path = tmp_path / "ambient.ledger.jsonl"
+    with config_override(ledger_path=str(path)):
+        _record_sample()
+        assert path.exists()
+        first = open(path).read().splitlines()
+        assert len(first) == 2  # header + one record
+        _record_sample(kind="fusion", labels=("C",))
+        assert len(open(path).read().splitlines()) == 3
+    run = ledger.read_ledger(str(path))
+    assert [d["kind"] for d in run["decisions"]] == ["megafusion", "fusion"]
+
+
+def test_traced_run_defaults_ledger_alongside_trace(tmp_path):
+    with config_override(trace_path=str(tmp_path / "run.json"),
+                         ledger_path=None):
+        assert ledger.resolve_ledger_path() == \
+            str(tmp_path / "run.json") + ".ledger.jsonl"
+    with config_override(trace_path=None, ledger_path=None):
+        assert ledger.resolve_ledger_path() is None
+
+
+def test_trace_metadata_form_loads(tmp_path):
+    """The second artifact form: a trace whose `keystone` metadata
+    embeds the decisions loads through the same `read_ledger`."""
+    path = str(tmp_path / "run.json")
+    with trace_run(path):
+        _record_sample()
+    run = ledger.read_ledger(path)
+    assert run["trace"] is not None
+    assert run["header"].get("config", {}).get("megafusion") is True
+    (d,) = run["decisions"]
+    assert d["kind"] == "megafusion"
+
+
+def test_suppressed_scope_records_nothing():
+    with ledger.suppressed():
+        assert _record_sample() is None
+    assert ledger.session_decisions() == []
+
+
+def test_truncated_tail_is_a_parseable_prefix(tmp_path):
+    """A run killed mid-append leaves a partial final line; read_ledger
+    must return the intact prefix, not raise (the documented contract).
+    Corruption anywhere but the tail still raises."""
+    _record_sample()
+    _record_sample(kind="fusion", labels=("A",))
+    path = str(tmp_path / "killed.ledger.jsonl")
+    ledger.write_session(path)
+    with open(path, "a") as f:
+        f.write('{"seq": 3, "kind": "precis')  # killed mid-write
+    run = ledger.read_ledger(path)
+    assert [d["kind"] for d in run["decisions"]] == ["megafusion", "fusion"]
+    assert run["header"]["ledger_version"] == ledger.LEDGER_VERSION
+
+    # mid-file corruption is NOT silently skipped
+    lines = open(path).read().splitlines()
+    lines[1] = lines[1][:20]
+    (tmp_path / "corrupt.jsonl").write_text("\n".join(lines))
+    with pytest.raises(ValueError):
+        ledger.read_ledger(str(tmp_path / "corrupt.jsonl"))
+
+
+def test_mid_run_config_change_gets_its_own_header(tmp_path):
+    """A process sweeping plans via scoped config overrides (the
+    dispatch bench) must not file every decision under the first plan's
+    config: a config change mid-file appends a fresh header, and fields
+    that varied within the run are excluded from --diff's flip
+    comparison (no phantom CONFIG FLIP regressions)."""
+    path = tmp_path / "sweep.ledger.jsonl"
+    with config_override(ledger_path=str(path)):
+        with config_override(megafusion=False):
+            _record_sample(kind="fusion", labels=("A",))
+        _record_sample()  # back under the ambient (megafusion on) config
+    run = ledger.read_ledger(str(path))
+    assert len(run["headers"]) == 2
+    assert run["headers"][0]["config"]["megafusion"] is False
+    assert run["headers"][1]["config"]["megafusion"] is True
+
+    # diff vs a constant-config run: megafusion varied within the sweep
+    # run, so it cannot be (and is not) reported as a flip
+    b = _write_run(tmp_path, "b.jsonl", megafusion=True)
+    diff = ledger.diff_runs(run, ledger.read_ledger(b))
+    assert diff["config_flips"] == []
+
+
+def test_removed_decision_without_flip_names_no_suspect(tmp_path):
+    """A decision that vanished under identical config (pipeline edit,
+    savings floor) must not blame a kill switch that never flipped."""
+    a = _write_run(tmp_path, "a.jsonl", megafusion=True, with_mega=True)
+    b = _write_run(tmp_path, "b.jsonl", megafusion=True, with_mega=False)
+    diff = ledger.diff_runs(ledger.read_ledger(a), ledger.read_ledger(b))
+    assert diff["config_flips"] == []
+    (removed,) = diff["decisions_removed"]
+    assert removed["kind"] == "megafusion"
+    assert removed["suspect_env"] is None
+
+
+# --------------------------------------------------------------------------
+# --diff: kill-switch flip, seeded drift, self-diff
+
+
+def _write_run(tmp_path, name, megafusion=True, with_mega=True,
+               predicted=None):
+    ledger.clear_session()
+    with config_override(megafusion=megafusion):
+        _record_sample(kind="fusion", labels=("A", "B"))
+        if with_mega:
+            _record_sample(predicted=predicted)
+        return ledger.write_session(str(tmp_path / name))
+
+
+def test_diff_names_injected_megafusion_flip(tmp_path, capsys):
+    a = _write_run(tmp_path, "a.jsonl", megafusion=True, with_mega=True)
+    b = _write_run(tmp_path, "b.jsonl", megafusion=False, with_mega=False)
+
+    diff = ledger.diff_runs(ledger.read_ledger(a), ledger.read_ledger(b))
+    (flip,) = diff["config_flips"]
+    assert flip["env"] == "KEYSTONE_MEGAFUSION"
+    assert flip["a"] is True and flip["b"] is False
+    (removed,) = diff["decisions_removed"]
+    assert removed["kind"] == "megafusion"
+    assert removed["suspect_env"] == "KEYSTONE_MEGAFUSION"
+    assert diff["regressions"] >= 2
+
+    # the CLI contract: exit 1 on regressions, the flip named by env var
+    assert telemetry_main(["--diff", a, b]) == 1
+    out = capsys.readouterr().out
+    assert "CONFIG FLIP: KEYSTONE_MEGAFUSION" in out
+    assert "DECISION REMOVED: megafusion" in out
+    assert "suspect: KEYSTONE_MEGAFUSION" in out
+
+
+def test_diff_reports_seeded_prediction_drift(tmp_path, capsys):
+    a = _write_run(tmp_path, "a.jsonl")
+    b = _write_run(tmp_path, "b.jsonl",
+                   predicted={"programs_per_apply": 1,
+                              "programs_eliminated": 9,
+                              "cold_compiles_max": 1})
+    diff = ledger.diff_runs(ledger.read_ledger(a), ledger.read_ledger(b))
+    assert diff["config_flips"] == []
+    (drift,) = diff["prediction_drift"]
+    assert drift["metric"] == "programs_eliminated"
+    assert drift["a"] == 4 and drift["b"] == 9
+    assert telemetry_main(["--diff", a, b]) == 1
+    assert "PREDICTION DRIFT" in capsys.readouterr().out
+
+
+def test_diff_of_run_against_itself_is_clean(tmp_path, capsys):
+    a = _write_run(tmp_path, "a.jsonl")
+    diff = ledger.diff_runs(ledger.read_ledger(a), ledger.read_ledger(a))
+    assert diff["regressions"] == 0
+    assert telemetry_main(["--diff", a, a]) == 0
+    assert "0 regression(s)" in capsys.readouterr().out
+
+
+def test_ledger_cli_renders_table(tmp_path, capsys):
+    a = _write_run(tmp_path, "a.jsonl")
+    assert telemetry_main(["--ledger", a]) == 0
+    out = capsys.readouterr().out
+    assert "megafused_scan_program" in out and "runner-up" in out
+    assert telemetry_main(["--ledger", a, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert [d["kind"] for d in payload["decisions"]] == \
+        ["fusion", "megafusion"]
+
+
+# --------------------------------------------------------------------------
+# predicted vs observed on a real example: exactness pins
+
+
+def _traced_apply(trace_path, plan="megafused"):
+    """Fit MnistRandomFFT outside the measured window, then trace ONE
+    apply run with a fresh metrics registry — the run-exact join shape
+    `reconcile_decisions` documents."""
+    from keystone_tpu.dispatch_bench import EXAMPLES, _plan_context
+
+    optimizer, overlap_on, concurrent_on, overrides = _plan_context(plan)
+    PipelineEnv.reset()
+    try:
+        PipelineEnv.get().set_optimizer(optimizer)
+        with overlap_override(overlap_on), \
+                dispatch_override(concurrent_on), \
+                config_override(**overrides):
+            predictor, train, test = EXAMPLES["MnistRandomFFT"]()
+            fit_pred = np.asarray(predictor(train).get().numpy())
+            from keystone_tpu.workflow.executor import drain_warmups
+
+            drain_warmups()  # background AOT compiles are the fit's
+            ledger.clear_session()
+            registry().reset()
+            with trace_run(trace_path):
+                apply_pred = np.asarray(predictor(test).get().numpy())
+                drain_warmups()
+    finally:
+        PipelineEnv.reset()
+    return fit_pred, apply_pred
+
+
+def test_predicted_vs_observed_exactness_mnist(tmp_path):
+    """The acceptance pin: the megafused plan's recorded megafusion
+    decision predicts EXACTLY the one program the traced apply run
+    executed, and the cold-compile prediction upper-bounds the observed
+    compiles."""
+    from keystone_tpu.analysis.reconcile import reconcile_decisions
+
+    path = str(tmp_path / "apply.json")
+    _traced_apply(path)
+    run = ledger.read_ledger(path)
+    assert run["trace"] is not None
+
+    kinds = {d["kind"] for d in run["decisions"]}
+    assert "megafusion" in kinds
+    # every enforced decision carries chosen + ≥1 priced alternative +
+    # predicted cost — the acceptance schema
+    for d in run["decisions"]:
+        assert d["enforced"] and d["chosen"]
+        assert len(d["alternatives"]) >= 1
+        assert d["predicted"]
+
+    rec = reconcile_decisions(run)
+    assert rec["run_predicted"]["programs_executed"] == 1
+    assert rec["run_observed"]["programs_executed"] == 1
+    assert rec["residuals"]["programs_executed"] == 0
+    assert rec["run_predicted"]["megafused_programs"] == 1
+    assert rec["run_observed"]["megafused_programs"] == 1
+    # compiles: the prediction is an upper bound (the persistent cache
+    # may serve the program warm), never an undercount
+    observed_cold = rec["run_observed"].get("programs_compiled")
+    if observed_cold is not None:
+        assert observed_cold <= rec["run_predicted"]["programs_compiled_max"]
+        assert rec["residuals"]["programs_compiled"] >= 0
+
+    # per-decision: the megafusion row observes its span exactly
+    mega_rows = [r for r in rec["rows"] if r["kind"] == "megafusion"]
+    assert mega_rows
+    assert mega_rows[0]["observed"]["programs_executed"] == 1
+    assert mega_rows[0]["residuals"]["programs_per_apply"] == 0
+
+
+def test_warm_reapply_observes_zero_cold_compiles(tmp_path):
+    """Second traced apply of the same fitted pipeline: still exactly 1
+    program, exactly 0 cold compiles — predicted-vs-observed exact on
+    both counts."""
+    from keystone_tpu.analysis.reconcile import reconcile_decisions
+    from keystone_tpu.dispatch_bench import EXAMPLES, _plan_context
+    from keystone_tpu.workflow.executor import drain_warmups
+
+    optimizer, overlap_on, concurrent_on, overrides = \
+        _plan_context("megafused")
+    path = str(tmp_path / "warm.json")
+    PipelineEnv.reset()
+    try:
+        PipelineEnv.get().set_optimizer(optimizer)
+        with overlap_override(overlap_on), \
+                dispatch_override(concurrent_on), \
+                config_override(**overrides):
+            predictor, train, test = EXAMPLES["MnistRandomFFT"]()
+            predictor(train).get()
+            predictor(test).get()  # first apply: compiles here
+            drain_warmups()
+            ledger.clear_session()
+            registry().reset()
+            with trace_run(path):
+                predictor(test).get()
+                drain_warmups()
+    finally:
+        PipelineEnv.reset()
+    run = ledger.read_ledger(path)
+    rec = reconcile_decisions(run)
+    assert rec["run_observed"]["programs_executed"] == 1
+    assert rec["run_predicted"]["programs_executed"] == 1
+    assert rec["run_observed"].get("programs_compiled", 0) == 0
+
+
+def test_acceptance_diff_default_vs_megafusion_off(tmp_path):
+    """The acceptance criterion end-to-end: --diff between a default
+    (megafused) run and a KEYSTONE_MEGAFUSION=0 run names the changed
+    decision AND the observed program-count regression."""
+    from keystone_tpu.analysis.reconcile import reconcile_decisions
+
+    path_a = str(tmp_path / "default.json")
+    path_b = str(tmp_path / "mega_off.json")
+    _, pred_a = _traced_apply(path_a, plan="megafused")
+    _, pred_b = _traced_apply(path_b, plan="optimized")
+    np.testing.assert_array_equal(pred_a, pred_b)
+
+    run_a = ledger.read_ledger(path_a)
+    run_b = ledger.read_ledger(path_b)
+    diff = ledger.diff_runs(
+        run_a, run_b,
+        reconciliation_a=reconcile_decisions(run_a),
+        reconciliation_b=reconcile_decisions(run_b))
+
+    # the flip is named by env var, not inferred from its fallout
+    assert any(f["env"] == "KEYSTONE_MEGAFUSION"
+               for f in diff["config_flips"])
+    # the changed decision is named, with the kill switch as suspect
+    removed = [d for d in diff["decisions_removed"]
+               if d["kind"] == "megafusion"]
+    assert removed and removed[0]["suspect_env"] == "KEYSTONE_MEGAFUSION"
+    # and the observed quantity that regressed is reported: 1 program
+    # under megafusion, more without it
+    regress = {r["metric"]: r for r in diff["observed_regressions"]}
+    assert "programs_executed" in regress
+    assert regress["programs_executed"]["a"] == 1
+    assert regress["programs_executed"]["b"] > 1
+    assert diff["regressions"] >= 3
+
+
+# --------------------------------------------------------------------------
+# the cost-model drift report
+
+
+def test_cost_model_drift_from_trace(tmp_path):
+    from keystone_tpu.analysis.reconcile import (
+        cost_model_drift,
+        drift_cost_weights,
+        format_drift,
+    )
+    from keystone_tpu.nodes.learning.calibrate import CostWeights
+
+    path = str(tmp_path / "apply.json")
+    _traced_apply(path)
+    trace = ledger.read_ledger(path)["trace"]
+    drift = cost_model_drift(trace)
+    assert drift["spans"] > 0 and drift["observed_bytes"] > 0
+    by_weight = {r["weight"]: r for r in drift["rows"]}
+    # mem_weight is implied by observed seconds-per-byte; cpu/network
+    # have no span observable and keep their current values
+    assert by_weight["mem_weight"]["implied"] == pytest.approx(
+        drift["observed_seconds"] / drift["observed_bytes"])
+    assert by_weight["cpu_weight"]["implied"] is None
+    assert drift["suggested"]["mem_weight"] == \
+        by_weight["mem_weight"]["implied"]
+    assert drift["suggested"]["cpu_weight"] == \
+        by_weight["cpu_weight"]["current"]
+
+    weights = drift_cost_weights(trace)
+    assert isinstance(weights, CostWeights)
+    assert weights.mem_weight == drift["suggested"]["mem_weight"]
+
+    rendered = format_drift(drift)
+    assert "mem_weight" in rendered and "unmeasured" in rendered

@@ -246,6 +246,15 @@ def test_streamed_stage_gets_node_span_and_bytes():
     up = node_spans["force StreamDouble"]
     assert up.args.get("streamed") is True
     assert up.args.get("out_bytes") == 32 * 4 * 4  # real bytes, not 64B
+    # the span covers the actual drain window: ts is the FIRST-pull
+    # timestamp (not the completion time the record is written at),
+    # dur stays the cumulative pull time, and drain_window_s carries
+    # the full first-pull→exhaustion extent (≥ dur: the consumer's
+    # between-chunk work is excluded from dur but inside the window)
+    window = up.args.get("drain_window_s")
+    assert window is not None
+    assert window + 2e-6 >= up.dur
+    assert 0.0 <= up.t0 <= up.t0 + window <= tr.now() + 2e-6
 
 
 def test_observed_live_peak_is_per_run():
