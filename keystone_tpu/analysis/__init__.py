@@ -53,6 +53,16 @@ from .sharding import (
     sharding_pass,
 )
 from .planner import ShardingPlan, plan_sharding
+from .roofline import (
+    Machine,
+    RooflineEstimate,
+    StageRoofline,
+    default_machine,
+    jaxpr_counts,
+    roofline_pass,
+    stage_cost,
+    xla_cost_analysis,
+)
 from .precision import (
     PrecisionPlan,
     plan_precision,
@@ -100,6 +110,7 @@ def validate_graph(
     specs: Dict = {}
     memory: Optional[MemoryEstimate] = None
     shardings: Dict = {}
+    roofline = None
 
     if tier >= 1:
         normalized = {
@@ -155,9 +166,16 @@ def validate_graph(
             # fleet sum over budget while every chip is under is not a
             # violation, and a chip over budget is KP600's finding
             diags = [d for d in diags if d.rule != "KP202"] + pd_diags
+        # roofline tier (KP8xx): jaxpr-level FLOP/byte pricing and the
+        # time-domain cost model — the compute half of the cost model
+        # the KP2xx/KP6xx/KP7xx byte tiers were missing
+        roofline, roof_diags = roofline_pass(graph, specs,
+                                             chunk_rows=chunk_rows)
+        diags.extend(roof_diags)
 
     report = ValidationReport(diags, specs=specs, memory=memory,
-                              level=level, shardings=shardings)
+                              level=level, shardings=shardings,
+                              roofline=roofline)
     return report.filter(ignore) if ignore else report
 
 
@@ -195,6 +213,8 @@ __all__ = [
     "hazard_pass",
     "interference_pass",
     "operator_effects",
+    "jaxpr_counts",
+    "Machine",
     "memory_pass",
     "per_device_pass",
     "plan_precision",
@@ -204,6 +224,12 @@ __all__ = [
     "reprice_memory",
     "shrink_to_band",
     "resolve_chunk_rows",
+    "roofline_pass",
+    "RooflineEstimate",
+    "StageRoofline",
+    "default_machine",
+    "stage_cost",
+    "xla_cost_analysis",
     "sharding_pass",
     "shape_struct",
     "spec_of",

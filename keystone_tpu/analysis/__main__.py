@@ -11,6 +11,8 @@ registry.
     python -m keystone_tpu.analysis --explain-sharding --plan --mesh-shape 2x4
     python -m keystone_tpu.analysis --explain-precision # per-stage dtype plan
     python -m keystone_tpu.analysis --explain-precision --json
+    python -m keystone_tpu.analysis --explain-roofline  # per-stage flops/bytes
+    python -m keystone_tpu.analysis --explain-roofline --json
     python -m keystone_tpu.analysis --list-rules
 
 Exit code 1 if any example produces ERROR-severity findings (or any
@@ -36,6 +38,16 @@ findings are linted UNDER the chosen policy and the KP2xx memory model
 is re-priced with the decided dtypes (KP703 rows). Exit code 1 on any
 unsuppressed WARNING/ERROR KP7xx finding, or when a chosen policy
 prices WORSE than the all-f32 default.
+
+``--explain-roofline`` runs the static roofline analyzer
+(analysis/roofline.py) per example: every priceable stage's jaxpr-level
+FLOP count, stage-at-a-time HBM bytes, arithmetic intensity,
+compute-vs-bandwidth classification against the calibrated machine
+balance, and predicted seconds (``max(flops/peak_flops,
+bytes/peak_bw)``); KP801 Pallas-candidate chains are listed with their
+priced fusion speedup. Exit code 1 only on ERROR-severity findings (the
+KP8xx tier is advisory — candidates and re-pricings are INFO/WARNING)
+or a failed example build.
 
 ``--plan`` (with ``--explain-sharding``) additionally runs the sharding
 planner (analysis/planner.py) per example: the rendered table compares
@@ -346,6 +358,89 @@ def _explain_precision_main(args) -> int:
     return 1 if failed else 0
 
 
+def _explain_roofline_main(args) -> int:
+    """Per-example roofline explanation (KP8xx): price every stage's
+    FLOPs/bytes/intensity/predicted-seconds against the calibrated
+    machine balance and list the KP801 Pallas-candidate chains. The
+    tier is advisory — the gate fails only on ERROR findings (none are
+    currently emitted) or a broken example build, but the lint.sh
+    audit additionally asserts the candidate list is non-empty (the
+    Pallas megakernel backend needs a statically identified target)."""
+    from .propagate import spec_pass
+    from .roofline import format_roofline, roofline_pass
+    from . import as_source_spec
+
+    names = args.examples or sorted(EXAMPLES)
+    unknown = [n for n in names if n not in EXAMPLES]
+    if unknown:
+        print(f"unknown example(s): {', '.join(unknown)}; "
+              f"known: {', '.join(sorted(EXAMPLES))}", file=sys.stderr)
+        return 2
+
+    failed = False
+    records = []
+    machine = None
+    for name in names:
+        try:
+            pipeline, source_spec = build_example(name)
+            graph = pipeline.graph
+            specs, _ = spec_pass(
+                graph, {pipeline.source: as_source_spec(source_spec)})
+            est, diags = roofline_pass(graph, specs)
+            machine = est.machine
+            diags = [d for d in diags if d.rule not in set(args.ignore)]
+            gate = [d for d in diags if d.severity >= Severity.ERROR]
+            rows = est.rows(graph)
+        except Exception as e:  # a factory bug is a failure, not a crash
+            if args.json:
+                records.append({"example": name, "build_error":
+                                f"{type(e).__name__}: {e}"})
+            else:
+                print(f"✗ {name}: failed to build/explain: "
+                      f"{type(e).__name__}: {e}")
+            failed = True
+            continue
+        failed |= bool(gate)
+        if args.json:
+            records.append({
+                "example": name,
+                "plan_predicted_seconds": est.plan_seconds,
+                "unpriced_stages": est.unknown_stages,
+                "stages": rows,
+                "candidates": [
+                    {**c, "vertices": [v.id for v in c["vertices"]]}
+                    for c in est.candidates
+                ],
+                "findings": [
+                    {"rule": d.rule, "severity": d.severity.name,
+                     "anchor": d.anchor, "message": d.message}
+                    for d in diags
+                ],
+            })
+        else:
+            mark = "✗" if gate else "✓"
+            print(f"{mark} {name}: {len(rows)} priced stage(s), "
+                  f"≈{est.plan_seconds:.3e}s predicted, "
+                  f"{len(est.candidates)} pallas candidate(s)"
+                  + (f", {est.unknown_stages} unpriced"
+                     if est.unknown_stages else ""))
+            if rows:
+                print("  " + format_roofline(rows).replace("\n", "\n  "))
+            for d in diags:
+                if d.severity >= Severity.WARNING or args.strict:
+                    print(f"    {d}")
+    if args.json:
+        print(json.dumps({
+            "machine": {
+                "peak_flops": machine.peak_flops,
+                "peak_bw": machine.peak_bw,
+                "balance": machine.balance,
+            } if machine is not None else None,
+            "examples": records,
+        }, indent=2))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m keystone_tpu.analysis", description=__doc__,
@@ -373,6 +468,12 @@ def main(argv=None) -> int:
                         "any unsuppressed WARNING/ERROR KP7xx finding "
                         "(planner ≤ all-f32 bytes is re-asserted as an "
                         "invariant)")
+    p.add_argument("--explain-roofline", action="store_true",
+                   help="run the static roofline analyzer per example "
+                        "and render the per-stage flops / HBM bytes / "
+                        "intensity / bound / predicted-seconds table "
+                        "plus the KP801 Pallas-candidate chains; fail "
+                        "only on ERROR-severity KP8xx findings")
     p.add_argument("--plan", action="store_true",
                    help="with --explain-sharding: run the sharding "
                         "planner per example and render chosen-vs-default "
@@ -400,6 +501,9 @@ def main(argv=None) -> int:
 
     if args.explain_precision:
         return _explain_precision_main(args)
+
+    if args.explain_roofline:
+        return _explain_roofline_main(args)
 
     names = args.examples or sorted(EXAMPLES)
     unknown = [n for n in names if n not in EXAMPLES]

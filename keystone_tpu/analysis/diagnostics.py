@@ -81,6 +81,23 @@ RULES = {
     "KP703": "dtype-dependent memory re-pricing: a chosen precision "
              "policy changes a stage's static KP2xx residency (bf16 "
              "halves the chosen float boundaries) — informational",
+    # roofline tier (jaxpr-level FLOP/byte pricing; see analysis/roofline)
+    "KP801": "pallas-candidate: a bandwidth-bound fan-out-free fused "
+             "chain of >=2 stages whose internal boundaries round-trip "
+             "HBM under stage-at-a-time lowering — a Pallas megakernel "
+             "candidate, priced with the boundary bytes the kernel "
+             "would keep in VMEM",
+    "KP802": "data-movement-dominated stage: pure "
+             "transpose/reshape/gather traffic at least the larger of "
+             "the stage's compute and its unavoidable boundary bytes — "
+             "the stage pays for layout, not math",
+    "KP803": "plan-roofline: the whole plan re-priced in predicted "
+             "seconds (max(flops/peak_flops, bytes/peak_bw) per stage) "
+             "against the calibrated machine balance — informational",
+    "KP804": "megafused-scan-underfilled: the in-program chunk loop's "
+             "per-trip compute is below the dispatch/loop overhead "
+             "floor; the scan cannot amortize its trips — raise "
+             "chunk_size",
     # contract tier (registry-wide operator audit; see analysis/contracts)
     "KP501": "fusable-without-structural-fuse: a fusable stage's fused "
              "program key is id-keyed (opaque), so fused programs "
@@ -131,6 +148,7 @@ class ValidationReport:
         memory: Optional[Any] = None,
         level: str = "structure",
         shardings: Optional[dict] = None,
+        roofline: Optional[Any] = None,
     ):
         self.diagnostics: List[Diagnostic] = list(diagnostics)
         self.specs = specs or {}
@@ -139,6 +157,10 @@ class ValidationReport:
         #: per-vertex propagated partition specs (analysis/sharding.py);
         #: populated at level="full", empty otherwise
         self.shardings = shardings or {}
+        #: the roofline estimate (analysis/roofline.RooflineEstimate —
+        #: per-stage flops/bytes/intensity/predicted-seconds);
+        #: populated at level="full", None otherwise
+        self.roofline = roofline
 
     # ------------------------------------------------------------- views
 
@@ -164,7 +186,7 @@ class ValidationReport:
         return ValidationReport(
             [d for d in self.diagnostics if d.rule not in ignore],
             specs=self.specs, memory=self.memory, level=self.level,
-            shardings=self.shardings,
+            shardings=self.shardings, roofline=self.roofline,
         )
 
     def raise_for_errors(self) -> "ValidationReport":

@@ -324,6 +324,99 @@ def format_decision_reconciliation(rec: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------- roofline reconciliation
+
+
+def observed_node_seconds(trace: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """key → {label, vertex, seconds(max over forces), forces} from
+    ``cat="node"`` spans — the observed side of the roofline's time
+    model. The roofline predicts ONE dataset pass per stage, and a
+    fit+apply run forces the same vertex:label more than once, so
+    seconds aggregate with **max** (the `observed_node_bytes`
+    precedent) — summing would inflate the residual and the implied
+    ``cpu_weight`` by the force count."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("cat") != "node":
+            continue
+        args = e.get("args", {})
+        vertex = args.get("vertex")
+        if vertex is None:
+            continue
+        label = e.get("name", "")
+        if label.startswith("force "):
+            label = label[len("force "):]
+        key = node_key(vertex, label)
+        rec = out.setdefault(key, {
+            "label": label, "vertex": vertex, "seconds": 0.0, "forces": 0,
+        })
+        rec["forces"] += 1
+        rec["seconds"] = max(rec["seconds"],
+                             float(args.get("seconds", 0.0) or 0.0))
+    return out
+
+
+def reconcile_roofline(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Join the trace's embedded roofline predictions
+    (``keystone.roofline`` — per-stage flops / bytes / predicted
+    seconds, the KP803 metadata the executor records) against the
+    observed per-node span seconds.
+
+    Returns ``{"rows", "predicted_seconds", "observed_seconds",
+    "flops_residual_seconds", "stages_joined", "machine"}`` where each
+    row carries ``predicted_seconds``, ``observed_seconds``,
+    ``residual`` (predicted − observed; positive means the model
+    promised more time than the run took) and the static ``flops`` /
+    ``bound``. Rows with only one side known are kept with
+    ``residual=None`` so coverage gaps stay visible; a trace with no
+    roofline metadata (or no spans) degrades to empty rows instead of
+    raising — the --ledger drift report must render on partial
+    artifacts."""
+    ks = trace.get("keystone", {})
+    roof = ks.get("roofline") or {}
+    static = roof.get("per_node", {}) or {}
+    observed = observed_node_seconds(trace)
+    rows: List[Dict[str, Any]] = []
+    pred_total = 0.0
+    obs_total = 0.0
+    joined = 0
+    for key in sorted(set(static) | set(observed)):
+        s = static.get(key)
+        o = observed.get(key)
+        pred: Optional[float] = (
+            float(s["predicted_seconds"]) if s else None)
+        obs: Optional[float] = (
+            float(o["seconds"]) if o and o["seconds"] else None)
+        residual = None
+        if pred is not None and obs is not None:
+            residual = pred - obs
+            pred_total += pred
+            obs_total += obs
+            joined += 1
+        rows.append({
+            "key": key,
+            "label": (s or o)["label"],
+            "vertex": (s or o).get("vertex", key.split(":", 1)[0]),
+            "flops": (s or {}).get("flops"),
+            "bound": (s or {}).get("bound"),
+            "predicted_seconds": pred,
+            "observed_seconds": obs,
+            "residual": residual,
+        })
+    rows.sort(key=lambda r: (r["residual"] is None,
+                             -(r["observed_seconds"] or 0.0)))
+    return {
+        "rows": rows,
+        "predicted_seconds": pred_total,
+        "observed_seconds": obs_total,
+        "flops_residual_seconds": (
+            pred_total - obs_total if joined else None),
+        "stages_joined": joined,
+        "machine": {k: roof.get(k) for k in ("peak_flops", "peak_bw")
+                    if roof.get(k) is not None} or None,
+    }
+
+
 # --------------------------------------------------- cost-model drift
 
 
@@ -335,14 +428,21 @@ def cost_model_drift(trace: Dict[str, Any]) -> Dict[str, Any]:
     network_weight·collective_bytes``; a run's node spans carry
     ``seconds`` and ``out_bytes``, so the observed seconds-per-byte over
     the run bounds the effective ``mem_weight`` (HBM + transport) the
-    plan actually experienced. FLOPs and collective bytes are not span
-    observables, so ``cpu_weight``/``network_weight`` report unmeasured
-    (``implied=None``) and keep their current values in the suggestion —
-    a MULTICHIP run's collective spans can widen this later.
+    plan actually experienced. When the trace additionally carries the
+    static roofline metadata (``keystone.roofline``, PR 12), the
+    per-stage FLOP counts join the same spans and imply a
+    ``cpu_weight`` bound too — plus a flops-residual section
+    (`reconcile_roofline`: predicted vs observed stage seconds under
+    the time model). Collective bytes remain unobserved, so
+    ``network_weight`` reports unmeasured and keeps its current value
+    in the suggestion — a MULTICHIP run's collective spans can widen
+    this later.
 
     Returns ``{"rows": [{weight, current, implied, ratio}],
     "suggested": {cpu_weight, mem_weight, network_weight},
-    "observed_bytes", "observed_seconds", "spans"}``."""
+    "observed_bytes", "observed_seconds", "observed_flops", "spans",
+    "roofline"}`` — ``roofline`` is the flops-residual join (None when
+    the trace carries no roofline metadata or no spans matched)."""
     from ..nodes.learning import cost_model
 
     total_b = 0.0
@@ -359,13 +459,34 @@ def cost_model_drift(trace: Dict[str, Any]) -> Dict[str, Any]:
             total_s += s
             n += 1
     implied_mem = (total_s / total_b) if total_b else None
+
+    # flops side: the embedded roofline joins static per-stage FLOPs
+    # against the same spans' seconds — the compute half of the
+    # recalibration feed
+    roof = reconcile_roofline(trace)
+    total_f = 0.0
+    flop_s = 0.0
+    for r in roof["rows"]:
+        if r["residual"] is not None and r["flops"]:
+            total_f += float(r["flops"])
+            flop_s += float(r["observed_seconds"])
+    implied_cpu = (flop_s / total_f) if total_f else None
+    roofline_section = None
+    if roof["stages_joined"]:
+        roofline_section = {
+            "stages_joined": roof["stages_joined"],
+            "predicted_seconds": roof["predicted_seconds"],
+            "observed_seconds": roof["observed_seconds"],
+            "flops_residual_seconds": roof["flops_residual_seconds"],
+        }
+
     current = {
         "cpu_weight": float(cost_model.CPU_WEIGHT),
         "mem_weight": float(cost_model.MEM_WEIGHT),
         "network_weight": float(cost_model.NETWORK_WEIGHT),
     }
     rows = []
-    for name, implied in (("cpu_weight", None),
+    for name, implied in (("cpu_weight", implied_cpu),
                           ("mem_weight", implied_mem),
                           ("network_weight", None)):
         rows.append({
@@ -377,12 +498,16 @@ def cost_model_drift(trace: Dict[str, Any]) -> Dict[str, Any]:
     suggested = dict(current)
     if implied_mem:
         suggested["mem_weight"] = implied_mem
+    if implied_cpu:
+        suggested["cpu_weight"] = implied_cpu
     return {
         "rows": rows,
         "suggested": suggested,
         "observed_bytes": total_b,
         "observed_seconds": total_s,
+        "observed_flops": total_f,
         "spans": n,
+        "roofline": roofline_section,
     }
 
 
@@ -410,6 +535,16 @@ def format_drift(drift: Dict[str, Any]) -> str:
     lines.append(
         f"({drift['spans']} span(s), {_fmt(drift['observed_bytes'])} over "
         f"{drift['observed_seconds']:.4f}s)")
+    roof = drift.get("roofline")
+    if roof is not None:
+        # the flops-residual column: the roofline time model's promise
+        # vs what the joined spans actually took
+        lines.append(
+            f"{'flops residual':<16} "
+            f"predicted={roof['predicted_seconds']:.4f}s "
+            f"observed={roof['observed_seconds']:.4f}s "
+            f"Δ={roof['flops_residual_seconds']:+.4f}s "
+            f"({roof['stages_joined']} stage(s) joined)")
     return "\n".join(lines)
 
 

@@ -33,6 +33,22 @@ class CostWeights:
     cpu_weight: float  # seconds per FLOP
     mem_weight: float  # seconds per HBM byte touched
     network_weight: float  # seconds per all-reduced byte
+    #: peak rates implied by the SAME microbenchmarks (the GEMM probe's
+    #: sustained MXU rate, the elementwise probe's HBM stream
+    #: bandwidth): the roofline analyzer's machine-balance inputs
+    #: (analysis/roofline.py). Default 0.0 resolves to the weight
+    #: reciprocals in ``__post_init__`` so every existing constructor —
+    #: including `reconcile.drift_cost_weights` — keeps working and the
+    #: two views (seconds-per-unit, units-per-second) can never
+    #: disagree.
+    peak_flops: float = 0.0  # FLOP/s
+    peak_bw: float = 0.0     # HBM B/s
+
+    def __post_init__(self):
+        if not self.peak_flops and self.cpu_weight > 0:
+            self.peak_flops = 1.0 / self.cpu_weight
+        if not self.peak_bw and self.mem_weight > 0:
+            self.peak_bw = 1.0 / self.mem_weight
 
 
 def _time_chained(build_step, x0, iters: int) -> float:
@@ -151,3 +167,35 @@ def calibrate_cost_weights(
 def default_weights() -> CostWeights:
     return CostWeights(cost_model.CPU_WEIGHT, cost_model.MEM_WEIGHT,
                        cost_model.NETWORK_WEIGHT)
+
+
+#: Honest CPU-backend analytic peaks, used when no measured calibration
+#: applies and the live platform is the CPU backend: an order-of-
+#: magnitude model of a few-core AVX host (~50 GFLOP/s sustained,
+#: ~20 GB/s DDR stream). Claiming the v5e analytic peaks (2e14 FLOP/s,
+#: 8e11 B/s) on a dev box would misclassify every stage's roofline
+#: bound — the machine balance would be ~100× too high.
+CPU_PEAK_FLOPS = 5.0e10
+CPU_PEAK_BW = 2.0e10
+
+
+def machine_rates() -> "tuple[float, float]":
+    """``(peak_flops, peak_bw)`` — the roofline's machine balance from
+    ONE place, the same resolution the solver cost model reads:
+
+      - a measured calibration file whose platform matches the live
+        backend wins (its weight reciprocals ARE the sustained peaks
+        the probes measured);
+      - otherwise, on a CPU backend, the honest CPU analytic peaks
+        above (the v5e analytic model would be off by ~1000×);
+      - otherwise the analytic v5e-class peaks
+        (`cost_model.ANALYTIC_*` reciprocals).
+
+    Never initializes a JAX backend (the platform check is
+    `cost_model._live_platform_no_init`)."""
+    cw, mw, _ = cost_model._resolve_weights()
+    analytic = (cw == cost_model.ANALYTIC_CPU_WEIGHT
+                and mw == cost_model.ANALYTIC_MEM_WEIGHT)
+    if analytic and cost_model._live_platform_no_init() == "cpu":
+        return CPU_PEAK_FLOPS, CPU_PEAK_BW
+    return 1.0 / cw, 1.0 / mw

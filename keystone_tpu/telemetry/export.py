@@ -338,6 +338,21 @@ def summarize(trace: Dict[str, Any], top: int = 15) -> str:
     except Exception as e:  # a malformed trace must still summarize
         lines.append(f"\n(memory reconciliation unavailable: {e})")
 
+    try:
+        from ..analysis.reconcile import reconcile_roofline
+
+        roof = reconcile_roofline(trace)
+        if roof["stages_joined"]:
+            lines.append(
+                f"\n== roofline (predicted vs observed seconds) ==")
+            lines.append(
+                f"{roof['stages_joined']} stage(s) joined: predicted "
+                f"{roof['predicted_seconds']:.4f}s, observed "
+                f"{roof['observed_seconds']:.4f}s, flops residual "
+                f"{roof['flops_residual_seconds']:+.4f}s")
+    except Exception:
+        pass  # advisory: partial traces summarize without it
+
     caps = ks.get("capabilities") or {}
     absent = {k: v for k, v in caps.items() if not v.get("available", True)}
     if absent:

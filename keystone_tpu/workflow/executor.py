@@ -294,6 +294,41 @@ class GraphExecutor:
             meta["per_device_peak_bytes"] = max(
                 meta.get("per_device_peak_bytes", 0),
                 int(getattr(est, "per_device_peak_bytes", 0) or 0))
+            # roofline side (KP803's trace half): per-stage flops /
+            # bytes / predicted seconds, so analysis.reconcile can join
+            # the time model against this run's observed span timings
+            # (the flops-residual column of the drift report)
+            try:
+                from ..analysis.roofline import roofline_pass
+
+                roof, _ = roofline_pass(graph, specs)
+                rmeta = tracer.metadata.setdefault(
+                    "roofline",
+                    {"per_node": {}, "plan_predicted_seconds": 0.0,
+                     "peak_flops": roof.machine.peak_flops,
+                     "peak_bw": roof.machine.peak_bw})
+                for vid, st in roof.stages.items():
+                    key = node_key(vid.id, st.label)
+                    prev = rmeta["per_node"].get(key)
+                    # fit/apply graph id:label collisions keep the
+                    # larger prediction, matching static_memory above
+                    if prev is None or prev["predicted_seconds"] \
+                            < st.predicted_seconds:
+                        rmeta["per_node"][key] = {
+                            "label": st.label,
+                            "vertex": vid.id,
+                            "flops": float(st.flops),
+                            "hbm_bytes": int(st.hbm_bytes),
+                            "intensity": float(st.intensity),
+                            "bound": st.bound,
+                            "predicted_seconds": float(
+                                st.predicted_seconds),
+                        }
+                rmeta["plan_predicted_seconds"] = max(
+                    rmeta["plan_predicted_seconds"],
+                    float(roof.plan_seconds))
+            except Exception:
+                pass  # the byte estimates above must still land
         except Exception:  # estimation must never break execution
             pass
 

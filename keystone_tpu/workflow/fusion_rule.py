@@ -55,16 +55,34 @@ from .optimizer import Plan, Rule
 
 
 def _record_fusion_decision(kind: str, rule: str, chain, labels,
-                            chosen_entry: str, programs_before: int) -> None:
+                            chosen_entry: str, programs_before: int,
+                            graph: Graph = None) -> None:
     """One ledger record per enforced fusion rewrite: the chain's
     vertices/labels, the chosen program shape, the per-stage dispatch
     alternative it beat, and the predicted program arithmetic in the
     shared units (programs-per-apply; one cold compile upper-bounds the
-    fresh program — the persistent cache may serve it warm). Never
-    raises: a ledger bug must not break the rewrite it records."""
+    fresh program — the persistent cache may serve it warm). With a
+    durable ledger destination armed, the record additionally carries
+    the chain's roofline ``predicted_seconds``
+    (`analysis.roofline.chain_predicted_seconds` over the bound graph's
+    propagated specs) — the time-domain prediction `reconcile` joins
+    against the run's observed spans. Never raises: a ledger bug must
+    not break the rewrite it records."""
     try:
         from ..telemetry import ledger
 
+        predicted = {"programs_per_apply": 1,
+                     "programs_eliminated": max(0, programs_before - 1),
+                     "cold_compiles_max": 1}
+        # roofline pricing traces stage jaxprs — worth it only when the
+        # record reaches a durable destination (trace/JSONL), not on
+        # every optimizer run's session-only bookkeeping
+        if graph is not None and ledger.ledger_active():
+            from ..analysis.roofline import chain_predicted_seconds
+
+            seconds = chain_predicted_seconds(graph, list(chain))
+            if seconds is not None:
+                predicted["predicted_seconds"] = seconds
         ledger.record_decision(
             kind=kind,
             rule=rule,
@@ -75,9 +93,7 @@ def _record_fusion_decision(kind: str, rule: str, chain, labels,
             alternatives=[{"entry": "per_stage_dispatch",
                            "programs": programs_before,
                            "cost_programs": programs_before}],
-            predicted={"programs_per_apply": 1,
-                       "programs_eliminated": max(0, programs_before - 1),
-                       "cold_compiles_max": 1},
+            predicted=predicted,
         )
     except Exception:
         pass
@@ -466,7 +482,8 @@ class MegafusionRule(Rule):
                 [graph.get_operator(n).label for n in chain],
                 "megafused_scan_program",
                 max(1, sum(1 for n in chain
-                           if self._member_kind(graph, n) != "cache")))
+                           if self._member_kind(graph, n) != "cache")),
+                graph=graph)
             head_data_dep = self._data_dep(graph, chain[0])
             est_deps: List = []
             stage_specs: List = []
@@ -665,7 +682,7 @@ class NodeFusionRule(Rule):
                 [graph.get_operator(b).label for b in deps]
                 + [graph.get_operator(g).label,
                    graph.get_operator(kid).label],
-                "gather_concat_program", len(deps) + 1)
+                "gather_concat_program", len(deps) + 1, graph=graph)
             stage = _GatherConcatStage([graph.get_operator(b) for b in deps])
             graph = graph.set_operator(
                 kid, FusedBatchTransformer([stage], microbatch=self.microbatch))
@@ -740,7 +757,7 @@ class NodeFusionRule(Rule):
             _record_fusion_decision(
                 "fusion", type(self).__name__, chain,
                 [graph.get_operator(n).label for n in chain],
-                "fused_chain_program", len(chain))
+                "fused_chain_program", len(chain), graph=graph)
             head_data_dep = self._data_dep(graph, chain[0])
             est_deps: List = []
             stage_specs: List = []

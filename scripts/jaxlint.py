@@ -119,6 +119,21 @@ system cannot (see ANALYSIS.md for the full catalog):
          ``telemetry/instrument.py``, outside this rule's scope, and
          any genuine in-scope exception carries a suppression.
 
+  KJ013  transpose-then-reshape (under ``workflow/`` and ``nodes/``): a
+         ``.reshape(...)`` whose receiver (or ``jnp.reshape`` whose
+         argument) contains a transpose — ``.T``/``.mT``,
+         ``transpose(...)``, ``swapaxes``/``moveaxis`` — inside a
+         ``fuse()``, ``_chunk_loop``, or ``_build_program`` body. A
+         transpose feeding a reshape cannot stay a free layout
+         relabeling: XLA must materialize the permuted buffer before
+         re-flattening it, so the fused program pays a full
+         write+read of the tensor that the roofline's boundary-bytes
+         model (analysis/roofline.py) cannot see — the in-body twin of
+         the KP802 movement-dominance lint. Reorder the computation
+         (reshape first, or keep the axis order end-to-end); genuine
+         layout contracts (kernel-required NHWC flips) carry a
+         suppression with the rationale.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -177,6 +192,11 @@ RULES = {
              "vertex/label name mints unbounded metric cardinality "
              "(use one literal name; carry the dimension in a span "
              "arg)",
+    "KJ013": "transpose-then-reshape chain inside a fused-program body "
+             "(fuse()/_chunk_loop/_build_program): the permuted buffer "
+             "must materialize before the reshape, a full write+read "
+             "the roofline's boundary-bytes model cannot see — reorder "
+             "the computation or keep the axis order end-to-end",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -919,6 +939,61 @@ def _check_literal_precision_cast(tree: ast.AST, path: str
                     "derive the dtype from the input instead")
 
 
+#: attribute spellings that mean "transpose" on an array expression.
+_TRANSPOSE_ATTRS = {"T", "mT"}
+#: call names that permute axes (method or jnp.* form).
+_TRANSPOSE_CALLS = {"transpose", "swapaxes", "moveaxis", "permute_dims"}
+
+
+def _contains_transpose(node: ast.AST) -> Optional[int]:
+    """Line number of a transpose buried in an expression — a ``.T`` /
+    ``.mT`` attribute read, or a ``transpose``/``swapaxes``/
+    ``moveaxis`` call — or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _TRANSPOSE_ATTRS \
+                and isinstance(sub.ctx, ast.Load):
+            return sub.lineno
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _TRANSPOSE_CALLS:
+            return sub.lineno
+    return None
+
+
+def _check_transpose_reshape(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ013 (under ``workflow/``/``nodes/``): a transpose-then-reshape
+    chain inside a ``fuse()`` / ``_chunk_loop`` / ``_build_program``
+    body — the code that becomes part of a fused XLA program. Two
+    spellings are matched: ``<expr with transpose>.reshape(...)``
+    (method chain, ``x.T.reshape(...)`` included) and
+    ``jnp.reshape(<expr with transpose>, ...)``. A reshape over a
+    permuted view forces the permuted buffer to materialize — a full
+    write+read of the tensor invisible to the roofline's boundary
+    bytes; the stage shows up as KP802 movement dominance at the graph
+    level, and here at the file level with zero imports."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef) \
+                or fn.name not in {"fuse", "_chunk_loop", "_build_program"}:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Attribute) and func.attr == "reshape":
+                root = _attr_root(func)
+                if root in _JNP_NAMES:
+                    target = sub.args[0] if sub.args else None
+                else:
+                    target = func.value
+                if target is not None and _contains_transpose(target):
+                    yield Finding(
+                        path, sub.lineno, "KJ013",
+                        "transpose-then-reshape in a fused-program body: "
+                        "the permuted buffer materializes before the "
+                        "reshape (a full write+read the roofline's "
+                        "boundary-bytes model cannot see); reorder the "
+                        "computation or keep the axis order end-to-end")
+
+
 #: the telemetry metric factories whose name argument KJ012 audits
 #: (alias-tolerant: ``from ..telemetry import counter as _counter`` is
 #: still the same registry entry point).
@@ -1043,6 +1118,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_output_layout_leak(tree, rel))
         findings.extend(_check_literal_precision_cast(tree, rel))
         findings.extend(_check_dynamic_metric_name(tree, rel))
+        findings.extend(_check_transpose_reshape(tree, rel))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
 

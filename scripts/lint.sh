@@ -116,9 +116,47 @@ print(f"precision audit: {len(examples)} example(s), strict wins on {strict}, "
       f"{saved:,} boundary bytes saved, 0 KP7xx under chosen policies OK")
 PY
 
+echo "== roofline audit (per-stage flops/bytes/intensity over every example) =="
+# The static roofline analyzer's gate: price every analyzable() example
+# on the calibrated machine balance and assert (1) zero unsuppressed
+# ERROR-severity KP8xx findings (the tier is advisory — KP801/KP803
+# candidates and re-pricings are INFO), (2) the device-featurize
+# examples actually price (stage rows with flops/bytes/intensity/
+# predicted-seconds present), and (3) the KP801 Pallas-candidate list
+# is non-empty — the Pallas megakernel backend (ROADMAP) needs a
+# statically identified bandwidth-bound chain to target.
+ROOFLINE_JSON="$(mktemp /tmp/keystone_roofline_audit.XXXXXX.json)"
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON"' EXIT
+JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --explain-roofline \
+    --json > "$ROOFLINE_JSON"
+python - "$ROOFLINE_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+machine = payload["machine"]
+assert machine and machine["peak_flops"] > 0 and machine["peak_bw"] > 0
+examples = payload["examples"]
+assert len(examples) >= 7, [e["example"] for e in examples]
+candidates = 0
+priced = 0
+for e in examples:
+    assert "build_error" not in e, e
+    errors = [f for f in e["findings"] if f["severity"] == "ERROR"]
+    assert errors == [], (e["example"], errors)
+    for s in e["stages"]:
+        assert s["flops"] >= 0 and s["hbm_bytes"] > 0, (e["example"], s)
+        assert s["bound"] in ("compute", "bandwidth"), s
+        assert s["predicted_seconds"] > 0, s
+    priced += len(e["stages"])
+    candidates += len(e["candidates"])
+assert priced > 0, "no example priced a single stage"
+assert candidates >= 1, "KP801 found no Pallas-candidate chain anywhere"
+print(f"roofline audit: {len(examples)} example(s), {priced} priced stage "
+      f"rows, {candidates} KP801 pallas candidate(s), 0 KP8xx errors OK")
+PY
+
 echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
 TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_SMOKE_TRACE="$TRACE_TMP" python - <<'PY'
 import json, os
 import numpy as np
@@ -142,7 +180,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
 
 echo "== dispatch smoke (example pipeline under the concurrent scheduler) =="
 DISPATCH_TRACE="$(mktemp /tmp/keystone_dispatch_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_TRACE="$DISPATCH_TRACE" KEYSTONE_CONCURRENT_DISPATCH=1 \
 python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance) run
@@ -174,7 +212,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
 echo "== compile smoke (warm second run performs 0 cold compiles) =="
 COMPILE_CACHE="$(mktemp -d /tmp/keystone_compile_smoke.XXXXXX)"
 COMPILE_TRACE="$(mktemp /tmp/keystone_compile_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$COMPILE_CACHE" \
 KEYSTONE_TRACE="$COMPILE_TRACE" python - <<'PY'
 # One example pipeline run TWICE against a fresh persistent-cache dir
@@ -218,7 +256,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
 echo "== megafusion smoke (1-program apply run; warm repeat stays 0-cold) =="
 MEGA_CACHE="$(mktemp -d /tmp/keystone_mega_smoke.XXXXXX)"
 MEGA_TRACE="$(mktemp /tmp/keystone_mega_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_MEGAFUSION=1 KEYSTONE_COMPILE_CACHE="$MEGA_CACHE" \
 KEYSTONE_TRACE="$MEGA_TRACE" python - <<'PY'
 # One example apply run TWICE under megafusion against a fresh
@@ -262,7 +300,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$MEGA_TRACE" >/dev/null
 echo "== ledger smoke (decision records match enforced plan tags; self-diff clean) =="
 LEDGER_TRACE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.json)"
 LEDGER_FILE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.jsonl)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 KEYSTONE_TRACE="$LEDGER_TRACE" KEYSTONE_LEDGER="$LEDGER_FILE" python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance,

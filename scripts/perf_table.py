@@ -5,6 +5,15 @@ PERF.md.
 Usage: python scripts/perf_table.py [path=BENCH_LAST_GOOD.json]
        python scripts/perf_table.py --trace run.json [--top N]
        python scripts/perf_table.py --ledger run.ledger.jsonl
+       python scripts/perf_table.py --roofline [EXAMPLE ...]
+
+``--roofline`` runs the STATIC roofline analyzer
+(keystone_tpu/analysis/roofline.py) over the named analyzable()
+examples (default: the three bench examples) and renders the per-stage
+markdown table PERF.md rounds source their intensity columns from —
+flops, stage-at-a-time HBM bytes, arithmetic intensity, the
+compute/bandwidth classification against the calibrated machine
+balance, predicted seconds, and the KP801 Pallas-candidate chains.
 
 ``--trace`` renders a Chrome trace (written via KEYSTONE_TRACE /
 `trace_run`, e.g. the ``trace_artifact`` path a bench record carries) as
@@ -89,6 +98,30 @@ def trace_table(path, top=15):
             print("```\n" + format_reconciliation(rec) + "\n```")
     except Exception:
         pass
+    try:
+        from keystone_tpu.analysis.reconcile import reconcile_roofline
+
+        roof = reconcile_roofline(trace)
+        if roof["stages_joined"]:
+            print("\n**Roofline** (static predicted vs observed span "
+                  "seconds)\n")
+            print("| Stage | FLOPs | Bound | Predicted s | Observed s | "
+                  "Residual s |")
+            print("|---|---|---|---|---|---|")
+            for r in roof["rows"]:
+                if r["residual"] is None:
+                    continue
+                print(f"| {r['label'][:40]} | {r['flops']:.3g} | "
+                      f"{r['bound'] or '—'} | "
+                      f"{r['predicted_seconds']:.3e} | "
+                      f"{r['observed_seconds']:.3e} | "
+                      f"{r['residual']:+.3e} |")
+            print(f"\nflops residual: predicted "
+                  f"{roof['predicted_seconds']:.4f}s vs observed "
+                  f"{roof['observed_seconds']:.4f}s over "
+                  f"{roof['stages_joined']} joined stage(s)\n")
+    except Exception:
+        pass
     if trace.get("keystone", {}).get("decisions"):
         print()
         ledger_table(path)
@@ -154,7 +187,59 @@ def ledger_table(path):
     print()
 
 
+#: the bench examples whose roofline table PERF.md rounds carry.
+_ROOFLINE_DEFAULT_EXAMPLES = (
+    "MnistRandomFFT", "RandomPatchCifar", "TimitPipeline")
+
+
+def roofline_table(examples=None):
+    """Markdown per-stage roofline table from the STATIC analyzer (no
+    run needed): the PERF.md round-table source for per-stage
+    arithmetic intensity."""
+    sys.path.insert(0, ".")
+    from keystone_tpu.analysis import as_source_spec
+    from keystone_tpu.analysis.examples import build_example
+    from keystone_tpu.analysis.propagate import spec_pass
+    from keystone_tpu.analysis.roofline import roofline_pass
+
+    machine = None
+    for name in examples or _ROOFLINE_DEFAULT_EXAMPLES:
+        pipeline, source_spec = build_example(name)
+        specs, _ = spec_pass(
+            pipeline.graph, {pipeline.source: as_source_spec(source_spec)})
+        est, _ = roofline_pass(pipeline.graph, specs)
+        machine = est.machine
+        print(f"**{name}** — ≈{est.plan_seconds:.3e}s predicted over "
+              f"{len(est.stages)} priced stage(s), "
+              f"{len(est.candidates)} pallas candidate(s)\n")
+        rows = est.rows(pipeline.graph)
+        if rows:
+            print("| Stage | FLOPs | HBM bytes | FLOP/B | Bound | "
+                  "Predicted s |")
+            print("|---|---|---|---|---|---|")
+            for r in rows:
+                print(f"| {r['label'][:44]} | {r['flops']:.3g} | "
+                      f"{int(r['hbm_bytes']):,} | {r['intensity']:.2f} | "
+                      f"{r['bound']} | {r['predicted_seconds']:.3e} |")
+            print()
+        for c in est.candidates:
+            print(f"- KP801 candidate ({c['kind']}): "
+                  f"{' >> '.join(c['stages'])} — "
+                  f"{c['boundary_bytes']:,} boundary bytes, "
+                  f"≈{c['seconds_saved']:.2e}s saved")
+        if est.candidates:
+            print()
+    if machine is not None:
+        print(f"(machine balance {machine.balance:.1f} FLOP/B — peaks "
+              f"{machine.peak_flops:.3g} FLOP/s, "
+              f"{machine.peak_bw:.3g} B/s)")
+
+
 def main():
+    if "--roofline" in sys.argv:
+        names = [a for a in sys.argv[sys.argv.index("--roofline") + 1:]
+                 if not a.startswith("-")]
+        return roofline_table(names or None)
     if "--ledger" in sys.argv:
         return ledger_table(sys.argv[sys.argv.index("--ledger") + 1])
     if "--trace" in sys.argv:

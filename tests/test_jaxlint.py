@@ -748,6 +748,92 @@ def test_kj012_suppression(tmp_path):
     assert jl.lint_file(src) == []
 
 
+def test_kj013_flags_transpose_then_reshape_in_fused_bodies(tmp_path):
+    """KJ013: transpose-then-reshape chains inside `fuse()` /
+    `_chunk_loop` / `_build_program` bodies — the permuted buffer must
+    materialize before the reshape, a full write+read the roofline's
+    boundary-bytes model cannot see. All the spellings flag: `.T`
+    method chains, `jnp.transpose(...)` fed to `.reshape`,
+    `jnp.reshape(<transposed>, ...)`, and `.swapaxes` chains."""
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "bad_layout.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def fuse(self):\n"
+        "        def fn(p, x):\n"
+        "            a = x.T.reshape(-1, 4)\n"                    # KJ013
+        "            b = jnp.transpose(x, (1, 0)).reshape(8,)\n"  # KJ013
+        "            c = jnp.reshape(x.swapaxes(0, 1), (-1,))\n"  # KJ013
+        "            return a, b, c\n"
+        "        return ((\"T\",), (), fn)\n"
+        "\n"
+        "    def _chunk_loop(self, fn, params, xs, ms):\n"
+        "        return fn(params, xs.mT.reshape(-1, 2), ms)\n"   # KJ013
+        "\n"
+        "    def _build_program(self, mesh):\n"
+        "        def chunk_fn(xs):\n"
+        "            return jnp.moveaxis(xs, 0, 1).reshape(4, -1)\n"  # KJ013
+        "        return chunk_fn\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ013"] * 5, findings
+
+    # reshape alone, transpose alone, and transpose AFTER reshape pass
+    ok = tmp_path / "workflow" / "ok_layout.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def fuse(self):\n"
+        "        def fn(p, x):\n"
+        "            a = x.reshape(-1, 4)\n"
+        "            b = a.T\n"
+        "            c = jnp.reshape(x, (8,)).swapaxes(0, 0)\n"
+        "            return a, b, c\n"
+        "        return ((\"T\",), (), fn)\n"
+        "\n"
+        "    def apply(self, x):\n"
+        "        # outside fused bodies the chain is host-side prep,\n"
+        "        # not program traffic\n"
+        "        return x.T.reshape(-1)\n"
+    )
+    assert jl.lint_file(ok) == []
+
+    # outside nodes/ and workflow/, KJ013 does not apply
+    elsewhere = tmp_path / "loaders" / "ok_layout.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj013_suppression(tmp_path):
+    """A genuine layout contract (a kernel-required NHWC flip) carries
+    the standard suppression with a rationale."""
+    jl = _jaxlint()
+    src = tmp_path / "workflow" / "suppressed_layout.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def fuse(self):\n"
+        "        def fn(p, x):\n"
+        "            # the conv kernel demands HWIO: the flip IS the\n"
+        "            # stage's contract\n"
+        "            return x.T.reshape(-1, 4)"
+        "  # keystone: ignore[KJ013]\n"
+        "        return ((\"T\",), (), fn)\n"
+    )
+    assert jl.lint_file(src) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""

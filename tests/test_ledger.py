@@ -438,19 +438,30 @@ def test_cost_model_drift_from_trace(tmp_path):
     drift = cost_model_drift(trace)
     assert drift["spans"] > 0 and drift["observed_bytes"] > 0
     by_weight = {r["weight"]: r for r in drift["rows"]}
-    # mem_weight is implied by observed seconds-per-byte; cpu/network
-    # have no span observable and keep their current values
+    # mem_weight is implied by observed seconds-per-byte; cpu_weight by
+    # the embedded roofline FLOPs joined against the same spans (the
+    # executor embeds keystone.roofline on every traced run); network
+    # has no span observable and keeps its current value
     assert by_weight["mem_weight"]["implied"] == pytest.approx(
         drift["observed_seconds"] / drift["observed_bytes"])
-    assert by_weight["cpu_weight"]["implied"] is None
+    assert by_weight["cpu_weight"]["implied"] is not None
+    assert drift["observed_flops"] > 0
+    assert by_weight["cpu_weight"]["implied"] > 0
+    assert by_weight["network_weight"]["implied"] is None
     assert drift["suggested"]["mem_weight"] == \
         by_weight["mem_weight"]["implied"]
     assert drift["suggested"]["cpu_weight"] == \
-        by_weight["cpu_weight"]["current"]
+        by_weight["cpu_weight"]["implied"]
+    assert drift["suggested"]["network_weight"] == \
+        by_weight["network_weight"]["current"]
+    assert drift["roofline"] is not None
+    assert drift["roofline"]["stages_joined"] > 0
 
     weights = drift_cost_weights(trace)
     assert isinstance(weights, CostWeights)
     assert weights.mem_weight == drift["suggested"]["mem_weight"]
+    assert weights.cpu_weight == drift["suggested"]["cpu_weight"]
 
     rendered = format_drift(drift)
     assert "mem_weight" in rendered and "unmeasured" in rendered
+    assert "flops residual" in rendered

@@ -1,0 +1,489 @@
+"""Static roofline analyzer (KP8xx) acceptance suite — PR 12.
+
+Covers the contract the tier exists for:
+
+  - the jaxpr FLOP walk prices the canonical primitives exactly
+    (GEMM 2mnk, conv 2·out·k·k·cin, elementwise at out-size, scan ×
+    trips) and distinguishes movement bytes from compute;
+  - the jaxpr walk is the SOURCE OF TRUTH, with the backend's
+    `Lowered.cost_analysis()` as a cross-check: the two agree within
+    2× on a GEMM stage whenever the backend provides an analysis
+    (pytest-pinned — the capability-probe fallback satellite);
+  - `stage_cost` is exactly ``max(flops/peak_flops, bytes/peak_bw)``
+    and classification flips at the machine balance;
+  - `roofline_pass` prices the example pipelines, flags ≥1 KP801
+    Pallas candidate on the featurize-heavy RandomPatchCifar, KP802 on
+    a movement-dominated stage, KP804 on an underfilled megafused
+    scan, and the KP803 plan re-pricing is present;
+  - the CLI gate: ``--explain-roofline --json`` succeeds over all 7
+    examples with per-stage flops/bytes/intensity/predicted-seconds;
+  - reconciliation: a traced MnistRandomFFT run embeds the per-stage
+    predictions (``keystone.roofline``), `reconcile_roofline` joins
+    them against observed span seconds, the drift report carries the
+    flops residual, and ``--ledger`` renders without crashing when
+    spans are missing.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import PipelineEnv
+from keystone_tpu.analysis import SpecDataset, as_source_spec, validate_graph
+from keystone_tpu.analysis.examples import EXAMPLES, build_example
+from keystone_tpu.analysis.propagate import spec_pass
+from keystone_tpu.analysis.roofline import (
+    DISPATCH_OVERHEAD_S,
+    Machine,
+    body_counts,
+    chain_predicted_seconds,
+    default_machine,
+    jaxpr_counts,
+    roofline_pass,
+    stage_cost,
+    xla_cost_analysis,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fresh_env():
+    PipelineEnv.reset()
+    yield
+    PipelineEnv.reset()
+
+
+def _sds(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+# ------------------------------------------------------------ FLOP walk
+
+
+def test_gemm_flops_exact():
+    m, k, n = 64, 32, 16
+    jx = jax.make_jaxpr(lambda a, b: a @ b)(_sds((m, k)), _sds((k, n)))
+    flops, movement = jaxpr_counts(jx)
+    assert flops == 2.0 * m * k * n
+    assert movement == 0.0
+
+
+def test_conv_flops_exact():
+    # NHWC x HWIO, VALID: out (1, 6, 6, 8), kernel 3x3, cin 2
+    jx = jax.make_jaxpr(
+        lambda x, w: jax.lax.conv_general_dilated(
+            x, w, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))(
+        _sds((1, 8, 8, 2)), _sds((3, 3, 2, 8)))
+    flops, _ = jaxpr_counts(jx)
+    assert flops == 2.0 * (6 * 6 * 8) * (3 * 3) * 2
+
+
+def test_elementwise_and_reduce_flops():
+    jx = jax.make_jaxpr(lambda x: jnp.tanh(x * 2.0).sum())(_sds((128,)))
+    flops, movement = jaxpr_counts(jx)
+    # mul (128) + tanh (128) + reduce_sum (128); broadcasts are movement
+    assert flops >= 3 * 128
+    assert movement >= 0.0
+
+
+def test_movement_bytes_counted_not_flops():
+    jx = jax.make_jaxpr(
+        lambda x: jnp.transpose(x, (1, 0)).reshape(-1))(_sds((16, 8)))
+    flops, movement = jaxpr_counts(jx)
+    assert flops == 0.0
+    # transpose reads+writes 512B, reshape reads+writes 512B
+    assert movement == 4 * 16 * 8 * 4
+
+
+def test_scan_multiplies_by_trip_count():
+    def body(c, x):
+        return c + x @ x, None
+
+    def run(xs):
+        return jax.lax.scan(body, jnp.zeros((8, 8), jnp.float32), xs)
+
+    jx = jax.make_jaxpr(run)(_sds((10, 8, 8)))
+    flops, _ = jaxpr_counts(jx)
+    per_trip = 2 * 8 * 8 * 8 + 8 * 8  # GEMM + add
+    assert flops >= 10 * per_trip
+
+
+def test_fft_flops_scale_n_log_n():
+    jx = jax.make_jaxpr(
+        lambda x: jnp.fft.rfft(x, n=256, axis=-1))(_sds((4, 256)))
+    flops, _ = jaxpr_counts(jx)
+    assert flops == pytest.approx(5.0 * 256 * 8 * 4)  # 5·n·log2(n)·batch
+
+
+def test_body_counts_is_abstract_and_host_code_safe():
+    counts = body_counts(lambda x: jnp.exp(x), _sds((32,)))
+    assert counts is not None and counts[0] >= 32
+    # host code the tracer cannot enter answers None, never raises
+    assert body_counts(lambda x: str(x).split(), _sds((4,))) is None
+
+
+# -------------------------------------------- cost_analysis cross-check
+
+
+def test_jaxpr_walk_agrees_with_backend_cost_analysis_on_gemm():
+    """Capability-probe satellite: where the backend provides
+    `cost_analysis`, the jaxpr FLOP walk agrees within 2× on a GEMM
+    stage; where it doesn't, the walk is the source of truth and this
+    test documents the fallback."""
+    fn = lambda x: x @ jnp.ones((64, 32), jnp.float32)  # noqa: E731
+    elem = _sds((128, 64))
+    backend = xla_cost_analysis(fn, elem)
+    jx_flops, _ = jaxpr_counts(jax.make_jaxpr(fn)(elem))
+    assert jx_flops == 2.0 * 128 * 64 * 32
+    if backend is None:
+        pytest.skip("backend provides no cost_analysis — jaxpr walk is "
+                    "the (only) source of truth")
+    ratio = backend["flops"] / jx_flops
+    assert 0.5 <= ratio <= 2.0, (backend, jx_flops)
+
+
+def test_xla_cost_analysis_rejects_partial_results():
+    # a host-code body cannot lower: the probe answers None, not a crash
+    assert xla_cost_analysis(lambda x: str(x), _sds((4,))) is None
+
+
+# ------------------------------------------------------------ time model
+
+
+def test_stage_cost_is_max_of_the_two_rates():
+    m = Machine(peak_flops=1e10, peak_bw=1e9)
+    assert stage_cost(1e10, 0, m) == 1.0
+    assert stage_cost(0, 1e9, m) == 1.0
+    assert stage_cost(1e10, 2e9, m) == 2.0  # bytes side dominates
+    assert stage_cost(None, None, m) == 0.0
+
+
+def test_classification_flips_at_machine_balance():
+    m = Machine(peak_flops=1e12, peak_bw=1e10)  # balance 100 FLOP/B
+    from keystone_tpu.nodes.stats import NormalizeRows
+
+    pipe = NormalizeRows().to_pipeline()
+    applied = pipe.apply(SpecDataset((64,), count=128))
+    specs, _ = spec_pass(applied.graph, {})
+    est, _ = roofline_pass(applied.graph, specs, machine=m)
+    assert est.stages, "NormalizeRows did not price"
+    st = next(iter(est.stages.values()))
+    assert st.bound == "bandwidth"  # ~2 FLOP/B << 100
+    est2, _ = roofline_pass(applied.graph, specs,
+                            machine=Machine(1e12, 1e13))  # balance 0.1
+    st2 = next(iter(est2.stages.values()))
+    assert st2.bound == "compute"
+    assert st2.intensity == pytest.approx(st.intensity)
+
+
+def test_default_machine_reads_calibration_plumbing():
+    from keystone_tpu.nodes.learning.calibrate import (
+        CostWeights,
+        machine_rates,
+    )
+
+    m = default_machine()
+    pf, pb = machine_rates()
+    assert (m.peak_flops, m.peak_bw) == (pf, pb)
+    assert m.balance > 0
+    # CostWeights derives peaks from weight reciprocals unless told
+    w = CostWeights(1e-12, 1e-11, 1e-11)
+    assert w.peak_flops == pytest.approx(1e12)
+    assert w.peak_bw == pytest.approx(1e11)
+    w2 = CostWeights(1e-12, 1e-11, 1e-11, peak_flops=3.0, peak_bw=4.0)
+    assert (w2.peak_flops, w2.peak_bw) == (3.0, 4.0)
+
+
+def test_machine_rates_honest_on_cpu_backend():
+    """The CPU backend must not claim v5e analytic peaks: the machine
+    balance would be ~100× off and every stage would misclassify."""
+    from keystone_tpu.nodes.learning import cost_model
+    from keystone_tpu.nodes.learning.calibrate import (
+        CPU_PEAK_BW,
+        CPU_PEAK_FLOPS,
+        machine_rates,
+    )
+
+    pf, pb = machine_rates()
+    if cost_model._live_platform_no_init() == "cpu" and (
+            float(cost_model.CPU_WEIGHT)
+            == cost_model.ANALYTIC_CPU_WEIGHT):
+        assert (pf, pb) == (CPU_PEAK_FLOPS, CPU_PEAK_BW)
+    assert pf < 1e15 and pb < 1e13  # sanity whatever the resolution
+
+
+# ------------------------------------------------------------ graph pass
+
+
+def test_roofline_pass_prices_examples_and_flags_kp801():
+    pipe, spec = build_example("RandomPatchCifar")
+    specs, _ = spec_pass(pipe.graph, {pipe.source: as_source_spec(spec)})
+    est, diags = roofline_pass(pipe.graph, specs)
+    assert est.stages and est.plan_seconds > 0
+    rules = {d.rule for d in diags}
+    assert "KP801" in rules and "KP803" in rules
+    assert est.candidates, "the featurize chain must be a candidate"
+    cand = est.candidates[0]
+    assert cand["n_stages"] >= 2
+    assert cand["boundary_bytes"] > 0 and cand["seconds_saved"] > 0
+    # the known bandwidth-bound featurize members are in the chain
+    names = {s for c in est.candidates for s in c["stages"]}
+    assert {"SymmetricRectifier", "Pooler"} & names, names
+
+
+def test_kp802_flags_movement_dominated_stage():
+    from keystone_tpu import Transformer
+
+    layout = Transformer.from_function(
+        lambda x: jnp.transpose(x.reshape(8, 8), (1, 0)).reshape(-1),
+        name="LayoutChurn")
+    applied = layout.to_pipeline().apply(SpecDataset((64,), count=256))
+    specs, _ = spec_pass(applied.graph, {})
+    est, diags = roofline_pass(applied.graph, specs)
+    kp802 = [d for d in diags if d.rule == "KP802"]
+    assert kp802 and "LayoutChurn" in kp802[0].label
+
+
+def _megafused_graph(shape, count):
+    """A one-vertex megafused plan over a SpecDataset input — the shape
+    `MegafusionRule` produces for a whole-plan fitted chain, built
+    directly so the test controls the trip arithmetic."""
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+    from keystone_tpu.workflow.fusion_rule import MegafusedPlanOperator
+
+    pipe = NormalizeRows().to_pipeline() >> SignedHellingerMapper()
+    applied = pipe.apply(SpecDataset(shape, count=count))
+    graph = applied.graph
+    # collapse the two stage vertices into one megafused operator, as
+    # MegafusionRule would for the fitted whole-plan chain
+    head = next(n for n in graph.operators
+                if isinstance(graph.get_operator(n), NormalizeRows))
+    tail = next(n for n in graph.operators
+                if isinstance(graph.get_operator(n),
+                              SignedHellingerMapper))
+    data_dep = graph.get_dependencies(head)[0]
+    mega = MegafusedPlanOperator(
+        [NormalizeRows(), SignedHellingerMapper()])
+    graph = graph.set_operator(head, mega)
+    graph = graph.replace_dependency(tail, head)
+    graph = graph.set_dependencies(head, (data_dep,))
+    graph = graph.set_dependencies(tail, ())
+    graph = graph.remove_node(tail)
+    return graph
+
+
+def test_kp804_flags_underfilled_megafused_scan():
+    graph = _megafused_graph((4,), count=8)
+    specs, _ = spec_pass(graph, {})
+    est, diags = roofline_pass(graph, specs, chunk_rows=8)
+    kp804 = [d for d in diags if d.rule == "KP804"]
+    assert kp804, [str(d) for d in diags]
+    assert "chunk_size" in kp804[0].message
+    # a fat chunk amortizes: the lint stays quiet
+    graph2 = _megafused_graph((1 << 14,), count=1 << 16)
+    specs2, _ = spec_pass(graph2, {})
+    _, diags2 = roofline_pass(graph2, specs2, chunk_rows=1 << 16)
+    assert not [d for d in diags2 if d.rule == "KP804"]
+
+
+def test_fused_chain_trail_is_priced_per_stage():
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+    from keystone_tpu.workflow.fusion_rule import NodeFusionRule
+
+    pipe = (NormalizeRows().to_pipeline() >> SignedHellingerMapper())
+    applied = pipe.apply(SpecDataset((64,), count=128))
+    graph, _ = NodeFusionRule().apply((applied.graph, {}))
+    specs, _ = spec_pass(graph, {})
+    est, _ = roofline_pass(graph, specs)
+    fused = [s for s in est.stages.values() if s.trail]
+    assert fused, "the fused chain must carry a per-stage trail"
+    st = fused[0]
+    assert len(st.trail) == 2
+    assert all(r["predicted_seconds"] > 0 for r in st.trail)
+    assert st.internal_boundary_bytes > 0
+    assert st.flops == pytest.approx(
+        sum(r["flops"] for r in st.trail))
+
+
+def test_validate_full_carries_roofline():
+    pipe, spec = build_example("MnistRandomFFT")
+    report = pipe.validate(spec, level="full", raise_on_error=False)
+    assert report.roofline is not None
+    assert report.roofline.stages
+    assert report.by_rule("KP803")
+    # level below full has no roofline
+    lite = pipe.validate(spec, level="memory", raise_on_error=False)
+    assert lite.roofline is None
+
+
+def test_chain_predicted_seconds_on_bound_graph():
+    from keystone_tpu import Dataset
+    from keystone_tpu.nodes.stats import NormalizeRows
+
+    applied = NormalizeRows().to_pipeline().apply(
+        Dataset.from_numpy(np.ones((32, 8), np.float32)))
+    nodes = sorted(applied.graph.operators, key=lambda n: n.id)
+    seconds = chain_predicted_seconds(applied.graph, nodes)
+    assert seconds is not None and seconds > 0
+    # an unpriceable chain answers None, never raises
+    assert chain_predicted_seconds(applied.graph, []) is None
+
+
+# ------------------------------------------------------------------- CLI
+
+
+@pytest.mark.lint
+def test_explain_roofline_cli_json_all_examples():
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "keystone_tpu.analysis",
+         "--explain-roofline", "--json"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["machine"]["balance"] > 0
+    examples = payload["examples"]
+    assert {e["example"] for e in examples} == set(EXAMPLES)
+    candidates = 0
+    for e in examples:
+        assert "build_error" not in e, e
+        assert not [f for f in e["findings"] if f["severity"] == "ERROR"]
+        for s in e["stages"]:
+            assert s["flops"] >= 0 and s["hbm_bytes"] > 0
+            assert s["bound"] in ("compute", "bandwidth")
+            assert s["predicted_seconds"] > 0
+            assert "intensity" in s
+        candidates += len(e["candidates"])
+    assert candidates >= 1, "no KP801 candidate on any example"
+
+
+# --------------------------------------------------------- reconciliation
+
+
+def _traced_mnist_run(tmp_path):
+    """One MnistRandomFFT fit+apply run with the trace armed; returns
+    the parsed trace."""
+    from keystone_tpu.dispatch_bench import EXAMPLES as BENCH_EXAMPLES
+    from keystone_tpu.telemetry import trace_run
+
+    path = tmp_path / "mnist_roofline.json"
+    with trace_run(str(path)):
+        predictor, train, test = BENCH_EXAMPLES["MnistRandomFFT"]()
+        predictor(train).get()
+        predictor(test).get()
+    return json.loads(path.read_text())
+
+
+def test_trace_embeds_roofline_and_reconciles(tmp_path):
+    from keystone_tpu.analysis.reconcile import (
+        cost_model_drift,
+        reconcile_roofline,
+    )
+
+    trace = _traced_mnist_run(tmp_path)
+    roof = trace["keystone"].get("roofline")
+    assert roof and roof["per_node"], "executor did not embed roofline"
+    assert roof["peak_flops"] > 0 and roof["peak_bw"] > 0
+    assert roof["plan_predicted_seconds"] > 0
+    for rec in roof["per_node"].values():
+        assert rec["predicted_seconds"] > 0
+        assert rec["bound"] in ("compute", "bandwidth")
+
+    rr = reconcile_roofline(trace)
+    assert rr["stages_joined"] > 0, rr
+    joined = [r for r in rr["rows"] if r["residual"] is not None]
+    assert joined
+    for r in joined:
+        assert r["predicted_seconds"] is not None
+        assert r["observed_seconds"] > 0
+    assert rr["flops_residual_seconds"] == pytest.approx(
+        rr["predicted_seconds"] - rr["observed_seconds"])
+
+    # the drift report carries the flops residual + an implied cpu bound
+    drift = cost_model_drift(trace)
+    assert drift["roofline"] is not None
+    assert drift["roofline"]["stages_joined"] == rr["stages_joined"]
+    cpu_row = next(r for r in drift["rows"] if r["weight"] == "cpu_weight")
+    assert cpu_row["implied"] is not None and cpu_row["implied"] > 0
+
+
+def test_reconcile_roofline_tolerates_missing_sides():
+    from keystone_tpu.analysis.reconcile import (
+        cost_model_drift,
+        format_drift,
+        reconcile_roofline,
+    )
+
+    # no roofline metadata, no spans: empty join, no crash
+    empty = reconcile_roofline({"traceEvents": []})
+    assert empty["stages_joined"] == 0 and empty["rows"] == []
+    assert empty["flops_residual_seconds"] is None
+    # prediction with no matching span stays visible with residual=None
+    one_sided = reconcile_roofline({
+        "traceEvents": [],
+        "keystone": {"roofline": {"per_node": {
+            "3:Stage": {"label": "Stage", "vertex": 3, "flops": 10.0,
+                        "bound": "compute", "predicted_seconds": 1e-6},
+        }}},
+    })
+    assert one_sided["rows"][0]["residual"] is None
+    # and the drift report renders either way
+    text = format_drift(cost_model_drift({"traceEvents": []}))
+    assert "cost-model drift" in text and "flops residual" not in text
+
+
+def test_ledger_cli_renders_drift_with_roofline(tmp_path):
+    """--ledger over a run whose trace embeds roofline metadata renders
+    the flops-residual line; a run with NO spans still renders."""
+    from keystone_tpu.telemetry import ledger
+    from keystone_tpu.telemetry.__main__ import main as telemetry_main
+
+    ledger.clear_session()
+    trace = _traced_mnist_run(tmp_path)
+    # write the trace back as the --ledger artifact (decision-carrying)
+    art = tmp_path / "run_trace.json"
+    art.write_text(json.dumps(trace))
+    rc = telemetry_main(["--ledger", str(art)])
+    assert rc == 0
+    # spans stripped: the join is empty but rendering must not crash
+    bare = dict(trace)
+    bare["traceEvents"] = []
+    art2 = tmp_path / "run_no_spans.json"
+    art2.write_text(json.dumps(bare))
+    assert telemetry_main(["--ledger", str(art2)]) == 0
+
+
+def test_fusion_decisions_record_predicted_seconds(tmp_path):
+    from keystone_tpu.telemetry import ledger, trace_run
+
+    ledger.clear_session()
+    mark = ledger.session_mark()
+    from keystone_tpu import Dataset
+    from keystone_tpu.nodes.stats import NormalizeRows, SignedHellingerMapper
+
+    with trace_run(str(tmp_path / "t.json")):
+        pipe = (NormalizeRows().to_pipeline() >> SignedHellingerMapper())
+        pipe(Dataset.from_numpy(
+            np.abs(np.random.rand(64, 8)).astype(np.float32))).get()
+    recs = [d for d in ledger.session_since(mark)
+            if d["kind"] in ("fusion", "megafusion")]
+    assert recs
+    assert any("predicted_seconds" in d["predicted"] for d in recs), recs
+    for d in recs:
+        ps = d["predicted"].get("predicted_seconds")
+        if ps is not None:
+            assert ps > 0
+
+
+def test_kp804_constant_is_sane():
+    assert 1e-6 < DISPATCH_OVERHEAD_S < 1e-3
