@@ -70,6 +70,15 @@ from .precision import (
     reprice_memory,
     shrink_to_band,
 )
+from .serving import (
+    ServingCertificate,
+    ServingEnvelope,
+    certify_example,
+    envelope_from_env,
+    ladder_shapes,
+    serving_pass,
+    warmup_manifest,
+)
 from .specs import (
     UNKNOWN,
     DataSpec,
@@ -94,6 +103,7 @@ def validate_graph(
     hbm_budget_bytes: Optional[int] = None,
     chunk_rows: Optional[int] = None,
     partition_rules: Iterable = (),
+    serving=None,
 ) -> ValidationReport:
     """Run the analyzer tiers up to ``level`` over a lowered graph.
 
@@ -101,7 +111,12 @@ def validate_graph(
     spec (anything `as_source_spec` accepts); unlisted sources propagate
     UNKNOWN. ``partition_rules`` (level="full") are declarative
     `sharding.PartitionRule`s / ``(regex, PartitionSpec)`` pairs pinning
-    per-stage placement. Never touches data or devices."""
+    per-stage placement. ``serving`` (level="full") is a
+    `serving.ServingEnvelope` arming the KP9xx serving-readiness
+    certifier — None falls back to the env-declared envelope
+    (``KEYSTONE_SLO_MS``), and with neither the serving tier is
+    skipped; the certificate lands on ``report.serving``. Never touches
+    data or devices."""
     if level not in LEVELS:
         raise ValueError(f"level must be one of {LEVELS}, got {level!r}")
     tier = LEVELS.index(level)
@@ -173,9 +188,21 @@ def validate_graph(
                                              chunk_rows=chunk_rows)
         diags.extend(roof_diags)
 
+    serving_cert = None
+    if tier >= 3:
+        # serving tier (KP9xx): only when an envelope is declared — the
+        # serving-readiness certificate is a contract check against a
+        # stated envelope, not an unconditional lint
+        envelope = serving if serving is not None else envelope_from_env()
+        if envelope is not None:
+            serving_cert, serve_diags = serving_pass(
+                graph, specs, envelope, memory=memory, roofline=roofline,
+                hbm_budget_bytes=hbm_budget_bytes, chunk_rows=chunk_rows)
+            diags.extend(serve_diags)
+
     report = ValidationReport(diags, specs=specs, memory=memory,
                               level=level, shardings=shardings,
-                              roofline=roofline)
+                              roofline=roofline, serving=serving_cert)
     return report.filter(ignore) if ignore else report
 
 
@@ -226,7 +253,14 @@ __all__ = [
     "resolve_chunk_rows",
     "roofline_pass",
     "RooflineEstimate",
+    "ServingCertificate",
+    "ServingEnvelope",
     "StageRoofline",
+    "certify_example",
+    "envelope_from_env",
+    "ladder_shapes",
+    "serving_pass",
+    "warmup_manifest",
     "default_machine",
     "stage_cost",
     "xla_cost_analysis",

@@ -13,6 +13,8 @@ registry.
     python -m keystone_tpu.analysis --explain-precision --json
     python -m keystone_tpu.analysis --explain-roofline  # per-stage flops/bytes
     python -m keystone_tpu.analysis --explain-roofline --json
+    python -m keystone_tpu.analysis --certify-serving   # KP9xx serving gate
+    python -m keystone_tpu.analysis --certify-serving --slo-ms 1500 --json
     python -m keystone_tpu.analysis --list-rules
 
 Exit code 1 if any example produces ERROR-severity findings (or any
@@ -441,6 +443,103 @@ def _explain_roofline_main(args) -> int:
     return 1 if failed else 0
 
 
+def _certify_serving_main(args) -> int:
+    """Per-example serving-readiness certification (KP9xx gate): price
+    every example's apply path against a declared envelope (batch
+    range + SLO + tenancy) and fail on any unsuppressed KP9xx ERROR.
+    Examples that genuinely cannot certify yet carry NAMED suppressions
+    (`serving.SERVING_SUPPRESSIONS` — each names the stage and the
+    fix), so the audit output states exactly what is uncertified and
+    why instead of silently passing. Ingress-declared examples
+    (`serving.SERVING_INGRESS`) are certified from their declared
+    request boundary, which the rendered certificate names."""
+    from .serving import (
+        SERVING_SUPPRESSIONS,
+        ServingEnvelope,
+        certify_example,
+        envelope_from_env,
+        format_certificate,
+    )
+    from ..workflow.env import execution_config
+
+    names = args.examples or sorted(EXAMPLES)
+    unknown = [n for n in names if n not in EXAMPLES]
+    if unknown:
+        print(f"unknown example(s): {', '.join(unknown)}; "
+              f"known: {', '.join(sorted(EXAMPLES))}", file=sys.stderr)
+        return 2
+    # require_slo=False: this surface certifies unconditionally, so the
+    # batch/tenant env refinements are honored without KEYSTONE_SLO_MS
+    # (the flags' documented defaults)
+    base = envelope_from_env(require_slo=False)
+    envelope = ServingEnvelope(
+        max_batch=args.max_batch or base.max_batch,
+        slo_seconds=(args.slo_ms / 1e3) if args.slo_ms else base.slo_seconds,
+        tenants=args.tenants or base.tenants)
+    budget = (int(args.hbm_budget_gb * (1 << 30))
+              if args.hbm_budget_gb else execution_config().hbm_budget_bytes)
+
+    failed = False
+    records = []
+    for name in names:
+        try:
+            cert, diags = certify_example(
+                name, envelope, hbm_budget_bytes=budget, record=True)
+        except Exception as e:  # a factory bug is a failure, not a crash
+            if args.json:
+                records.append({"example": name, "build_error":
+                                f"{type(e).__name__}: {e}"})
+            else:
+                print(f"✗ {name}: failed to build/certify: "
+                      f"{type(e).__name__}: {e}")
+            failed = True
+            continue
+        suppressions = dict(SERVING_SUPPRESSIONS.get(name, {}))
+        ignored = set(args.ignore)
+        gate = [d for d in diags if d.severity >= Severity.ERROR
+                and d.rule not in suppressions and d.rule not in ignored]
+        suppressed = sorted({d.rule for d in diags
+                             if d.severity >= Severity.ERROR
+                             and d.rule in suppressions})
+        failed |= bool(gate)
+        if args.json:
+            records.append({
+                "example": name,
+                "certified": cert.certified,
+                "unsuppressed_errors": len(gate),
+                "suppressions": {r: suppressions[r] for r in suppressed},
+                "certificate": cert.as_record(),
+                "findings": [
+                    {"rule": d.rule, "severity": d.severity.name,
+                     "anchor": d.anchor, "message": d.message}
+                    for d in diags
+                ],
+            })
+        else:
+            mark = "✗" if gate else "✓"
+            verdict = ("certified" if cert.certified else
+                       ("uncertified (suppressed: " + ", ".join(suppressed)
+                        + ")" if suppressed and not gate else "UNCERTIFIED"))
+            print(f"{mark} {name}: {verdict}")
+            print("  " + format_certificate(cert).replace("\n", "\n  "))
+            for rule in suppressed:
+                print(f"    suppressed {rule}: {suppressions[rule]}")
+            for d in diags:
+                if d.severity >= Severity.WARNING or args.strict:
+                    print(f"    {d}")
+    if args.json:
+        print(json.dumps({
+            "envelope": {
+                "min_batch": envelope.min_batch,
+                "max_batch": envelope.max_batch,
+                "slo_seconds": envelope.slo_seconds,
+                "tenants": envelope.tenants,
+            },
+            "examples": records,
+        }, indent=2, default=str))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m keystone_tpu.analysis", description=__doc__,
@@ -474,6 +573,22 @@ def main(argv=None) -> int:
                         "intensity / bound / predicted-seconds table "
                         "plus the KP801 Pallas-candidate chains; fail "
                         "only on ERROR-severity KP8xx findings")
+    p.add_argument("--certify-serving", action="store_true",
+                   help="run the KP9xx serving-readiness certifier per "
+                        "example (per-shape latency bounds vs the SLO, "
+                        "warmup-manifest coverage, host/donation/tenancy "
+                        "checks); fail on any unsuppressed KP9xx ERROR")
+    p.add_argument("--slo-ms", type=float, default=None,
+                   help="serving SLO in milliseconds for "
+                        "--certify-serving (default: KEYSTONE_SLO_MS or "
+                        "1000)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="largest coalesced request batch the envelope "
+                        "certifies (default: KEYSTONE_SERVING_MAX_BATCH "
+                        "or 64)")
+    p.add_argument("--tenants", type=int, default=None,
+                   help="concurrent warmed pipelines sharing the device "
+                        "(KP905; default 1)")
     p.add_argument("--plan", action="store_true",
                    help="with --explain-sharding: run the sharding "
                         "planner per example and render chosen-vs-default "
@@ -504,6 +619,9 @@ def main(argv=None) -> int:
 
     if args.explain_roofline:
         return _explain_roofline_main(args)
+
+    if args.certify_serving:
+        return _certify_serving_main(args)
 
     names = args.examples or sorted(EXAMPLES)
     unknown = [n for n in names if n not in EXAMPLES]

@@ -98,6 +98,33 @@ RULES = {
              "per-trip compute is below the dispatch/loop overhead "
              "floor; the scan cannot amortize its trips — raise "
              "chunk_size",
+    # serving tier (static serving-readiness certifier; see analysis/serving)
+    "KP901": "serving-host-stage: an apply-path stage whose body cannot "
+             "be abstractly traced (host code, or no propagated element "
+             "spec) — it can neither be AOT-warmed nor enter the "
+             "megafused scan, so the one-warm-program serving claim "
+             "fails at this stage",
+    "KP902": "serving-recompile-exposure: an apply-path device stage "
+             "outside every warmable fused program compiles cold at "
+             "each pad-ladder shape the envelope can produce (INFO "
+             "when the warmup manifest covers every shape)",
+    "KP903": "serving-latency-bound: the certified per-shape latency "
+             "upper bound (headroom x roofline seconds + per-program "
+             "dispatch floors) vs the declared SLO; ERROR when the "
+             "worst in-envelope shape busts it, with the dominating "
+             "stage named",
+    "KP904": "serving-donated-request: an apply-path operator donates "
+             "the pipeline's own input buffer — a serving caller "
+             "retains the request it passed, so every repeated apply "
+             "would read (or force a copy of) a deleted buffer",
+    "KP905": "serving-multi-tenant-residency: per-device peak bytes x "
+             "declared concurrent warmed pipelines exceeds the HBM "
+             "budget — the tenant count the envelope declares cannot "
+             "co-reside",
+    "KP906": "serving-telemetry-cardinality: an apply-path operator "
+             "formats a telemetry metric name dynamically in a hot "
+             "method — per-request names grow the process-wide registry "
+             "without bound (the graph-level twin of jaxlint KJ012)",
     # contract tier (registry-wide operator audit; see analysis/contracts)
     "KP501": "fusable-without-structural-fuse: a fusable stage's fused "
              "program key is id-keyed (opaque), so fused programs "
@@ -149,6 +176,7 @@ class ValidationReport:
         level: str = "structure",
         shardings: Optional[dict] = None,
         roofline: Optional[Any] = None,
+        serving: Optional[Any] = None,
     ):
         self.diagnostics: List[Diagnostic] = list(diagnostics)
         self.specs = specs or {}
@@ -161,6 +189,11 @@ class ValidationReport:
         #: per-stage flops/bytes/intensity/predicted-seconds);
         #: populated at level="full", None otherwise
         self.roofline = roofline
+        #: the serving certificate (analysis/serving.ServingCertificate —
+        #: per-shape latency bounds, warmup manifest, verdict); populated
+        #: at level="full" when a `ServingEnvelope` is declared (the
+        #: ``serving=`` kwarg or ``KEYSTONE_SLO_MS``), None otherwise
+        self.serving = serving
 
     # ------------------------------------------------------------- views
 
@@ -187,6 +220,7 @@ class ValidationReport:
             [d for d in self.diagnostics if d.rule not in ignore],
             specs=self.specs, memory=self.memory, level=self.level,
             shardings=self.shardings, roofline=self.roofline,
+            serving=self.serving,
         )
 
     def raise_for_errors(self) -> "ValidationReport":

@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..workflow.graph import Graph, GraphId, NodeId, SinkId, SourceId
 from .diagnostics import Diagnostic, Severity
-from .specs import UNKNOWN, DataSpec, SpecMismatchError, TransformerSpec
+from .specs import UNKNOWN, DataSpec, SpecMismatchError, TransformerSpec, is_known
 
 
 def _label(graph: Graph, vid: GraphId) -> str:
@@ -169,6 +169,7 @@ def structural_pass(graph: Graph) -> List[Diagnostic]:
 def spec_pass(
     graph: Graph,
     source_specs: Optional[Dict[SourceId, Any]] = None,
+    seeds: Optional[Dict[Any, Any]] = None,
 ) -> Tuple[Dict[GraphId, Any], List[Diagnostic]]:
     """Propagate abstract specs vertex-by-vertex in topological order.
 
@@ -176,8 +177,15 @@ def spec_pass(
     (see `specs.trace_element`), and hooks that cannot tell return
     UNKNOWN. A `SpecMismatchError` raised by a hook becomes an ERROR
     diagnostic anchored at the node, and UNKNOWN flows downstream so one
-    mismatch does not cascade into a wall of secondary errors."""
+    mismatch does not cascade into a wall of secondary errors.
+
+    ``seeds`` maps interior vertices to *declared* boundary `DataSpec`s
+    (the serving certifier's ingress declarations): a seed fills in a
+    vertex whose propagated element is unknown — it NEVER overrides a
+    spec propagation proved, so a declared boundary can only extend
+    coverage, not contradict it."""
     source_specs = source_specs or {}
+    seeds = seeds or {}
     order, cycle_diags = toposort(graph)
     diags: List[Diagnostic] = list(cycle_diags)
     specs: Dict[GraphId, Any] = {}
@@ -203,5 +211,7 @@ def spec_pass(
                     f"abstract_eval hook raised {type(e).__name__}: {e}",
                     vertex=vid, label=_label(graph, vid)))
                 out = UNKNOWN
+            if vid in seeds and not is_known(getattr(out, "element", None)):
+                out = seeds[vid]
             specs[vid] = out
     return specs, diags

@@ -417,6 +417,110 @@ def reconcile_roofline(trace: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def reconcile_serving(trace: Dict[str, Any],
+                      observed: Optional[Any] = None) -> Dict[str, Any]:
+    """Join the trace's embedded serving certificate
+    (``keystone.serving`` — the per-ladder-shape certified latency
+    bounds the KP9xx certifier issued, which the executor records when
+    an envelope is armed) against observed per-shape serving
+    percentiles from `scripts/serving_latency.py`.
+
+    ``observed`` is the artifact's per-shape record list
+    (``[{"batch", "chunk_shape", "p50_ms", ...}]``); when omitted it is
+    read from ``keystone.serving_observed`` — the script embeds its
+    measurements into the same trace it wrote, so one artifact carries
+    both sides of the join. Each observed shape joins the certificate
+    row whose ladder shape covers it (``chunk_shape`` when recorded,
+    else the batch itself), and the certificate's claim is directional:
+    the certified bound is an UPPER bound, so ``holds`` means
+    ``predicted_bound ≥ observed p50``. The residual (bound − p50,
+    always ≥ 0 while the claim holds) is the `BOUND_HEADROOM`
+    recalibration feed: a persistently large residual means the
+    headroom can shrink. Degrades to empty rows on partial artifacts —
+    the drift report must render regardless."""
+    ks = trace.get("keystone", {})
+    cert = ks.get("serving") or {}
+    if observed is None:
+        observed = ks.get("serving_observed") or []
+    by_shape: Dict[int, Dict[str, Any]] = {
+        int(s["batch"]): s for s in cert.get("shapes", [])
+        if s.get("batch") is not None
+    }
+    rows: List[Dict[str, Any]] = []
+    joined = 0
+    violations = 0
+    residual_total = 0.0
+    for o in observed:
+        batch = o.get("batch")
+        if batch is None:
+            continue
+        shape = int(o.get("chunk_shape") or batch)
+        p50 = o.get("p50_ms")
+        p50_s = float(p50) / 1e3 if p50 is not None else None
+        c = by_shape.get(shape)
+        bound = float(c["predicted_seconds"]) if c else None
+        residual = holds = None
+        if bound is not None and p50_s is not None:
+            residual = bound - p50_s
+            holds = bound >= p50_s
+            joined += 1
+            violations += 0 if holds else 1
+            residual_total += residual
+        rows.append({
+            "batch": int(batch),
+            "chunk_shape": shape,
+            "predicted_bound_seconds": bound,
+            "machine_seconds": (float(c["machine_seconds"])
+                                if c and "machine_seconds" in c else None),
+            "observed_p50_seconds": p50_s,
+            "observed_p99_seconds": (float(o["p99_ms"]) / 1e3
+                                     if o.get("p99_ms") is not None
+                                     else None),
+            "residual_seconds": residual,
+            "holds": holds,
+        })
+    rows.sort(key=lambda r: (r["holds"] is None, r["batch"]))
+    return {
+        "rows": rows,
+        "shapes_joined": joined,
+        "violations": violations,
+        "bound_holds": (violations == 0) if joined else None,
+        "residual_seconds": residual_total if joined else None,
+        "slo_seconds": cert.get("slo_seconds"),
+        "certified": cert.get("certified"),
+        "dominating_stage": cert.get("dominating_stage"),
+    }
+
+
+def format_serving_reconciliation(rec: Dict[str, Any]) -> str:
+    """Text table of one serving join (the --serving rendering)."""
+    lines = ["== serving reconciliation (certified bound vs observed "
+             "percentiles) =="]
+    if not rec["rows"]:
+        lines.append("(no joined shapes — trace carries no "
+                     "keystone.serving certificate or no observed "
+                     "percentiles)")
+        return "\n".join(lines)
+    lines.append(f"{'batch':>6} {'shape':>6} {'bound':>12} {'p50':>10} "
+                 f"{'residual':>10} verdict")
+    for r in rec["rows"]:
+        bound = (f"{r['predicted_bound_seconds'] * 1e3:9.2f} ms"
+                 if r["predicted_bound_seconds"] is not None else "—")
+        p50 = (f"{r['observed_p50_seconds'] * 1e3:7.2f} ms"
+               if r["observed_p50_seconds"] is not None else "—")
+        res = (f"{r['residual_seconds'] * 1e3:+7.2f} ms"
+               if r["residual_seconds"] is not None else "—")
+        verdict = ("holds" if r["holds"]
+                   else "VIOLATED" if r["holds"] is not None else "unjoined")
+        lines.append(f"{r['batch']:>6} {r['chunk_shape']:>6} {bound:>12} "
+                     f"{p50:>10} {res:>10} {verdict}")
+    verdict = ("bound holds over every joined shape" if rec["bound_holds"]
+               else f"{rec['violations']} shape(s) VIOLATE the bound"
+               if rec["bound_holds"] is not None else "nothing joined")
+    lines.append(f"({rec['shapes_joined']} shape(s) joined — {verdict})")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------- cost-model drift
 
 

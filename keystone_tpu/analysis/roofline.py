@@ -412,7 +412,20 @@ def _elem_count(spec: Any, nominal: int) -> int:
 def _modeled_dense_flops(in_elem, out_elem) -> Optional[float]:
     """Per-item FLOPs of a fitted apply modeled as a dense map in→out
     (2·in·out — the y = xW family every `fusable_fit` estimator
-    produces)."""
+    produces). Refinement: when both sides are single-leaf 2-D arrays
+    sharing a leading dim, the map is row-wise (each row independently
+    projected — the PCA/whitening family) and prices 2·rows·d_in·d_out;
+    the full in×out product would charge the rows against each other,
+    a quadratic overprice the serving latency bound cannot afford."""
+    in_leaves = jax.tree_util.tree_leaves(in_elem)
+    out_leaves = jax.tree_util.tree_leaves(out_elem)
+    if len(in_leaves) == 1 and len(out_leaves) == 1:
+        a, b = in_leaves[0], out_leaves[0]
+        if getattr(a, "ndim", 0) == 2 and getattr(b, "ndim", 0) == 2 \
+                and a.shape[0] == b.shape[0]:
+            return 2.0 * float(a.shape[0]) * float(a.shape[1]) \
+                * float(b.shape[1])
+
     def elems(e) -> Optional[int]:
         total = 0
         for leaf in jax.tree_util.tree_leaves(e):
@@ -504,11 +517,26 @@ def _stage_trail(graph: Graph, vid: NodeId, op, specs: Dict[GraphId, Any]):
                 or not is_known(data_spec.element) \
                 or not is_known(out_spec.element):
             return None
-        flops = _modeled_dense_flops(data_spec.element, out_spec.element)
+        # the estimator may declare its encoder's honest flop order
+        # (`abstract_apply_flops` — the FV family prices ~40× under
+        # the generic dense map); the dense model is the fallback
+        flops = None
+        est_dep = deps[0]
+        if isinstance(est_dep, NodeId):
+            hook = getattr(graph.get_operator(est_dep),
+                           "abstract_apply_flops", None)
+            if hook is not None:
+                try:
+                    flops = hook(data_spec.element, out_spec.element)
+                except Exception:
+                    flops = None
+        if flops is None:
+            flops = _modeled_dense_flops(data_spec.element,
+                                         out_spec.element)
         if flops is None:
             return None
         return [(_label(graph, vid), data_spec.element, out_spec.element,
-                 flops, 0.0, "modeled")]
+                 float(flops), 0.0, "modeled")]
 
     fn = getattr(op, "single_transform", None)
     if fn is None:

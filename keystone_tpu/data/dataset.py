@@ -161,11 +161,16 @@ class Dataset:
         layout — what AOT warmup lowers against."""
         m = self.__dict__.get("_mask_cache")
         if m is None:
-            m = jnp.arange(self.padded_count) < self.count
+            # built on host: an eager jnp.arange/lt pair compiles two
+            # one-op XLA programs per DISTINCT padded count — cold
+            # compiles the serving certifier's 0-cold-compile warm
+            # ladder claim (KP902) cannot afford; device_put is a
+            # transfer, not a compile
+            m = np.arange(self.padded_count) < self.count
             sh = NamedSharding(self.mesh, P(meshlib.DATA_AXIS))
             if sh.is_fully_addressable:
-                # multi-host meshes keep the uncommitted mask (a host
-                # array can't device_put to a cross-process sharding);
+                # multi-host meshes keep the host mask (a host array
+                # can't device_put to a cross-process sharding);
                 # AOT-warmed programs just fall back to the jit path
                 m = jax.device_put(m, sh)
             self.__dict__["_mask_cache"] = m

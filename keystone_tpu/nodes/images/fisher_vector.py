@@ -72,6 +72,41 @@ class FisherVector(Transformer):
         )
 
 
+def _fv_fit_spec(k: int, label: str):
+    """TransformerSpec of a to-be-fitted FV encoder: descriptor matrix
+    (nd, d) → (d, 2k) float32 — the output geometry depends only on the
+    configured component count, so it is decidable before the GMM fit
+    runs (what lets the serving certifier price the FV apply path)."""
+    from ...analysis.specs import (
+        SpecMismatchError,
+        TransformerSpec,
+        shape_struct,
+    )
+
+    def elem_fn(elem):
+        if getattr(elem, "ndim", 0) != 2:
+            raise SpecMismatchError(
+                f"{label} input element must be a 2-D descriptor matrix")
+        return shape_struct((int(elem.shape[-1]), 2 * k), np.float32)
+
+    return TransformerSpec(elem_fn, label=label)
+
+
+def _fv_apply_flops(k: int, in_elem) -> "float | None":
+    """≈8·nd·d·k per item: the posterior GEMM (2·nd·d·k), the S1/S2
+    aggregation GEMMs (4·nd·d·k), and the elementwise posterior and
+    gradient work. Declared so the roofline's fitted-apply model prices
+    the FV encoder at its honest order — the generic dense in×out map
+    charges descriptor rows against output rows, ~nd/8 times over."""
+    import jax as _jax
+
+    leaves = _jax.tree_util.tree_leaves(in_elem)
+    if len(leaves) != 1 or getattr(leaves[0], "ndim", 0) != 2:
+        return None
+    nd, d = leaves[0].shape
+    return 8.0 * float(nd) * float(d) * float(k)
+
+
 class ScalaGMMFisherVectorEstimator(Estimator):
     """Fit a GMM on descriptor samples, return the FV encoder
     (FisherVector.scala:69-84)."""
@@ -80,6 +115,12 @@ class ScalaGMMFisherVectorEstimator(Estimator):
         self.k = k
         self.num_iters = num_iters
         self.seed = seed
+
+    def abstract_fit(self, in_specs):
+        return _fv_fit_spec(self.k, self.label)
+
+    def abstract_apply_flops(self, in_elem, out_elem):
+        return _fv_apply_flops(self.k, in_elem)
 
     def fit(self, data) -> FisherVector:
         gmm = GaussianMixtureModelEstimator(
@@ -101,6 +142,12 @@ class GMMFisherVectorEstimator(OptimizableEstimator):
         self.k = k
         self.num_iters = num_iters
         self.seed = seed
+
+    def abstract_fit(self, in_specs):
+        return _fv_fit_spec(self.k, self.label)
+
+    def abstract_apply_flops(self, in_elem, out_elem):
+        return _fv_apply_flops(self.k, in_elem)
 
     @property
     def default(self) -> Estimator:

@@ -295,6 +295,13 @@ class ColumnPCAEstimator(OptimizableEstimator):
         self.num_chips = num_chips
         self.chosen = None
 
+    def abstract_fit(self, in_specs):
+        # both cost-model outcomes (local/distributed) fit the same
+        # last-axis d -> dims projection, so the spec is decidable
+        # before the choice is
+        return _pca_fit_spec(self.dims, self.label,
+                             in_specs[0] if in_specs else None)
+
     @property
     def default(self) -> Estimator:
         return PCAEstimator(self.dims)

@@ -93,8 +93,13 @@ def analyzable(config: Optional[ImageNetSiftLcsFVConfig] = None):
     lcs_branch = _fv_branch(img >> LCSExtractor(stride=6), train, config)
 
     class _Concat(Transformer):
+        # jnp, not np: a host concatenate on the apply path would pull
+        # both branch outputs off-device mid-pipeline (and the serving
+        # certifier's KP901 would rightly refuse to warm it)
         def apply(self, xs):
-            return np.concatenate([np.asarray(x).ravel() for x in xs])
+            import jax.numpy as jnp
+
+            return jnp.concatenate([jnp.ravel(jnp.asarray(x)) for x in xs])
 
     featurizer = Pipeline.gather([sift_branch, lcs_branch]) >> _Concat() >> _Stack()
     raw_labels = SpecDataset((), np.int32, count=n, name="imagenet-labels")
@@ -127,7 +132,9 @@ def run(config: ImageNetSiftLcsFVConfig):
 
     class _Concat(Transformer):
         def apply(self, xs):
-            return np.concatenate([np.asarray(x).ravel() for x in xs])
+            import jax.numpy as jnp
+
+            return jnp.concatenate([jnp.ravel(jnp.asarray(x)) for x in xs])
 
         def apply_batch(self, data):
             return HostDataset(

@@ -353,6 +353,28 @@ def summarize(trace: Dict[str, Any], top: int = 15) -> str:
     except Exception:
         pass  # advisory: partial traces summarize without it
 
+    try:
+        from ..analysis.reconcile import (
+            format_serving_reconciliation,
+            reconcile_serving,
+        )
+
+        serving = reconcile_serving(trace)
+        if serving["rows"]:
+            lines.append("")
+            lines.append(format_serving_reconciliation(serving))
+        elif trace.get("keystone", {}).get("serving"):
+            cert = trace["keystone"]["serving"]
+            verdict = "certified" if cert.get("certified") else "UNCERTIFIED"
+            lines.append(
+                f"\nserving certificate: {verdict}, "
+                f"{len(cert.get('shapes', []))} ladder shape(s), SLO "
+                f"{(cert.get('slo_seconds') or 0) * 1e3:.0f}ms (no "
+                "observed percentiles — run scripts/serving_latency.py "
+                "to join)")
+    except Exception:
+        pass  # advisory: partial traces summarize without it
+
     caps = ks.get("capabilities") or {}
     absent = {k: v for k, v in caps.items() if not v.get("available", True)}
     if absent:

@@ -58,11 +58,26 @@ _warm_threads: List[threading.Thread] = []
 _warm_threads_lock = threading.Lock()
 
 
+_exit_drain_registered = False
+
+
 def _spawn_warm_thread(target, name: str) -> None:
+    global _exit_drain_registered
     t = threading.Thread(target=target, name=name, daemon=True)
     with _warm_threads_lock:
         _warm_threads[:] = [x for x in _warm_threads if x.is_alive()]
         _warm_threads.append(t)
+        if not _exit_drain_registered:
+            # a daemon thread still inside an XLA compile while CPython
+            # finalizes segfaults the interpreter (seen with the
+            # serving envelope armed, where a short-lived process can
+            # exit right after an apply spawned its ladder warmup);
+            # quiesce in-flight warmups at exit, briefly — a wedged
+            # compile still cannot block exit past the timeout
+            import atexit
+
+            atexit.register(drain_warmups, timeout=10.0)
+            _exit_drain_registered = True
     t.start()
 
 
@@ -86,26 +101,58 @@ def drain_warmups(timeout: float = 60.0) -> None:
             return
 
 
-def _submit_warmup(op, element, count) -> None:
-    """Run one fused-program AOT warmup on a daemon thread. Plans carry
-    at most a handful of fused programs, so a thread per compile is the
-    bound; daemon so a wedged compile can never block process exit.
-    Failures are logged at debug and otherwise dropped — the force path
-    compiles inline exactly as it would have without warmup (it also
-    clears the pending-future entry, so nothing waits on a dead warmup;
-    see `nodes.util.fusion._WARMUP_PENDING`)."""
+def _submit_warmup(op, element, counts) -> None:
+    """Run one fused-program AOT warmup on a daemon thread. ``counts``
+    is one example count or a sequence of them (the serving ladder): the
+    shapes compile sequentially on one thread, so a plan warms a whole
+    envelope without a thread per shape. Plans carry at most a handful
+    of fused programs, so a thread per program site is the bound; daemon
+    so a wedged compile can never block process exit. Failures are
+    logged at debug and otherwise dropped — the force path compiles
+    inline exactly as it would have without warmup (it also clears the
+    pending-future entry, so nothing waits on a dead warmup; see
+    `nodes.util.fusion._WARMUP_PENDING`)."""
+    if isinstance(counts, int):
+        counts = (counts,)
+    counts = tuple(dict.fromkeys(int(c) for c in counts if c))
 
     def run():
-        try:
-            op.warmup(element, count)
-        except Exception as e:
-            import logging
+        for count in counts:
+            try:
+                op.warmup(element, count)
+            except Exception as e:
+                import logging
 
-            logging.getLogger(__name__).debug(
-                "AOT warmup of %s failed: %s: %s",
-                getattr(op, "label", op), type(e).__name__, e)
+                logging.getLogger(__name__).debug(
+                    "AOT warmup of %s at count %d failed: %s: %s",
+                    getattr(op, "label", op), count, type(e).__name__, e)
 
     _spawn_warm_thread(run, "keystone-aot-warmup")
+
+
+def _serving_warm_counts() -> List[int]:
+    """The extra AOT warm counts a declared serving envelope demands:
+    every pad-ladder shape `analysis.serving.ladder_shapes` enumerates —
+    the SAME (element × count) expansion `serving.warmup_manifest`
+    exports, so the KP902 coverage claim ("with KEYSTONE_SLO_MS armed,
+    warm serving at any in-envelope shape performs 0 cold compiles") is
+    enforced here, not just stated. Deliberately widens EVERY warm
+    target — fit-graph sites included, which serving never dispatches
+    at ladder shapes: the fit/apply chains share structural program
+    keys more often than not, the compiles run on background daemon
+    threads overlapped with fit compute, and a path-scoped filter here
+    would duplicate the certificate's apply-path walk in the executor.
+    Empty when no envelope is armed; a serving.py bug must never break
+    warmup."""
+    try:
+        from ..analysis.serving import envelope_from_env, ladder_shapes
+
+        envelope = envelope_from_env()
+        if envelope is None:
+            return []
+        return ladder_shapes(envelope)
+    except Exception:
+        return []
 
 
 def _spec_dtype_name(spec) -> Optional[str]:
@@ -298,6 +345,7 @@ class GraphExecutor:
             # bytes / predicted seconds, so analysis.reconcile can join
             # the time model against this run's observed span timings
             # (the flops-residual column of the drift report)
+            roof = None
             try:
                 from ..analysis.roofline import roofline_pass
 
@@ -329,6 +377,24 @@ class GraphExecutor:
                     float(roof.plan_seconds))
             except Exception:
                 pass  # the byte estimates above must still land
+            # serving side (KP903's trace half): with an envelope armed
+            # (KEYSTONE_SLO_MS), embed the per-shape certified latency
+            # bounds so `reconcile.reconcile_serving` can join observed
+            # serving percentiles against them. Later executors
+            # overwrite earlier ones: in a fit-then-serve trace the
+            # apply-path executor runs last, and its certificate is the
+            # one a serving run's percentiles must sit under.
+            try:
+                from ..analysis.serving import envelope_from_env, serving_pass
+
+                envelope = envelope_from_env()
+                if envelope is not None:
+                    cert, _ = serving_pass(
+                        graph, specs, envelope, memory=est,
+                        roofline=roof, record=False)
+                    tracer.metadata["serving"] = cert.as_record()
+            except Exception:
+                pass
         except Exception:  # estimation must never break execution
             pass
 
@@ -406,6 +472,11 @@ class GraphExecutor:
                 if not targets and not parked:
                     return
                 specs, _ = spec_pass(graph, {})
+                # serving-manifest expansion: an armed envelope
+                # (KEYSTONE_SLO_MS) widens every program site's warm
+                # count to the whole pad ladder, so ANY in-envelope
+                # request shape dispatches into a warm executable
+                serving_counts = _serving_warm_counts()
 
                 def data_spec(data_dep):
                     s = specs.get(data_dep)
@@ -418,7 +489,8 @@ class GraphExecutor:
                 for op, data_dep in targets:
                     s = data_spec(data_dep)
                     if s is not None:
-                        _submit_warmup(op, s.element, s.count)
+                        _submit_warmup(op, s.element,
+                                       [s.count, *serving_counts])
                 for op, est_deps, data_dep in parked:
                     s = data_spec(data_dep)
                     if s is None:
@@ -452,6 +524,7 @@ class GraphExecutor:
         with self._warm_lock:
             pending, self._warm_pending = self._warm_pending, []
         still: List[dict] = []
+        serving_counts = _serving_warm_counts()
         for ent in pending:
             exprs = [self._memo.get(d) for d in ent["est_deps"]]
             if all(isinstance(e, TransformerExpression) and e.is_forced
@@ -459,7 +532,8 @@ class GraphExecutor:
                 try:
                     mat = ent["op"].materialize([e.get for e in exprs])
                     if isinstance(mat, FusedBatchTransformer):
-                        _submit_warmup(mat, ent["element"], ent["count"])
+                        _submit_warmup(mat, ent["element"],
+                                       [ent["count"], *serving_counts])
                 except Exception:
                     pass
             else:
@@ -467,6 +541,69 @@ class GraphExecutor:
         if still:
             with self._warm_lock:
                 self._warm_pending.extend(still)
+
+    def warm_manifest(self, manifest) -> int:
+        """Feed an explicit `analysis.serving.warmup_manifest()`
+        enumeration to the AOT warmer: each entry names a fused program
+        site (vertex id + label), the element spec its programs trace
+        from, and every pad-ladder count the envelope can produce — the
+        serving runtime's pre-traffic warm step. Entries are resolved
+        against this executor's optimized plan by vertex id, falling
+        back to operator label (the manifest may have been computed on
+        the raw graph whose fused projection renumbered vertices).
+        Returns the number of program sites submitted; never raises."""
+        graph, _ = self._optimized_plan()
+        from ..nodes.util.fusion import FusedBatchTransformer
+        from .expressions import TransformerExpression
+        from .fusion_rule import FusedChainOperator
+        from .operators import ExpressionOperator
+
+        def resolve(entry):
+            by_label = None
+            for vid in graph.operators:
+                op = graph.get_operator(vid)
+                if not isinstance(op, (FusedBatchTransformer,
+                                       FusedChainOperator)):
+                    continue
+                if vid.id == entry.get("vertex"):
+                    return vid, op
+                if by_label is None and op.label == entry.get("label"):
+                    by_label = (vid, op)
+            return by_label
+
+        submitted = 0
+        for entry in manifest or ():
+            try:
+                hit = resolve(entry)
+                if hit is None:
+                    continue
+                vid, op = hit
+                if isinstance(op, FusedChainOperator):
+                    fitted = []
+                    for dep in graph.get_dependencies(vid)[:-1]:
+                        # a fitted plan carries its fits as forced
+                        # ExpressionOperators; a live executor may hold
+                        # them in the memo instead
+                        eop = (graph.get_operator(dep)
+                               if isinstance(dep, NodeId) else None)
+                        expr = (eop.expression
+                                if isinstance(eop, ExpressionOperator)
+                                else self._memo.get(dep))
+                        if not (isinstance(expr, TransformerExpression)
+                                and expr.is_forced):
+                            fitted = None
+                            break
+                        fitted.append(expr.get)
+                    if fitted is None:
+                        continue
+                    op = op.materialize(fitted)
+                    if not isinstance(op, FusedBatchTransformer):
+                        continue
+                _submit_warmup(op, entry["element"], entry["counts"])
+                submitted += 1
+            except Exception:
+                continue
+        return submitted
 
     def execute(self, graph_id: GraphId) -> Expression:
         """Execute up to ``graph_id``, returning its lazy Expression

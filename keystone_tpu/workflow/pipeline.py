@@ -102,7 +102,7 @@ def _add_data_vertex(g: Graph, data: Any) -> Tuple[Graph, NodeOrSourceId]:
 
 def _validate(graph, source_specs, *, level: str = "full", ignore=(),
               hbm_budget_bytes=None, chunk_rows=None, partition_rules=(),
-              raise_on_error=True):
+              serving=None, raise_on_error=True):
     """Shared implementation of `Pipeline.validate` and friends."""
     from ..analysis import validate_graph
 
@@ -115,6 +115,7 @@ def _validate(graph, source_specs, *, level: str = "full", ignore=(),
         # None → ExecutionConfig.chunk_size, resolved inside memory_pass
         chunk_rows=chunk_rows,
         partition_rules=partition_rules,
+        serving=serving,
     )
     if raise_on_error:
         report.raise_for_errors()
@@ -181,7 +182,7 @@ class Pipeline(Chainable):
 
     def validate(self, source_spec=None, *, level: str = "full", ignore=(),
                  hbm_budget_bytes=None, chunk_rows=None, partition_rules=(),
-                 raise_on_error: bool = True):
+                 serving=None, raise_on_error: bool = True):
         """Statically validate this pipeline before any data loads.
 
         Walks the lowered graph propagating abstract specs
@@ -202,6 +203,15 @@ class Pipeline(Chainable):
         ``partition_rules``: declarative ``(regex, PartitionSpec)``
         placement overrides for the sharding tier (see
         `analysis.sharding.PartitionRule`).
+        ``serving``: a `analysis.ServingEnvelope` arming the KP9xx
+        serving-readiness certifier (batch range + SLO + tenancy); the
+        certificate lands on ``report.serving``. None falls back to the
+        env-declared envelope (``KEYSTONE_SLO_MS``); with neither the
+        serving tier is skipped. An armed envelope makes KP9xx errors
+        raise like any other tier's — a fit-only script validating a
+        known-host pipeline under an inherited ``KEYSTONE_SLO_MS``
+        acknowledges the boundary with ``ignore=("KP901",)`` (the
+        example registry's named suppressions are a CLI-layer concept).
         Raises `analysis.PipelineValidationError` on ERROR-severity
         findings unless ``raise_on_error=False``; always returns the
         `ValidationReport`."""
@@ -212,7 +222,7 @@ class Pipeline(Chainable):
             {self.source: as_source_spec(source_spec)},
             level=level, ignore=ignore, hbm_budget_bytes=hbm_budget_bytes,
             chunk_rows=chunk_rows, partition_rules=partition_rules,
-            raise_on_error=raise_on_error)
+            serving=serving, raise_on_error=raise_on_error)
 
     # -------------------------------------------------------------- apply
 

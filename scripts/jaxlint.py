@@ -134,6 +134,21 @@ system cannot (see ANALYSIS.md for the full catalog):
          layout contracts (kernel-required NHWC flips) carry a
          suppression with the rationale.
 
+  KJ014  blocking-host-io (under ``workflow/`` and ``nodes/``):
+         ``time.sleep(...)``, blocking file reads (``open(...)`` /
+         ``Path.read_text/read_bytes``), or network calls
+         (``urllib.request.urlopen``, ``requests.get/post/...``,
+         ``socket.create_connection``) inside an operator hot-path
+         method (``apply``/``apply_batch``/``_chunk_loop``/...). The
+         KJ005 companion for non-device blocking: a host stall on the
+         apply path gates EVERY request behind the full I/O latency,
+         is invisible to the roofline's time model, and busts the
+         KP903 serving latency bound without any static trace of why.
+         Hoist the I/O to construction or fit time (weights, vocab
+         files), or pre-load at the serving ingress; a genuinely
+         per-request external lookup carries a suppression naming why
+         it cannot be batched ahead of the request.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -197,6 +212,12 @@ RULES = {
              "must materialize before the reshape, a full write+read "
              "the roofline's boundary-bytes model cannot see — reorder "
              "the computation or keep the axis order end-to-end",
+    "KJ014": "blocking host I/O in an operator hot path: time.sleep, "
+             "file reads (open/Path.read_*), or network calls "
+             "(urllib/requests/socket) inside apply/apply_batch/"
+             "_chunk_loop stall every request for the full host-call "
+             "latency — the non-device twin of KJ005 (hoist the I/O to "
+             "construction/fit time, or pre-load at ingress)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -1090,6 +1111,67 @@ def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "its state buffers reallocate every iteration")
 
 
+#: call receivers whose attribute calls block on the network.
+_NETWORK_RECEIVERS = {"urllib", "requests", "socket", "http", "httplib"}
+#: attribute names that read/block regardless of receiver spelling
+#: (urllib.request.urlopen, socket.create_connection).
+_BLOCKING_ATTRS = {"urlopen", "create_connection", "getaddrinfo"}
+#: Path read methods — Path(...).read_text() in a hot method is file
+#: I/O just like open().read().
+_PATH_READ_ATTRS = {"read_text", "read_bytes"}
+
+
+def _check_blocking_host_io(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ014 (under ``workflow/``/``nodes/``): blocking host I/O inside
+    an operator hot-path method — ``time.sleep``, ``open(...)`` /
+    ``Path.read_*`` file reads, or urllib/requests/socket network
+    calls. The non-device companion of KJ005's blocking-host-pull rule:
+    a sleep or synchronous read on the apply path stalls every request
+    for the full host-call latency, invisibly to the roofline time
+    model that prices the KP903 serving bound."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) \
+                    or fn.name not in _HOT_PATH_METHODS:
+                continue
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                func = sub.func
+                offense = None
+                if isinstance(func, ast.Name):
+                    if func.id == "open":
+                        offense = "`open(...)` file I/O"
+                    elif func.id in ("urlopen", "sleep"):
+                        offense = f"`{func.id}(...)`"
+                elif isinstance(func, ast.Attribute):
+                    root = _chain_root(func)
+                    root_id = root.id if isinstance(root, ast.Name) else ""
+                    if func.attr == "sleep" and root_id == "time":
+                        offense = "`time.sleep(...)`"
+                    elif func.attr in _BLOCKING_ATTRS:
+                        offense = f"`{root_id or '...'}.{func.attr}(...)`"
+                    elif root_id in _NETWORK_RECEIVERS:
+                        offense = f"`{root_id}.{func.attr}(...)` network call"
+                    elif func.attr in _PATH_READ_ATTRS:
+                        offense = f"`.{func.attr}()` file read"
+                    elif func.attr == "read" and isinstance(
+                            func.value, ast.Call) and isinstance(
+                            func.value.func, ast.Name) \
+                            and func.value.func.id == "open":
+                        offense = "`open(...).read()`"
+                if offense is not None:
+                    yield Finding(
+                        path, sub.lineno, "KJ014",
+                        f"{offense} in hot-path method `{fn.name}`: "
+                        "blocking host I/O stalls every request for the "
+                        "full call latency and is invisible to the "
+                        "KP903 serving latency bound — hoist it to "
+                        "construction/fit time or the serving ingress")
+
+
 # ----------------------------------------------------------------- driver
 
 
@@ -1119,6 +1201,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_literal_precision_cast(tree, rel))
         findings.extend(_check_dynamic_metric_name(tree, rel))
         findings.extend(_check_transpose_reshape(tree, rel))
+        findings.extend(_check_blocking_host_io(tree, rel))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
 

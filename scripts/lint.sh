@@ -154,9 +154,46 @@ print(f"roofline audit: {len(examples)} example(s), {priced} priced stage "
       f"rows, {candidates} KP801 pallas candidate(s), 0 KP8xx errors OK")
 PY
 
+echo "== serving audit (KP9xx readiness certificate over every example) =="
+# The serving-readiness certifier's gate: certify every analyzable()
+# example against the default envelope (batch [1,64], 1s SLO) and
+# assert (1) the CLI exits 0 — zero UNSUPPRESSED ERROR-severity KP9xx
+# findings anywhere, (2) at least 5 examples certify clean, and (3)
+# every example that cannot certify carries NAMED suppressions
+# (serving.SERVING_SUPPRESSIONS — each states the stage and the fix),
+# so the audit says exactly what is uncertified and why instead of
+# silently passing.
+SERVING_JSON="$(mktemp /tmp/keystone_serving_audit.XXXXXX.json)"
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON"' EXIT
+JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --certify-serving \
+    --json > "$SERVING_JSON"
+python - "$SERVING_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+examples = payload["examples"]
+assert len(examples) >= 7, [e.get("example") for e in examples]
+certified = 0
+for e in examples:
+    assert "build_error" not in e, e
+    assert e["unsuppressed_errors"] == 0, (e["example"], e["findings"])
+    if e["certified"]:
+        certified += 1
+        assert e["certificate"]["shapes"], e["example"]
+        assert all(s["predicted_seconds"] > 0
+                   for s in e["certificate"]["shapes"]), e["example"]
+    else:
+        assert e["suppressions"], (
+            f"{e['example']} is uncertified with NO named suppression")
+assert certified >= 5, f"only {certified} example(s) certified clean"
+suppressed = sum(1 for e in examples if e["suppressions"])
+print(f"serving audit: {len(examples)} example(s), {certified} certified "
+      f"clean, {suppressed} carrying named suppressions, 0 unsuppressed "
+      "KP9xx errors OK")
+PY
+
 echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
 TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_SMOKE_TRACE="$TRACE_TMP" python - <<'PY'
 import json, os
 import numpy as np
@@ -180,7 +217,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
 
 echo "== dispatch smoke (example pipeline under the concurrent scheduler) =="
 DISPATCH_TRACE="$(mktemp /tmp/keystone_dispatch_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_TRACE="$DISPATCH_TRACE" KEYSTONE_CONCURRENT_DISPATCH=1 \
 python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance) run
@@ -212,7 +249,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
 echo "== compile smoke (warm second run performs 0 cold compiles) =="
 COMPILE_CACHE="$(mktemp -d /tmp/keystone_compile_smoke.XXXXXX)"
 COMPILE_TRACE="$(mktemp /tmp/keystone_compile_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$COMPILE_CACHE" \
 KEYSTONE_TRACE="$COMPILE_TRACE" python - <<'PY'
 # One example pipeline run TWICE against a fresh persistent-cache dir
@@ -256,7 +293,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
 echo "== megafusion smoke (1-program apply run; warm repeat stays 0-cold) =="
 MEGA_CACHE="$(mktemp -d /tmp/keystone_mega_smoke.XXXXXX)"
 MEGA_TRACE="$(mktemp /tmp/keystone_mega_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_MEGAFUSION=1 KEYSTONE_COMPILE_CACHE="$MEGA_CACHE" \
 KEYSTONE_TRACE="$MEGA_TRACE" python - <<'PY'
 # One example apply run TWICE under megafusion against a fresh
@@ -300,7 +337,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$MEGA_TRACE" >/dev/null
 echo "== ledger smoke (decision records match enforced plan tags; self-diff clean) =="
 LEDGER_TRACE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.json)"
 LEDGER_FILE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.jsonl)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 KEYSTONE_TRACE="$LEDGER_TRACE" KEYSTONE_LEDGER="$LEDGER_FILE" python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance,

@@ -6,6 +6,7 @@ Usage: python scripts/perf_table.py [path=BENCH_LAST_GOOD.json]
        python scripts/perf_table.py --trace run.json [--top N]
        python scripts/perf_table.py --ledger run.ledger.jsonl
        python scripts/perf_table.py --roofline [EXAMPLE ...]
+       python scripts/perf_table.py --serving [EXAMPLE ...]
 
 ``--roofline`` runs the STATIC roofline analyzer
 (keystone_tpu/analysis/roofline.py) over the named analyzable()
@@ -25,6 +26,15 @@ optimizer decisions, the decision tables are appended automatically.
 path a bench record carries, or a decision-carrying trace) as the
 markdown predicted-vs-observed tables PERF.md rounds source their
 decision columns from.
+
+``--serving`` runs the STATIC serving-readiness certifier
+(keystone_tpu/analysis/serving.py — the KP9xx tier) over the named
+analyzable() examples (default: every registered example) and renders
+the per-example markdown verdict table: certified / uncertified (with
+the NAMED suppressions for examples that genuinely cannot certify
+yet), the worst-shape certified latency bound vs the SLO, and the
+dominating stage. ``KEYSTONE_SLO_MS`` / ``KEYSTONE_SERVING_MAX_BATCH``
+refine the envelope.
 """
 
 import json
@@ -235,7 +245,62 @@ def roofline_table(examples=None):
               f"{machine.peak_bw:.3g} B/s)")
 
 
+def serving_table(examples=None):
+    """Markdown per-example serving-certification table from the STATIC
+    KP9xx certifier (no run needed): the ROADMAP serving runtime's
+    pre-traffic readiness board."""
+    sys.path.insert(0, ".")
+    from keystone_tpu.analysis.examples import EXAMPLES
+    from keystone_tpu.analysis.serving import (
+        SERVING_SUPPRESSIONS,
+        ServingEnvelope,
+        certify_example,
+        envelope_from_env,
+    )
+
+    envelope = envelope_from_env(require_slo=False)
+    print(f"**Serving readiness** — envelope: batch "
+          f"[{envelope.min_batch}, {envelope.max_batch}], SLO "
+          f"{envelope.slo_seconds * 1e3:.0f} ms, "
+          f"{envelope.tenants} tenant(s)\n")
+    print("| Example | Verdict | Worst shape | Bound | SLO | "
+          "Dominating stage | Notes |")
+    print("|---|---|---|---|---|---|---|")
+    for name in examples or sorted(EXAMPLES):
+        try:
+            cert, diags = certify_example(name, envelope)
+        except Exception as e:
+            print(f"| {name} | build error | — | — | — | — | "
+                  f"{type(e).__name__}: {e} |")
+            continue
+        suppressed = sorted(
+            {d.rule for d in diags if d.severity.name == "ERROR"
+             and d.rule in SERVING_SUPPRESSIONS.get(name, {})})
+        verdict = ("certified" if cert.certified else
+                   f"uncertified (suppressed: {', '.join(suppressed)})"
+                   if suppressed else "**UNCERTIFIED**")
+        worst = cert.worst_shape
+        notes = []
+        if cert.ingress:
+            notes.append(f"ingress at {cert.ingress['stage']}")
+        if cert.unpriced_stages:
+            notes.append(f"{cert.unpriced_stages} unpriced host stage(s)")
+        if cert.exposed_stages:
+            notes.append(f"{len(cert.exposed_stages)} recompile-exposed")
+        print(f"| {name} | {verdict} "
+              f"| {worst['batch'] if worst else '—'} "
+              f"| {worst['predicted_seconds'] * 1e3:.1f} ms "
+              f"| {envelope.slo_seconds * 1e3:.0f} ms "
+              f"| {(cert.dominating_stage or '—')[:44]} "
+              f"| {'; '.join(notes) or '—'} |")
+    print()
+
+
 def main():
+    if "--serving" in sys.argv:
+        names = [a for a in sys.argv[sys.argv.index("--serving") + 1:]
+                 if not a.startswith("-")]
+        return serving_table(names or None)
     if "--roofline" in sys.argv:
         names = [a for a in sys.argv[sys.argv.index("--roofline") + 1:]
                  if not a.startswith("-")]

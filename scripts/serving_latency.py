@@ -1,11 +1,36 @@
-"""Single-datum serving latency (VERDICT r4 #7).
+"""Serving latency — the observed half of the KP9xx serving-cert join.
 
-Measures warm `FittedPipeline.apply(datum)` p50/p90/p99 for the
-RandomPatchCifar image pipeline and the Newsgroups text pipeline — the
-reference's single-item hot loop (Operator.scala:77-100 single dispatch,
-FittedPipeline.scala:38). Prints one JSON line; results land in PERF.md.
+Measures warm `FittedPipeline.apply` percentiles two ways:
+
+  - the legacy single-datum records (VERDICT r4 #7): warm batch=1
+    p50/p90/p99 for RandomPatchCifar and Newsgroups — PERF.md's
+    serving rows, unchanged;
+  - per-shape records over the serving envelope's pad ladder: for each
+    request batch size, the batch coalesces onto PR-5's pow-2 ladder
+    (`utils.batching._pad_target`), and the record carries the batch,
+    the padded ``chunk_shape`` it dispatched at, the percentiles, and
+    the ``trace`` path — exactly the observed side
+    `analysis.reconcile.reconcile_serving` joins against the certified
+    per-shape bounds.
+
+Each covered example runs with the ambient tracer armed AND the
+serving envelope armed (``KEYSTONE_SLO_MS`` — set by this script when
+absent), so the apply-run executor embeds the KP9xx certificate
+(``keystone.serving``) into the same trace this script embeds its
+measurements into (``keystone.serving_observed``): ONE artifact
+carries both sides of the join, and
+
+    python -m keystone_tpu.telemetry <trace>   # serving reconciliation
+    python scripts/perf_table.py --serving     # certified-vs-SLO table
+
+render predicted-bound-vs-observed-p50 per shape. Coverage is every
+example with a runnable synthetic instance: RandomPatchCifar,
+NewsgroupsPipeline, MnistRandomFFT, TimitPipeline (the dispatch-bench
+instances); VOC/ImageNet SIFT remain static-only until their loaders
+grow synthetic fixtures.
 
 Usage: python scripts/serving_latency.py [--reps 200] [--out -]
+           [--max-batch 64] [--trace-dir /tmp] [--examples NAME ...]
        KEYSTONE_BACKEND=cpu python scripts/serving_latency.py --reps 20
 """
 
@@ -15,6 +40,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -33,19 +59,162 @@ def _percentiles(samples):
     }
 
 
-def bench_cifar(reps: int):
+# ------------------------------------------------------- example builders
+#
+# Each builder returns ``(fitted, make_batch, sync)``: a fitted
+# pipeline, a ``make_batch(b, i)`` closure yielding the i-th rotating
+# request batch of size b, and a ``sync(out)`` host-synchronizer (the
+# timed section must include device→host completion).
+
+
+def _build_cifar():
     from keystone_tpu.loaders.cifar_loader import synthetic_cifar
     from keystone_tpu.pipelines.random_patch_cifar import (
         RandomPatchCifarConfig,
         build_pipeline,
     )
-    from keystone_tpu.workflow import PipelineEnv
 
-    PipelineEnv.reset()
     config = RandomPatchCifarConfig(num_filters=256)
     train, _ = synthetic_cifar(2048, 64, config.num_classes, config.seed)
     fitted = build_pipeline(train, config).fit()
-    images = np.asarray(train.data.numpy())[:reps + 8]
+    images = np.asarray(train.data.numpy())
+    return fitted, images, config.num_classes
+
+
+def _build_newsgroups():
+    from keystone_tpu.pipelines.text_pipelines import (
+        build_newsgroups_predictor,
+        synthetic_corpus,
+    )
+
+    labels, docs = synthetic_corpus(800, 4, seed=0)
+    fitted = build_newsgroups_predictor(docs, labels, 4).fit()
+    return fitted, list(docs.items)
+
+
+def _bench_example_builder(name):
+    """A per-shape builder over the dispatch-bench synthetic instance of
+    ``name`` — the same pipelines the lint.sh smokes run."""
+    from keystone_tpu.dispatch_bench import EXAMPLES as BENCH
+
+    def build():
+        from keystone_tpu.data.dataset import Dataset
+
+        predictor, train, test = BENCH[name]()
+        fitted = predictor.fit()
+        X = np.concatenate([np.asarray(test.numpy()),
+                            np.asarray(train.numpy())])
+
+        def make_batch(b, i):
+            off = (i * b) % max(1, len(X) - b)
+            return Dataset.from_numpy(np.ascontiguousarray(X[off:off + b]))
+
+        def sync(out):
+            return np.asarray(out.numpy())
+
+        return fitted, make_batch, sync
+
+    return build
+
+
+def _make_array_batcher(images):
+    from keystone_tpu.data.dataset import Dataset
+
+    def make_batch(b, i):
+        off = (i * b) % max(1, len(images) - b)
+        return Dataset.from_numpy(np.ascontiguousarray(images[off:off + b]))
+
+    def sync(out):
+        return np.asarray(out.numpy())
+
+    return make_batch, sync
+
+
+def _make_host_batcher(items):
+    from keystone_tpu.data.dataset import HostDataset
+
+    def make_batch(b, i):
+        off = (i * b) % max(1, len(items) - b)
+        return HostDataset(items[off:off + b])
+
+    def sync(out):
+        return np.asarray(out.numpy())
+
+    return make_batch, sync
+
+
+#: covered examples (names match the analysis registry); each maps to a
+#: builder returning ``(fitted, make_batch, sync)``.
+def _builders():
+    def cifar():
+        fitted, images, _ = _build_cifar()
+        return (fitted, *_make_array_batcher(images))
+
+    def newsgroups():
+        fitted, items = _build_newsgroups()
+        return (fitted, *_make_host_batcher(items))
+
+    return {
+        "RandomPatchCifar": cifar,
+        "NewsgroupsPipeline": newsgroups,
+        "MnistRandomFFT": _bench_example_builder("MnistRandomFFT"),
+        "TimitPipeline": _bench_example_builder("TimitPipeline"),
+    }
+
+
+# ----------------------------------------------------------- measurement
+
+
+def bench_shapes(name, build, reps, batches, trace_path):
+    """Per-shape percentile records for one example. Percentiles are
+    measured UNTRACED (an armed tracer re-runs the static-estimate
+    embed per request-bound executor — host work a serving process
+    would not pay per request); then one warm apply per shape runs
+    inside a `trace_run` so the apply executor embeds the KP9xx
+    certificate, and the observed records are embedded alongside it —
+    the written trace carries both sides of the `reconcile_serving`
+    join."""
+    from keystone_tpu.analysis.memory import resolve_chunk_rows
+    from keystone_tpu.telemetry import trace_run
+    from keystone_tpu.utils.batching import _pad_target
+    from keystone_tpu.workflow import PipelineEnv
+    from keystone_tpu.workflow.executor import drain_warmups
+
+    PipelineEnv.reset()
+    chunk = resolve_chunk_rows(None)
+    records = []
+    fitted, make_batch, sync = build()
+    drain_warmups()  # AOT ladder warmup must not count against p99
+    for b in batches:
+        sync(fitted.apply(make_batch(b, 0)))  # warm this shape
+        sync(fitted.apply(make_batch(b, 1)))
+        samples = []
+        for i in range(reps):
+            x = make_batch(b, 2 + i)
+            t0 = time.perf_counter()
+            sync(fitted.apply(x))
+            samples.append(time.perf_counter() - t0)
+        rec = _percentiles(samples)
+        rec["batch"] = int(b)
+        rec["chunk_shape"] = int(_pad_target(b, chunk, b))
+        rec["trace"] = trace_path
+        records.append(rec)
+    # the join artifact: one warm apply per shape under the tracer (the
+    # executor embeds keystone.serving), plus the observed half
+    with trace_run(trace_path) as tracer:
+        for b in batches:
+            sync(fitted.apply(make_batch(b, 0)))
+        tracer.metadata["serving_observed"] = records
+    PipelineEnv.reset()
+    return records
+
+
+def bench_cifar(reps: int):
+    """Legacy single-datum record (PERF.md serving row)."""
+    from keystone_tpu.workflow import PipelineEnv
+
+    PipelineEnv.reset()
+    fitted, images, num_classes = _build_cifar()
 
     int(fitted.apply(images[0]))  # warm the batch=1 programs
     int(fitted.apply(images[1]))
@@ -55,21 +224,16 @@ def bench_cifar(reps: int):
         t0 = time.perf_counter()
         out = int(fitted.apply(x))  # int() = host sync
         samples.append(time.perf_counter() - t0)
-        assert 0 <= out < config.num_classes
+        assert 0 <= out < num_classes
     return _percentiles(samples)
 
 
 def bench_newsgroups(reps: int):
-    from keystone_tpu.pipelines.text_pipelines import (
-        build_newsgroups_predictor,
-        synthetic_corpus,
-    )
+    """Legacy single-datum record (PERF.md serving row)."""
     from keystone_tpu.workflow import PipelineEnv
 
     PipelineEnv.reset()
-    labels, docs = synthetic_corpus(800, 4, seed=0)
-    fitted = build_newsgroups_predictor(docs, labels, 4).fit()
-    items = list(docs.items)
+    fitted, items = _build_newsgroups()
 
     int(fitted.apply(items[0]))  # warm
     int(fitted.apply(items[1]))
@@ -86,6 +250,20 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--reps", type=int, default=200)
     p.add_argument("--out", default="-")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="largest request batch measured; per-shape "
+                        "batches walk the pow-2 ladder 1..max-batch "
+                        "(the serving envelope's coalescing window)")
+    p.add_argument("--trace-dir", default=None,
+                   help="directory for per-example trace artifacts "
+                        "(default: a fresh temp dir); each trace "
+                        "carries keystone.serving AND "
+                        "keystone.serving_observed — the reconcile_"
+                        "serving join input")
+    p.add_argument("--examples", nargs="*", default=None,
+                   help="subset of covered examples (default: all)")
+    p.add_argument("--skip-shapes", action="store_true",
+                   help="legacy single-datum records only")
     args = p.parse_args()
     if os.environ.get("KEYSTONE_BACKEND") == "cpu":
         import jax
@@ -93,18 +271,69 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax
 
+    # pop an inherited KEYSTONE_SLO_MS up front: the legacy
+    # single-datum rows must run with the envelope DISARMED so their
+    # methodology (and comparability with prior PERF.md rounds) is
+    # untouched by the ladder AOT warmup an armed envelope triggers —
+    # and a malformed value must degrade NOW, not crash after minutes
+    # of measurement
+    inherited = os.environ.pop("KEYSTONE_SLO_MS", None)
+    try:
+        slo_ms = float(inherited) if inherited else 1000.0
+    except (TypeError, ValueError):
+        slo_ms = 1000.0
+
     record = {
-        "workload": "single-datum serving latency (warm, batch=1 jitted)",
+        "workload": "serving latency (warm apply; per-shape over the "
+                    "pad ladder + legacy single-datum)",
         "platform": jax.devices()[0].platform,
         "random_patch_cifar": bench_cifar(args.reps),
         "newsgroups": bench_newsgroups(args.reps),
     }
+
+    if not args.skip_shapes:
+        # arm the serving envelope for the per-shape section: the
+        # apply-run executor embeds the KP9xx certificate into the
+        # trace this script measures into, and warmup widens to the
+        # ladder (drained before timing). --max-batch is explicit and
+        # must WIN over an inherited env var — otherwise the measured
+        # shapes and the certified ladder desynchronize and the excess
+        # shapes cold-compile inside the timed section
+        os.environ["KEYSTONE_SLO_MS"] = str(slo_ms)
+        os.environ["KEYSTONE_SERVING_MAX_BATCH"] = str(args.max_batch)
+        record["slo_ms"] = slo_ms
+        trace_dir = args.trace_dir or tempfile.mkdtemp(
+            prefix="keystone_serving_")
+        os.makedirs(trace_dir, exist_ok=True)
+        batches = []
+        b = 1
+        while b < args.max_batch:
+            batches.append(b)
+            b <<= 1
+        batches.append(args.max_batch)
+        builders = _builders()
+        names = args.examples or sorted(builders)
+        shapes = {}
+        for name in names:
+            if name not in builders:
+                print(f"unknown example {name!r}; covered: "
+                      f"{', '.join(sorted(builders))}", file=sys.stderr)
+                return 2
+            trace_path = os.path.join(trace_dir, f"{name}.trace.json")
+            shapes[name] = {
+                "trace": trace_path,
+                "shapes": bench_shapes(name, builders[name], args.reps,
+                                       batches, trace_path),
+            }
+        record["examples"] = shapes
+
     line = json.dumps(record)
     print(line)
     if args.out != "-":
         with open(args.out, "w") as f:
             f.write(line + "\n")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

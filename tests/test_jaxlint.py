@@ -834,6 +834,100 @@ def test_kj013_suppression(tmp_path):
     assert jl.lint_file(src) == []
 
 
+def test_kj014_flags_blocking_host_io_in_hot_methods(tmp_path):
+    """KJ014: `time.sleep`, file reads, and network calls inside an
+    operator hot-path method stall every request for the full host-call
+    latency — invisibly to the KP903 serving latency bound. All the
+    spellings flag: `time.sleep`/bare `sleep`, `open(...)`,
+    `Path.read_text/read_bytes`, `urllib.request.urlopen`,
+    `requests.get`, `socket.create_connection`."""
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "bad_io.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import time\n"
+        "import socket\n"
+        "import urllib.request\n"
+        "import requests\n"
+        "from pathlib import Path\n"
+        "from time import sleep\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def apply(self, x):\n"
+        "        time.sleep(0.1)\n"                               # KJ014
+        "        sleep(0.1)\n"                                    # KJ014
+        "        vocab = open('vocab.txt')\n"                     # KJ014
+        "        return x, vocab\n"
+        "\n"
+        "    def apply_batch(self, data):\n"
+        "        w = Path('weights.bin').read_bytes()\n"          # KJ014
+        "        r = urllib.request.urlopen('http://e/x')\n"      # KJ014
+        "        return data, w, r\n"
+        "\n"
+        "    def _chunk_loop(self, fn, params, xs, ms):\n"
+        "        requests.get('http://e/feature-store')\n"        # KJ014
+        "        socket.create_connection(('e', 80))\n"           # KJ014
+        "        return fn(params, xs, ms)\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ014"] * 7, findings
+    assert sorted(f.line for f in findings) == [11, 12, 13, 17, 18, 22, 23]
+
+    # the same calls at construction/fit time — and sleeps outside any
+    # operator class — are exactly where the rule says to hoist them
+    ok = tmp_path / "nodes" / "ok_io.py"
+    ok.write_text(
+        "import time\n"
+        "\n"
+        "\n"
+        "def backoff_helper():\n"
+        "    time.sleep(0.1)\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def __init__(self, path):\n"
+        "        self.vocab = open(path).read()\n"
+        "\n"
+        "    def fit(self, data):\n"
+        "        import urllib.request\n"
+        "        self.w = urllib.request.urlopen('http://e/w')\n"
+        "        return self\n"
+        "\n"
+        "    def apply(self, x):\n"
+        "        self.clock.sleep\n"
+        "        return x\n"
+    )
+    assert jl.lint_file(ok) == []
+
+    # outside workflow/ and nodes/ (loaders do blocking I/O by design)
+    elsewhere = tmp_path / "loaders" / "reader.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj014_suppression(tmp_path):
+    """A genuinely per-request external lookup suppresses per line with
+    a rationale naming why it cannot be batched ahead of the request."""
+    jl = _jaxlint()
+    src = tmp_path / "workflow" / "suppressed_io.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "import requests\n"
+        "\n"
+        "\n"
+        "class T:\n"
+        "    def apply(self, x):\n"
+        "        # per-request entitlement check: the auth decision\n"
+        "        # cannot be precomputed\n"
+        "        tok = requests.get('http://auth/check')"
+        "  # keystone: ignore[KJ014]\n"
+        "        return x, tok\n"
+    )
+    assert jl.lint_file(src) == []
+
+
 def test_lint_sh_gate(tmp_path):
     """`scripts/lint.sh`'s jaxlint stage passes on the repo and fails on
     a seeded violation (the acceptance contract)."""

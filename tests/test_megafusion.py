@@ -402,9 +402,12 @@ def test_warmup_rearms_after_fit_resolution(monkeypatch):
 
     warmed = []
 
-    def fake_submit(op, element, count):
+    def fake_submit(op, element, counts):
+        # counts: one count or the serving-ladder sequence of them
+        if isinstance(counts, int):
+            counts = (counts,)
         warmed.append((getattr(op, "label", str(op)), tuple(element.shape),
-                       int(count)))
+                       tuple(int(c) for c in counts)))
 
     monkeypatch.setattr(executor_mod, "_submit_warmup", fake_submit)
 

@@ -1,0 +1,464 @@
+"""Static serving-readiness certifier tests (analysis/serving — KP9xx).
+
+The acceptance contract of the tier: a fitted pipeline is provably ONE
+warm, host-free, latency-bounded program over a declared envelope
+before any traffic arrives — and the warmup-manifest claim is pinned
+live: with the envelope armed, warm apply at EVERY pad-ladder shape the
+envelope can produce performs ZERO cold XLA compiles (the PR-5
+`compile_count` discipline extended past the single propagated shape).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis import (
+    Severity,
+    ServingCertificate,
+    ServingEnvelope,
+    as_source_spec,
+    envelope_from_env,
+    ladder_shapes,
+    serving_pass,
+    validate_graph,
+    warmup_manifest,
+)
+from keystone_tpu.analysis.examples import build_example
+from keystone_tpu.analysis.propagate import spec_pass
+from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.nodes.util import (
+    ClassLabelIndicatorsFromInt,
+    MaxClassifier,
+    VectorCombiner,
+)
+from keystone_tpu.workflow import Pipeline, PipelineEnv
+
+
+@pytest.fixture(autouse=True)
+def _reset_env():
+    PipelineEnv.reset()
+    yield
+    PipelineEnv.reset()
+
+
+@pytest.fixture(autouse=True)
+def _disarm_env_envelope(monkeypatch):
+    """Certification must be armed explicitly in these tests."""
+    for var in ("KEYSTONE_SLO_MS", "KEYSTONE_SERVING_MAX_BATCH",
+                "KEYSTONE_SERVING_TENANTS"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def _mnist_like():
+    """The canonical fully-priced abstract example (the CLI's
+    MnistRandomFFT registry entry)."""
+    pipeline, source_spec = build_example("MnistRandomFFT")
+    specs, _ = spec_pass(
+        pipeline.graph, {pipeline.source: as_source_spec(source_spec)})
+    return pipeline, specs
+
+
+def _rules(diags):
+    return [d.rule for d in diags]
+
+
+# ------------------------------------------------------------- envelope
+
+
+def test_envelope_validates_its_contract():
+    with pytest.raises(ValueError):
+        ServingEnvelope(min_batch=0)
+    with pytest.raises(ValueError):
+        ServingEnvelope(min_batch=8, max_batch=4)
+    with pytest.raises(ValueError):
+        ServingEnvelope(slo_seconds=0.0)
+    with pytest.raises(ValueError):
+        ServingEnvelope(tenants=0)
+
+
+def test_envelope_from_env_arms_and_disarms(monkeypatch):
+    assert envelope_from_env() is None  # disarmed by default
+    monkeypatch.setenv("KEYSTONE_SLO_MS", "250")
+    monkeypatch.setenv("KEYSTONE_SERVING_MAX_BATCH", "16")
+    monkeypatch.setenv("KEYSTONE_SERVING_TENANTS", "3")
+    env = envelope_from_env()
+    assert env == ServingEnvelope(max_batch=16, slo_seconds=0.25, tenants=3)
+    # a malformed value disarms rather than breaking validation
+    monkeypatch.setenv("KEYSTONE_SLO_MS", "not-a-number")
+    assert envelope_from_env() is None
+
+
+def test_ladder_shapes_are_the_pad_target_image():
+    from keystone_tpu.utils.batching import _pad_target
+
+    shapes = ladder_shapes(ServingEnvelope(max_batch=64), chunk_rows=64)
+    assert shapes == [1, 2, 4, 8, 16, 32, 64]
+    # the contract KP902 certifies against: EVERY in-envelope batch
+    # coalesces onto an enumerated shape
+    for b in range(1, 65):
+        assert _pad_target(b, 64, b) in shapes
+    # batches past the chunk size clamp to the chunk
+    assert ladder_shapes(
+        ServingEnvelope(max_batch=512), chunk_rows=64)[-1] == 64
+    # a narrowed batch range drops the small rungs
+    assert ladder_shapes(
+        ServingEnvelope(min_batch=5, max_batch=8), chunk_rows=64) == [8]
+
+
+# ---------------------------------------------------------- the verdict
+
+
+def test_certified_pipeline_and_report_surface():
+    pipeline, specs = _mnist_like()
+    cert, diags = serving_pass(
+        pipeline.graph, specs, ServingEnvelope(max_batch=16),
+        source=pipeline.source, sink=pipeline.sink, record=False)
+    assert isinstance(cert, ServingCertificate)
+    assert cert.certified
+    assert cert.priced_stages > 0 and cert.unpriced_stages == 0
+    assert cert.dominating_stage
+    assert [s["batch"] for s in cert.shapes] == [1, 2, 4, 8, 16]
+    for s in cert.shapes:
+        # the certified bound is the upper envelope; the machine bound
+        # (roofline + dispatch floor) the hardware lower one
+        assert s["predicted_seconds"] > s["machine_seconds"] > 0
+    assert "KP903" in _rules(diags)  # INFO: bound holds
+    rec = cert.as_record()
+    assert rec["certified"] and rec["shapes"] and rec["warmup_manifest"]
+
+
+def test_validate_attaches_certificate_only_when_armed(monkeypatch):
+    pipeline, source_spec = build_example("MnistRandomFFT")
+    report = pipeline.validate(source_spec, raise_on_error=False)
+    assert report.serving is None  # no envelope: the tier is skipped
+    report = pipeline.validate(
+        source_spec, serving=ServingEnvelope(max_batch=8),
+        raise_on_error=False)
+    assert report.serving is not None and report.serving.certified
+    # the env-declared envelope arms it too
+    monkeypatch.setenv("KEYSTONE_SLO_MS", "500")
+    report = pipeline.validate(source_spec, raise_on_error=False)
+    assert report.serving is not None
+    assert report.serving.envelope.slo_seconds == 0.5
+
+
+def test_kp901_names_host_stages_and_their_fix():
+    pipeline, source_spec = build_example("NewsgroupsPipeline")
+    specs, _ = spec_pass(
+        pipeline.graph, {pipeline.source: as_source_spec(source_spec)})
+    cert, diags = serving_pass(pipeline.graph, specs, record=False)
+    errors = [d for d in diags if d.rule == "KP901"]
+    assert errors and not cert.certified
+    labels = {d.label for d in errors}
+    assert "Trim" in labels  # the host NLP front-end, stage-named
+    assert all("Fix:" in d.message for d in errors)
+
+
+def test_kp903_busted_slo_names_the_dominating_stage():
+    pipeline, specs = _mnist_like()
+    cert, diags = serving_pass(
+        pipeline.graph, specs,
+        ServingEnvelope(max_batch=64, slo_seconds=1e-9),
+        source=pipeline.source, sink=pipeline.sink, record=False)
+    assert not cert.certified
+    bust = [d for d in diags
+            if d.rule == "KP903" and d.severity == Severity.ERROR]
+    assert len(bust) == 1
+    assert cert.dominating_stage in bust[0].message
+    assert f"batch {cert.worst_shape['batch']}" in bust[0].message
+
+
+def test_kp904_flags_donated_request_buffer():
+    class _DonatingRectifier(LinearRectifier):
+        donates_deps = (0,)
+
+    pipe = RandomSignNode(8).to_pipeline() >> _DonatingRectifier(0.0)
+    # the donating stage reads the RandomSign output (an interior
+    # buffer): safe
+    specs, _ = spec_pass(pipe.graph, {pipe.source: as_source_spec((8,))})
+    _, diags = serving_pass(pipe.graph, specs, record=False)
+    assert "KP904" not in _rules(diags)
+
+    pipe2 = _DonatingRectifier(0.0).to_pipeline() >> RandomSignNode(8)
+    specs2, _ = spec_pass(pipe2.graph, {pipe2.source: as_source_spec((8,))})
+    cert, diags2 = serving_pass(pipe2.graph, specs2, record=False)
+    kp904 = [d for d in diags2 if d.rule == "KP904"]
+    assert len(kp904) == 1 and kp904[0].severity == Severity.ERROR
+    assert not cert.certified
+
+
+def test_kp905_prices_multi_tenant_residency():
+    pipeline, specs = _mnist_like()
+    _, diags = serving_pass(
+        pipeline.graph, specs, ServingEnvelope(tenants=2),
+        source=pipeline.source, sink=pipeline.sink,
+        hbm_budget_bytes=1 << 40, record=False)
+    info = [d for d in diags if d.rule == "KP905"]
+    assert len(info) == 1 and info[0].severity == Severity.INFO
+
+    cert, diags = serving_pass(
+        pipeline.graph, specs, ServingEnvelope(tenants=1_000_000),
+        source=pipeline.source, sink=pipeline.sink,
+        hbm_budget_bytes=1 << 20, record=False)
+    over = [d for d in diags if d.rule == "KP905"]
+    assert len(over) == 1 and over[0].severity == Severity.ERROR
+    assert not cert.certified
+
+
+def test_kp906_flags_dynamic_metric_names_on_instantiated_operators():
+    class _ChattyRectifier(LinearRectifier):
+        def apply(self, x):
+            from keystone_tpu.telemetry import counter
+
+            counter(f"serve.{self.label}").inc()
+            return super().apply(x)
+
+    pipe = RandomSignNode(8).to_pipeline() >> _ChattyRectifier(0.0)
+    specs, _ = spec_pass(pipe.graph, {pipe.source: as_source_spec((8,))})
+    _, diags = serving_pass(pipe.graph, specs, record=False)
+    kp906 = [d for d in diags if d.rule == "KP906"]
+    assert len(kp906) == 1 and kp906[0].severity == Severity.WARNING
+    assert "apply" in kp906[0].message
+
+    class _HistogramRectifier(LinearRectifier):
+        def apply(self, x):
+            import jax.numpy as jnp
+
+            h, _ = jnp.histogram(x, bins=int(x.shape[-1]))
+            return h
+
+    # np/jnp.histogram is math, not a metric factory (the KJ012
+    # receiver filter applies here too)
+    pipe2 = RandomSignNode(8).to_pipeline() >> _HistogramRectifier(0.0)
+    specs2, _ = spec_pass(pipe2.graph, {pipe2.source: as_source_spec((8,))})
+    _, diags2 = serving_pass(pipe2.graph, specs2, record=False)
+    assert [d for d in diags2 if d.rule == "KP906"] == []
+
+
+def test_serving_cert_lands_in_the_ledger():
+    from keystone_tpu.telemetry import ledger
+
+    pipeline, specs = _mnist_like()
+    mark = ledger.session_mark()
+    serving_pass(pipeline.graph, specs, ServingEnvelope(max_batch=8),
+                 source=pipeline.source, sink=pipeline.sink,
+                 label="MnistRandomFFT")
+    records = [d for d in ledger.session_since(mark)
+               if d["kind"] == "serving_cert"]
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["labels"] == ["MnistRandomFFT"]
+    assert rec["chosen"]["entry"] == "certified"
+    # the priced per-shape menu is the alternatives list
+    assert [a["entry"] for a in rec["alternatives"]] == [
+        "batch=1", "batch=2", "batch=4", "batch=8"]
+    assert rec["predicted"]["worst_shape_seconds"] > 0
+
+
+# ------------------------------------------------------ warmup manifest
+
+
+def test_warmup_manifest_enumerates_sites_times_ladder():
+    pipeline, source_spec = build_example("MnistRandomFFT")
+    manifest = warmup_manifest(
+        pipeline.graph,
+        {pipeline.source: as_source_spec(source_spec)},
+        envelope=ServingEnvelope(max_batch=16))
+    assert manifest, "no warmable fused program site found"
+    for entry in manifest:
+        assert entry["counts"] == [1, 2, 4, 8, 16]
+        assert hasattr(entry["element"], "shape")
+        assert "Fused[" in entry["label"]
+
+
+def _fit_small_predictor():
+    """A tiny real fitted pipeline: gather(2 fft branches) → block LS →
+    argmax. Fits in seconds on CPU; the default optimizer collapses the
+    whole apply path into one fused program."""
+    from keystone_tpu.data.dataset import Dataset
+
+    rng = np.random.default_rng(0)
+    dim, n, k = 16, 48, 3
+    X = rng.normal(size=(n, dim)).astype(np.float32)
+    y = rng.integers(0, k, n).astype(np.int32)
+    branches = [
+        RandomSignNode(dim, seed=i) >> PaddedFFT() >> LinearRectifier(0.0)
+        for i in range(2)
+    ]
+    feat = Pipeline.gather(branches) >> VectorCombiner()
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+    pred = feat.and_then(
+        BlockLeastSquaresEstimator(32, 1, 1e-2), train, labels
+    ) >> MaxClassifier()
+    return pred.fit(), X
+
+
+def _compile_events(fn):
+    """Run fn, return (number of XLA compile requests, result)."""
+    from jax._src import monitoring
+
+    events = []
+
+    def listener(name, **kw):
+        if name == "/jax/compilation_cache/compile_requests_use_cache":
+            events.append(name)
+
+    monitoring.register_event_listener(listener)
+    try:
+        out = fn()
+    finally:
+        try:
+            monitoring._event_listeners.remove(listener)
+        except ValueError:  # pragma: no cover - listener wrapper changed
+            monitoring.clear_event_listeners()
+    return len(events), out
+
+
+LADDER = (1, 2, 4, 8, 16)
+
+
+def test_armed_envelope_warm_serves_every_ladder_shape_zero_cold(
+        monkeypatch):
+    """THE acceptance pin: with the serving envelope armed
+    (``KEYSTONE_SLO_MS``), `GraphExecutor._warm_plan` widens AOT warmup
+    to every pad-ladder shape the envelope can produce, so warm apply
+    at EVERY in-envelope shape performs 0 cold compiles — and matches
+    the batch path datum-for-datum."""
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.workflow.executor import drain_warmups
+
+    monkeypatch.setenv("KEYSTONE_SLO_MS", "1000")
+    monkeypatch.setenv("KEYSTONE_SERVING_MAX_BATCH", str(max(LADDER)))
+    fitted, X = _fit_small_predictor()
+    batch_ref = np.asarray(fitted.apply(Dataset.from_numpy(X)).numpy())
+
+    # one warm apply triggers the executor's warm scan (ladder-widened
+    # by the armed envelope); drain so the background compiles land
+    np.asarray(fitted.apply(Dataset.from_numpy(X[:1])).numpy())
+    drain_warmups()
+
+    def serve():
+        return [
+            np.asarray(fitted.apply(Dataset.from_numpy(X[:b])).numpy())
+            for b in LADDER
+        ]
+
+    n_compiles, preds = _compile_events(serve)
+    assert n_compiles == 0, (
+        f"warm serving at the envelope's ladder shapes {LADDER} "
+        f"performed {n_compiles} cold compile(s) — the KP902 coverage "
+        "claim (0 cold compiles at ANY in-envelope shape) is broken")
+    for b, p in zip(LADDER, preds):
+        assert (p == batch_ref[:b]).all()
+
+
+def test_warm_manifest_drives_ladder_warmup_without_env(monkeypatch):
+    """The serving runtime's explicit pre-traffic warm step:
+    `warmup_manifest()` fed to `GraphExecutor.warm_manifest` covers the
+    whole ladder with NO env arming — the manifest is the contract."""
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.workflow import GraphExecutor
+    from keystone_tpu.workflow.executor import drain_warmups
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    fitted, X = _fit_small_predictor()
+    dim = X.shape[1]
+    manifest = warmup_manifest(
+        fitted.graph, {fitted.source: as_source_spec((dim,))},
+        envelope=ServingEnvelope(max_batch=max(LADDER)))
+    assert manifest
+
+    # an executor over the bound fitted graph (the serving process)
+    g, nid = fitted.graph.add_node(
+        DatasetOperator(Dataset.from_numpy(X[:1])), [])
+    g = g.replace_dependency(fitted.source, nid).remove_source(
+        fitted.source)
+    executor = GraphExecutor(g, optimize=False)
+    submitted = executor.warm_manifest(manifest)
+    assert submitted >= 1
+    drain_warmups()
+
+    def serve():
+        for b in LADDER:
+            np.asarray(fitted.apply(Dataset.from_numpy(X[:b])).numpy())
+
+    n_compiles, _ = _compile_events(serve)
+    assert n_compiles == 0, (
+        f"manifest-driven warmup left {n_compiles} cold compile(s) "
+        f"across ladder shapes {LADDER}")
+
+
+def test_executor_embeds_certificate_in_trace_metadata(monkeypatch):
+    """KP903's trace half: with the envelope armed, the apply executor
+    embeds ``keystone.serving`` so `reconcile_serving` has a predicted
+    side."""
+    from keystone_tpu.data.dataset import Dataset
+    from keystone_tpu.telemetry import trace_run
+    from keystone_tpu.telemetry.export import to_chrome_trace
+
+    monkeypatch.setenv("KEYSTONE_SLO_MS", "1000")
+    monkeypatch.setenv("KEYSTONE_SERVING_MAX_BATCH", "4")
+    fitted, X = _fit_small_predictor()
+    with trace_run() as tracer:
+        np.asarray(fitted.apply(Dataset.from_numpy(X[:2])).numpy())
+    trace = to_chrome_trace(tracer)
+    cert = trace["keystone"].get("serving")
+    assert cert is not None
+    assert cert["slo_seconds"] == 1.0
+    assert [s["batch"] for s in cert["shapes"]] == [1, 2, 4]
+    assert all(s["predicted_seconds"] > 0 for s in cert["shapes"])
+
+
+# ------------------------------------------------------- the reconcile
+
+
+def _trace_with(cert_shapes, observed):
+    return {"keystone": {
+        "serving": {"shapes": cert_shapes, "slo_seconds": 1.0,
+                    "certified": True, "dominating_stage": "Stage"},
+        "serving_observed": observed,
+    }}
+
+
+def test_reconcile_serving_joins_on_the_padded_shape():
+    from keystone_tpu.analysis.reconcile import (
+        format_serving_reconciliation,
+        reconcile_serving,
+    )
+
+    trace = _trace_with(
+        [{"batch": 1, "predicted_seconds": 0.010, "machine_seconds": 1e-4},
+         {"batch": 4, "predicted_seconds": 0.020, "machine_seconds": 2e-4}],
+        [{"batch": 1, "chunk_shape": 1, "p50_ms": 6.0, "p99_ms": 9.0},
+         # a batch-3 request coalesces onto the 4-rung: joins there
+         {"batch": 3, "chunk_shape": 4, "p50_ms": 8.0},
+         {"batch": 9, "chunk_shape": 16, "p50_ms": 9.0}])  # unjoined
+    rec = reconcile_serving(trace)
+    assert rec["shapes_joined"] == 2
+    assert rec["violations"] == 0 and rec["bound_holds"] is True
+    by_batch = {r["batch"]: r for r in rec["rows"]}
+    assert by_batch[3]["predicted_bound_seconds"] == 0.020
+    assert by_batch[3]["residual_seconds"] == pytest.approx(0.012)
+    assert by_batch[9]["holds"] is None
+    text = format_serving_reconciliation(rec)
+    assert "holds" in text and "unjoined" in text
+
+
+def test_reconcile_serving_flags_violations_and_degrades():
+    from keystone_tpu.analysis.reconcile import (
+        format_serving_reconciliation,
+        reconcile_serving,
+    )
+
+    trace = _trace_with(
+        [{"batch": 2, "predicted_seconds": 0.004, "machine_seconds": 1e-4}],
+        [{"batch": 2, "chunk_shape": 2, "p50_ms": 11.0}])
+    rec = reconcile_serving(trace)
+    assert rec["bound_holds"] is False and rec["violations"] == 1
+    assert rec["rows"][0]["residual_seconds"] < 0
+    assert "VIOLATED" in format_serving_reconciliation(rec)
+
+    empty = reconcile_serving({"keystone": {}})
+    assert empty["rows"] == [] and empty["bound_holds"] is None
+    assert "no joined shapes" in format_serving_reconciliation(empty)
