@@ -52,6 +52,7 @@ from .sharding import (
     per_device_pass,
     sharding_pass,
 )
+from .plan_ir import UnifiedPlan, plan_unified
 from .planner import ShardingPlan, plan_sharding
 from .roofline import (
     Machine,
@@ -224,6 +225,7 @@ __all__ = [
     "Severity",
     "ShardedValue",
     "ShardingPlan",
+    "UnifiedPlan",
     "ShardingResult",
     "SpecDataset",
     "SpecMismatchError",
@@ -246,6 +248,7 @@ __all__ = [
     "per_device_pass",
     "plan_precision",
     "plan_sharding",
+    "plan_unified",
     "precision_pass",
     "PrecisionPlan",
     "reprice_memory",

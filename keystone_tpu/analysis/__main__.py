@@ -13,6 +13,8 @@ registry.
     python -m keystone_tpu.analysis --explain-precision --json
     python -m keystone_tpu.analysis --explain-roofline  # per-stage flops/bytes
     python -m keystone_tpu.analysis --explain-roofline --json
+    python -m keystone_tpu.analysis --explain-unified   # joint decision IR
+    python -m keystone_tpu.analysis --explain-unified --json --mesh-shape 2x4
     python -m keystone_tpu.analysis --certify-serving   # KP9xx serving gate
     python -m keystone_tpu.analysis --certify-serving --slo-ms 1500 --json
     python -m keystone_tpu.analysis --list-rules
@@ -50,6 +52,20 @@ bytes/peak_bw)``); KP801 Pallas-candidate chains are listed with their
 priced fusion speedup. Exit code 1 only on ERROR-severity findings (the
 KP8xx tier is advisory — candidates and re-pricings are INFO/WARNING)
 or a failed example build.
+
+``--explain-unified`` runs the unified plan optimizer
+(analysis/plan_ir.py) per example: one decision IR spanning {placement
+family × storage dtype × chunk size × cache point} per stage boundary,
+solved jointly in predicted seconds (roofline stage costs +
+collective-cost seconds at family flips + per-trip dispatch floors,
+recomputation-weighted under chosen cache points) against the
+sequential PR-13 composition scored by the same function. Findings are
+linted UNDER the chosen plan (KP6xx against the joint placement, KP7xx
+against the joint dtypes, KP8xx errors at the chosen chunk). Exit code
+1 when a joint plan prices worse than the sequential composition (an
+invariant re-assertion — `plan_unified` clamps non-strict wins) or any
+WARNING/ERROR finding survives. ``--trace-artifact`` recalibrates the
+time model from a live trace's observed span timings.
 
 ``--plan`` (with ``--explain-sharding``) additionally runs the sharding
 planner (analysis/planner.py) per example: the rendered table compares
@@ -443,6 +459,158 @@ def _explain_roofline_main(args) -> int:
     return 1 if failed else 0
 
 
+def _explain_unified_main(args) -> int:
+    """Per-example unified-plan explanation (the joint-decision gate):
+    run the unified plan optimizer (`analysis.plan_ir`) over each
+    example's stage graph — placement × dtype × chunk × cache solved
+    jointly in predicted seconds — and render joint-vs-sequential
+    scores, the chosen axes, and the findings UNDER the chosen plan:
+    KP6xx linted against the joint placement, KP7xx against the joint
+    dtype policies, KP8xx roofline errors at the chosen chunk. Exit 1
+    when any example's joint plan prices WORSE than the sequential
+    composition (the ≤ invariant is re-asserted so a solver regression
+    fails the audit) or any unsuppressed WARNING/ERROR finding remains
+    under a chosen plan. ``--trace-artifact <path>`` recalibrates the
+    time model's peaks from a live trace
+    (`reconcile.drift_cost_weights`)."""
+    from contextlib import nullcontext
+
+    from ..parallel import mesh as meshlib
+    from ..workflow.env import execution_config
+    from . import as_source_spec
+    from .memory import memory_pass
+    from .plan_ir import format_plan, plan_unified
+    from .precision import precision_pass
+    from .propagate import spec_pass
+    from .roofline import roofline_pass
+    from .sharding import per_device_pass, sharding_pass
+
+    names = args.examples or sorted(EXAMPLES)
+    unknown = [n for n in names if n not in EXAMPLES]
+    if unknown:
+        print(f"unknown example(s): {', '.join(unknown)}; "
+              f"known: {', '.join(sorted(EXAMPLES))}", file=sys.stderr)
+        return 2
+    try:
+        forced_mesh = _parse_mesh_shape(args.mesh_shape)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    weights = None
+    if getattr(args, "trace_artifact", None):
+        import json as _json
+
+        from .reconcile import drift_cost_weights
+
+        with open(args.trace_artifact) as f:
+            weights = drift_cost_weights(_json.load(f))
+    mesh_ctx = (meshlib.use_mesh(forced_mesh) if forced_mesh is not None
+                else nullcontext())
+    budget = (int(args.hbm_budget_gb * (1 << 30))
+              if args.hbm_budget_gb else execution_config().hbm_budget_bytes)
+
+    failed = False
+    records = []
+    with mesh_ctx:
+        mesh = meshlib.current_mesh()
+        for name in names:
+            try:
+                pipeline, source_spec = build_example(name)
+                graph = pipeline.graph
+                specs, _ = spec_pass(
+                    graph, {pipeline.source: as_source_spec(source_spec)})
+                uplan = plan_unified(
+                    graph, specs, mesh=mesh, hbm_budget_bytes=budget,
+                    weights=weights)
+                diags = []
+                if uplan is not None:
+                    plan_choices = (uplan.sharding.choices
+                                    if uplan.sharding else None)
+                    shardings, s_diags, _ = sharding_pass(
+                        graph, specs, mesh=mesh, plan=plan_choices)
+                    # the memory gate prices the CHOSEN chunk, not the
+                    # config default — the enforced chunking is what
+                    # the per-device budget must hold under
+                    est, _ = memory_pass(graph, specs,
+                                         chunk_rows=uplan.chunk_size)
+                    _, pd_diags = per_device_pass(
+                        graph, specs, shardings, est, mesh=mesh,
+                        hbm_budget_bytes=budget)
+                    diags.extend(s_diags)
+                    diags.extend(pd_diags)
+                    if uplan.boundary_precision is not None:
+                        diags.extend(precision_pass(
+                            graph, specs, uplan.boundary_precision))
+                    _, r_diags = roofline_pass(
+                        graph, specs, chunk_rows=uplan.chunk_size)
+                    diags.extend(d for d in r_diags
+                                 if d.severity >= Severity.ERROR)
+                diags = [d for d in diags
+                         if d.rule not in set(args.ignore)]
+                gate = [d for d in diags
+                        if d.severity >= Severity.WARNING]
+            except Exception as e:  # a factory bug is a failure
+                if args.json:
+                    records.append({"example": name, "build_error":
+                                    f"{type(e).__name__}: {e}"})
+                else:
+                    print(f"✗ {name}: failed to build/explain: "
+                          f"{type(e).__name__}: {e}")
+                failed = True
+                continue
+            # the ≤ invariant, re-asserted: plan_unified clamps any
+            # non-strict win to the sequential composition, so `over`
+            # only fires when that clamp regresses
+            over = (uplan is not None
+                    and uplan.joint_seconds > uplan.sequential_seconds)
+            failed |= bool(gate) or over
+            if args.json:
+                rec = {"example": name, "findings": [
+                    {"rule": d.rule, "severity": d.severity.name,
+                     "anchor": d.anchor, "message": d.message}
+                    for d in diags
+                ]}
+                if uplan is not None:
+                    rec["planner"] = {
+                        "joint_seconds": uplan.joint_seconds,
+                        "sequential_seconds": uplan.sequential_seconds,
+                        "savings_seconds": uplan.savings_seconds,
+                        "improved": uplan.improved,
+                        "chunk_size": uplan.chunk_size,
+                        "sequential_chunk_size": uplan.default_chunk_size,
+                        "cache_points": [v.id for v in
+                                         uplan.cache_vertices],
+                        "changed_kinds": uplan.changed_kinds(),
+                        "unpriced_stages": uplan.unpriced_stages,
+                        "stages": uplan.rows(graph),
+                        "scored_candidates": uplan.scored_candidates,
+                    }
+                else:
+                    rec["planner"] = None  # nothing to decide
+                records.append(rec)
+            else:
+                mark = "✗" if (gate or over) else "✓"
+                if uplan is None:
+                    print(f"{mark} {name}: nothing to decide (no priced "
+                          "stage / no axis with more than one entry)")
+                    continue
+                print(f"{mark} {name}:")
+                print("  " + format_plan(uplan, graph)
+                      .replace("\n", "\n  "))
+                if uplan.unpriced_stages:
+                    print(f"  ({uplan.unpriced_stages} stage(s) "
+                          "unpriced — excluded from both sides)")
+                for d in diags:
+                    if d.severity >= Severity.WARNING or args.strict:
+                        print(f"    {d}")
+    if args.json:
+        print(json.dumps({
+            "devices": int(mesh.devices.size),
+            "examples": records,
+        }, indent=2))
+    return 1 if failed else 0
+
+
 def _certify_serving_main(args) -> int:
     """Per-example serving-readiness certification (KP9xx gate): price
     every example's apply path against a declared envelope (batch
@@ -573,6 +741,19 @@ def main(argv=None) -> int:
                         "intensity / bound / predicted-seconds table "
                         "plus the KP801 Pallas-candidate chains; fail "
                         "only on ERROR-severity KP8xx findings")
+    p.add_argument("--explain-unified", action="store_true",
+                   help="run the unified plan optimizer per example "
+                        "(placement x dtype x chunk x cache solved "
+                        "jointly in predicted seconds) and render "
+                        "joint-vs-sequential scores with findings "
+                        "linted UNDER the chosen plan; fail when the "
+                        "joint plan prices worse than the sequential "
+                        "composition or any WARNING/ERROR "
+                        "KP6xx/KP7xx/KP8xx finding remains")
+    p.add_argument("--trace-artifact", default=None, metavar="TRACE",
+                   help="with --explain-unified: recalibrate the time "
+                        "model's peaks from this trace's observed span "
+                        "timings (reconcile.drift_cost_weights)")
     p.add_argument("--certify-serving", action="store_true",
                    help="run the KP9xx serving-readiness certifier per "
                         "example (per-shape latency bounds vs the SLO, "
@@ -619,6 +800,9 @@ def main(argv=None) -> int:
 
     if args.explain_roofline:
         return _explain_roofline_main(args)
+
+    if args.explain_unified:
+        return _explain_unified_main(args)
 
     if args.certify_serving:
         return _certify_serving_main(args)

@@ -35,14 +35,16 @@ DEFAULT_CHUNK_ROWS = 256
 
 
 def resolve_chunk_rows(chunk_rows: Optional[int]) -> int:
-    """An explicit ``chunk_rows`` wins; None reads the execution
-    config's ``chunk_size`` — one number for the runtime dispatcher and
-    the static memory model."""
+    """An explicit ``chunk_rows`` wins; None reads the shared
+    resolution (`workflow.env.resolved_chunk_size`: the unified
+    planner's enforced chunk decision when one is live, else the
+    execution config's ``chunk_size``) — one number for the runtime
+    dispatcher and the static memory model."""
     if chunk_rows is not None:
         return chunk_rows
-    from ..workflow.env import execution_config
+    from ..workflow.env import resolved_chunk_size
 
-    return execution_config().chunk_size
+    return resolved_chunk_size()
 
 
 def _fmt_bytes(n: Optional[int]) -> str:

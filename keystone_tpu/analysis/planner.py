@@ -190,47 +190,55 @@ def _effective_input_family(v_fam: str, u_spec, mesh) -> str:
     return FAMILY_DATA if v_fam == FAMILY_DATA_MODEL else FAMILY_REPLICATED
 
 
+def transition_cost(u_fam: Optional[str], v_fam: Optional[str],
+                    nbytes: Optional[int], mesh, u_spec=None):
+    """The `CollectiveCost` of relaying a producer's output from its
+    family to the layout the consumer's family implies for it
+    (`_effective_input_family`), or None when the boundary is free. A
+    matching layout — and anything leaving a replicated producer, which
+    every device already holds whole — is free; gathering into full
+    replication is an all-gather; everything else is an all-to-all of
+    the boundary bytes (`parallel.mesh.collective_cost`, the KP601
+    formula). The byte planner reads ``.bytes_moved`` and the unified
+    seconds model reads ``.seconds`` off the SAME object, so the two
+    cost views can never diverge."""
+    if u_fam is None or v_fam is None or not nbytes:
+        return None
+    eff = _effective_input_family(v_fam, u_spec, mesh)
+    if u_fam == eff:
+        return None
+    if u_fam == FAMILY_REPLICATED:
+        return None  # local slicing: each device holds the full value
+    if eff == FAMILY_REPLICATED:
+        return meshlib.collective_cost(
+            "all_gather", nbytes, shards=family_shards(u_fam, mesh),
+            mesh=mesh)
+    return meshlib.collective_cost(
+        "all_to_all", nbytes,
+        shards=max(family_shards(u_fam, mesh),
+                   family_shards(eff, mesh)),
+        mesh=mesh)
+
+
 def _transition_bytes(u_fam: Optional[str], v_fam: Optional[str],
                       nbytes: Optional[int], mesh,
                       u_spec=None) -> float:
-    """Priced bytes of relaying a producer's output from its family to
-    the layout the consumer's family implies for it
-    (`_effective_input_family`). A matching layout — and anything
-    leaving a replicated producer, which every device already holds
-    whole — is free; gathering into full replication is an all-gather;
-    everything else is an all-to-all of the boundary bytes
-    (`parallel.mesh.collective_cost`, the KP601 formula). Pure
-    collective bytes — the per-reshard penalty is an OBJECTIVE term
-    only (`_with_penalty`), never reported as bytes."""
-    if u_fam is None or v_fam is None or not nbytes:
-        return 0.0
-    eff = _effective_input_family(v_fam, u_spec, mesh)
-    if u_fam == eff:
-        return 0.0
-    if u_fam == FAMILY_REPLICATED:
-        return 0.0  # local slicing: each device holds the full value
-    if eff == FAMILY_REPLICATED:
-        cost = meshlib.collective_cost(
-            "all_gather", nbytes, shards=family_shards(u_fam, mesh),
-            mesh=mesh)
-    else:
-        cost = meshlib.collective_cost(
-            "all_to_all", nbytes,
-            shards=max(family_shards(u_fam, mesh),
-                       family_shards(eff, mesh)),
-            mesh=mesh)
-    return float(cost.bytes_moved)
+    """Pure collective bytes of `transition_cost` — the per-reshard
+    penalty is an OBJECTIVE term only (`_with_penalty`), never reported
+    as bytes."""
+    cost = transition_cost(u_fam, v_fam, nbytes, mesh, u_spec=u_spec)
+    return float(cost.bytes_moved) if cost is not None else 0.0
 
 
-def _demand_bytes(demand: Optional[str], fam: Optional[str],
-                  nbytes: Optional[int], mesh) -> float:
-    """KP601's demand pricing: an `abstract_sharding` input demand unmet
-    by the producer's family. A sharding demand costs an all-to-all
-    between layouts; a replication demand gathers the whole value (the
-    lint's own convention). Pure collective bytes — see
-    `_transition_bytes` on the penalty split."""
+def demand_cost(demand: Optional[str], fam: Optional[str],
+                nbytes: Optional[int], mesh):
+    """KP601's demand pricing as a `CollectiveCost` (or None when met):
+    an `abstract_sharding` input demand unmet by the producer's family.
+    A sharding demand costs an all-to-all between layouts; a
+    replication demand gathers the whole value (the lint's own
+    convention)."""
     if demand is None or fam is None or not nbytes:
-        return 0.0
+        return None
     data = int(mesh.shape.get(meshlib.DATA_AXIS, 1))
     bad = (
         demand == DEMAND_DATA_SHARDED and data > 1
@@ -239,16 +247,22 @@ def _demand_bytes(demand: Optional[str], fam: Optional[str],
         demand == DEMAND_REPLICATED and fam != FAMILY_REPLICATED
     )
     if not bad:
-        return 0.0
+        return None
     if demand == DEMAND_REPLICATED:
-        cost = meshlib.collective_cost(
+        return meshlib.collective_cost(
             "all_gather", nbytes, shards=family_shards(fam, mesh),
             mesh=mesh)
-    else:
-        cost = meshlib.collective_cost(
-            "all_to_all", nbytes,
-            shards=max(data, family_shards(fam, mesh)), mesh=mesh)
-    return float(cost.bytes_moved)
+    return meshlib.collective_cost(
+        "all_to_all", nbytes,
+        shards=max(data, family_shards(fam, mesh)), mesh=mesh)
+
+
+def _demand_bytes(demand: Optional[str], fam: Optional[str],
+                  nbytes: Optional[int], mesh) -> float:
+    """Pure collective bytes of `demand_cost` — see `_transition_bytes`
+    on the penalty split."""
+    cost = demand_cost(demand, fam, nbytes, mesh)
+    return float(cost.bytes_moved) if cost is not None else 0.0
 
 
 def _with_penalty(move_bytes: float) -> float:
@@ -259,14 +273,18 @@ def _with_penalty(move_bytes: float) -> float:
     return move_bytes + RESHARD_PENALTY_BYTES if move_bytes else 0.0
 
 
-def _gather_bytes(fam: Optional[str], nbytes: Optional[int], mesh) -> float:
-    """KP603's pricing: a host consumer of device-sharded data
-    all-gathers every shard."""
+def gather_cost(fam: Optional[str], nbytes: Optional[int], mesh):
+    """KP603's pricing as a `CollectiveCost` (or None): a host consumer
+    of device-sharded data all-gathers every shard."""
     if fam is None or fam == FAMILY_REPLICATED or not nbytes:
-        return 0.0
-    cost = meshlib.collective_cost(
+        return None
+    return meshlib.collective_cost(
         "all_gather", nbytes, shards=family_shards(fam, mesh), mesh=mesh)
-    return float(cost.bytes_moved)
+
+
+def _gather_bytes(fam: Optional[str], nbytes: Optional[int], mesh) -> float:
+    cost = gather_cost(fam, nbytes, mesh)
+    return float(cost.bytes_moved) if cost is not None else 0.0
 
 
 class _CostModel:

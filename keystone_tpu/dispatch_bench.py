@@ -174,32 +174,43 @@ def _plan_context(plan: str):
     """(optimizer, overlap_on, concurrent_on, config_overrides) for a
     named plan. ``optimized`` pins megafusion OFF so it remains the
     PR-4/5 plan bit for bit; the historical baselines also pin the
-    sharding planner OFF (it post-dates them — PR 9) and every plan up
+    sharding planner OFF (it post-dates them — PR 9), every plan up
     to ``megafused`` pins the precision planner OFF (it post-dates them
-    — PR 10); ``precision`` is the full default stack with the
-    enforcement floor dropped so the small bench instances bake their
-    policies."""
+    — PR 10), and EVERY named plan pins the unified planner OFF (it
+    post-dates all of them — PR 15 — and the named plans are exact
+    historical reproductions; the unified planner's bench story is the
+    static joint-vs-sequential audit); ``precision`` is the full PR-13
+    sequential stack with the enforcement floor dropped so the small
+    bench instances bake their policies."""
     from .workflow.optimizer import DefaultOptimizer
 
     if plan == "serial_unfused":
         return DefaultOptimizer(fuse=False, sharding_planner=False,
-                                precision_planner=False), \
-            False, False, dict(megafusion=False, precision_planner=False)
+                                precision_planner=False,
+                                unified_planner=False), \
+            False, False, dict(megafusion=False, precision_planner=False,
+                               unified_planner=False)
     if plan == "legacy":
         return DefaultOptimizer(fuse_apply=False, sharding_planner=False,
-                                precision_planner=False), \
-            True, False, dict(megafusion=False, precision_planner=False)
+                                precision_planner=False,
+                                unified_planner=False), \
+            True, False, dict(megafusion=False, precision_planner=False,
+                              unified_planner=False)
     if plan == "optimized":
         return DefaultOptimizer(megafuse=False, sharding_planner=False,
-                                precision_planner=False), \
-            True, True, dict(megafusion=False, precision_planner=False)
+                                precision_planner=False,
+                                unified_planner=False), \
+            True, True, dict(megafusion=False, precision_planner=False,
+                             unified_planner=False)
     if plan == "megafused":
-        return DefaultOptimizer(precision_planner=False), True, True, \
-            dict(megafusion=True, precision_planner=False)
+        return DefaultOptimizer(precision_planner=False,
+                                unified_planner=False), True, True, \
+            dict(megafusion=True, precision_planner=False,
+                 unified_planner=False)
     if plan == "precision":
-        return DefaultOptimizer(), True, True, \
+        return DefaultOptimizer(unified_planner=False), True, True, \
             dict(megafusion=True, precision_planner=True,
-                 precision_min_savings_bytes=0)
+                 precision_min_savings_bytes=0, unified_planner=False)
     raise ValueError(f"unknown plan {plan!r}; expected one of {PLANS}")
 
 

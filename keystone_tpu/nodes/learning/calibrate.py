@@ -169,6 +169,32 @@ def default_weights() -> CostWeights:
                        cost_model.NETWORK_WEIGHT)
 
 
+def write_calibration(path: str, weights: CostWeights,
+                      provenance: "dict | None" = None) -> dict:
+    """Persist a `CostWeights` in the ``tpu_calibration.json`` schema —
+    the same file format `cost_model._resolve_weights` loads, so a
+    trace-recalibrated suggestion (`reconcile.drift_cost_weights`)
+    round-trips: emit it here, point ``KEYSTONE_COST_CALIBRATION`` at
+    the file, and `machine_rates()` prefers it whenever the recorded
+    platform matches the live backend. Returns the written payload."""
+    import json
+
+    prov = {"platform": cost_model._live_platform_no_init()}
+    prov.update(provenance or {})
+    payload = {
+        "cpu_weight": float(weights.cpu_weight),
+        "mem_weight": float(weights.mem_weight),
+        "network_weight": float(weights.network_weight),
+        "peak_flops": float(weights.peak_flops),
+        "peak_bw": float(weights.peak_bw),
+        "provenance": prov,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return payload
+
+
 #: Honest CPU-backend analytic peaks, used when no measured calibration
 #: applies and the live platform is the CPU backend: an order-of-
 #: magnitude model of a few-core AVX host (~50 GFLOP/s sustained,

@@ -89,7 +89,13 @@ def _resolve_weights():
     model on CPU dev boxes or other TPU generations.
 
     KEYSTONE_COST_CALIBRATION=analytic ignores the file entirely;
-    KEYSTONE_COST_CALIBRATION=force applies it regardless of platform.
+    KEYSTONE_COST_CALIBRATION=force applies it regardless of platform;
+    Any other KEYSTONE_COST_CALIBRATION value is a calibration file
+    PATH read instead of the committed one (same schema, platform
+    check still applies; a missing path warns and falls back to
+    analytic) — the round-trip seam for trace-recalibrated weights
+    emitted by ``python -m keystone_tpu.telemetry --ledger <run>
+    --emit-calibration <path>``.
     Resolution is lazy (first weight access) AND never initializes a JAX
     backend: the platform check consults only an already-initialized
     backend or the configured platform setting (_live_platform_no_init).
@@ -110,7 +116,16 @@ def _resolve_weights():
     if mode == "analytic":
         _weights_cache = (cache_key, _ANALYTIC)
         return _ANALYTIC
-    path = os.path.join(os.path.dirname(__file__), "tpu_calibration.json")
+    if mode not in ("", "force"):
+        # any value other than the keywords ("analytic" returned above,
+        # "force", empty) IS a calibration file path — a bare filename
+        # must not silently fall back to the committed file while the
+        # user believes recalibration is active (a missing path warns
+        # in the FileNotFoundError branch below)
+        path = mode
+    else:
+        path = os.path.join(os.path.dirname(__file__),
+                            "tpu_calibration.json")
     log = logging.getLogger(__name__)
     try:
         with open(path) as f:
@@ -123,6 +138,13 @@ def _resolve_weights():
         prov = cal.get("provenance")
         cal_platform = prov.get("platform") if isinstance(prov, dict) else None
     except FileNotFoundError:
+        if path == mode:
+            # an explicitly pointed-at calibration file that does not
+            # exist is a user error, not the quiet no-committed-file
+            # default — say so instead of silently going analytic
+            log.warning(
+                "KEYSTONE_COST_CALIBRATION=%s does not exist; "
+                "falling back to analytic weights", path)
         _weights_cache = (cache_key, _ANALYTIC)
         return _ANALYTIC
     except (OSError, KeyError, ValueError, TypeError, AttributeError) as e:

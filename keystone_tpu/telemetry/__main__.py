@@ -2,6 +2,7 @@
 
     python -m keystone_tpu.telemetry run.json [--top N] [--json]
     python -m keystone_tpu.telemetry --ledger <run> [--json]
+    python -m keystone_tpu.telemetry --ledger <run> --emit-calibration <path>
     python -m keystone_tpu.telemetry --diff <run_a> <run_b> [--json]
 
 The trace form prints the span digest (top nodes by self-time, solver
@@ -16,6 +17,15 @@ optimizer decision — chosen entry, best-priced runner-up, predicted
 cost — joined, when the run's trace is reachable, with the observed
 values and residuals (`analysis.reconcile.reconcile_decisions`) plus
 the cost-model drift report (`cost_model_drift`).
+
+``--emit-calibration`` (with ``--ledger``) closes the
+trace-bytes-in/plan-out loop: the run's cost-model drift report is
+persisted as a ``tpu_calibration.json``-schema file
+(`reconcile.drift_cost_weights` → `calibrate.write_calibration`), and
+pointing ``KEYSTONE_COST_CALIBRATION`` at it makes
+`calibrate.machine_rates()` — hence every roofline classification and
+every unified-planner menu price — prefer the trace-implied rates
+whenever the recorded platform matches the live backend.
 
 ``--diff`` is run-over-run regression detection between two runs'
 ledgers: config kill-switch flips are named by env var (an injected
@@ -57,12 +67,47 @@ def _reconcile(run):
         return None
 
 
-def _ledger_main(path: str, as_json: bool) -> int:
+def _emit_calibration(run, out_path: str, ledger_path: str) -> int:
+    """Persist the run's drift-implied `CostWeights` in the
+    ``tpu_calibration.json`` schema (the `machine_rates` round-trip)."""
+    if not run.get("trace"):
+        print("error: --emit-calibration needs a run whose trace "
+              "artifact is reachable (the drift report is computed "
+              "from observed span timings)", file=sys.stderr)
+        return 2
+    from ..analysis.reconcile import drift_cost_weights
+    from ..nodes.learning.calibrate import write_calibration
+
+    weights = drift_cost_weights(run["trace"])
+    provenance = {"source": "drift_cost_weights", "ledger": ledger_path}
+    # the weights are implied by the TRACED run's measurements: its
+    # recorded platform owns the provenance — emitting from a
+    # different host must not relabel TPU-implied weights as CPU ones
+    run_platform = (run.get("header") or {}).get("platform")
+    assumed = ""
+    if run_platform:
+        provenance["platform"] = run_platform
+    else:
+        assumed = (" [platform assumed from THIS host — the run's "
+                   "ledger predates the header platform field]")
+    payload = write_calibration(out_path, weights, provenance=provenance)
+    print(f"wrote {out_path}: cpu_weight={payload['cpu_weight']:.3e} "
+          f"mem_weight={payload['mem_weight']:.3e} "
+          f"(platform={payload['provenance'].get('platform')}{assumed}); "
+          "point KEYSTONE_COST_CALIBRATION at it to recalibrate "
+          "machine_rates()")
+    return 0
+
+
+def _ledger_main(path: str, as_json: bool,
+                 emit_calibration: str = None) -> int:
     from .ledger import render_ledger
 
     run = _read_run(path)
     if run is None:
         return 2
+    if emit_calibration:
+        return _emit_calibration(run, emit_calibration, path)
     rec = _reconcile(run)
     drift = None
     if run.get("trace"):
@@ -132,11 +177,20 @@ def main(argv=None) -> int:
     p.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
                    help="run-over-run regression detection between two "
                         "runs' ledgers (exit 1 on any regression)")
+    p.add_argument("--emit-calibration", metavar="PATH",
+                   help="with --ledger: persist the run's drift-implied "
+                        "cost weights as a tpu_calibration.json-schema "
+                        "file; KEYSTONE_COST_CALIBRATION=<PATH> then "
+                        "recalibrates machine_rates() when the platform "
+                        "matches")
     args = p.parse_args(argv)
+    if args.emit_calibration and not args.ledger:
+        p.error("--emit-calibration requires --ledger")
     if args.diff:
         return _diff_main(args.diff[0], args.diff[1], args.as_json)
     if args.ledger:
-        return _ledger_main(args.ledger, args.as_json)
+        return _ledger_main(args.ledger, args.as_json,
+                            emit_calibration=args.emit_calibration)
     if not args.trace:
         p.error("a trace path, --ledger, or --diff is required")
     try:

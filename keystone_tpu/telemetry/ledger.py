@@ -61,7 +61,8 @@ from typing import Any, Dict, List, Optional, Tuple
 LEDGER_VERSION = 1
 
 #: decision kinds the optimizer rules emit.
-KINDS = ("fusion", "megafusion", "placement", "precision")
+KINDS = ("fusion", "megafusion", "placement", "precision", "chunk",
+         "cache")
 
 #: the config fields a run header snapshots, with the env var that
 #: flips each — the channel by which ``--diff`` names a kill-switch
@@ -71,6 +72,7 @@ CONFIG_ENV = {
     "megafusion": "KEYSTONE_MEGAFUSION",
     "sharding_planner": "KEYSTONE_SHARDING_PLANNER",
     "precision_planner": "KEYSTONE_PRECISION_PLANNER",
+    "unified_planner": "KEYSTONE_UNIFIED_PLANNER",
     "concurrent_dispatch": "KEYSTONE_CONCURRENT_DISPATCH",
     "pad_chunks": "KEYSTONE_PAD_CHUNKS",
     "aot_warmup": "KEYSTONE_AOT_WARMUP",
@@ -148,6 +150,7 @@ def run_header() -> Dict[str, Any]:
     names — the diff channel for kill-switch flips."""
     config: Dict[str, Any] = {}
     trace_path = None
+    platform = None
     try:
         from ..workflow.env import execution_config
 
@@ -157,11 +160,22 @@ def run_header() -> Dict[str, Any]:
             config[field] = bool(getattr(cfg, field, False))
     except Exception:
         pass
+    try:
+        # the platform the run's measurements were taken on — what a
+        # later --emit-calibration must stamp into provenance (emitting
+        # from a different host must not relabel TPU-implied weights
+        # as CPU ones). Never initializes a backend.
+        from ..nodes.learning.cost_model import _live_platform_no_init
+
+        platform = _live_platform_no_init()
+    except Exception:
+        pass
     return {
         "ledger_version": LEDGER_VERSION,
         "pid": os.getpid(),
         "wall_epoch": time.time(),  # keystone: ignore[KJ004] — wall-clock anchor, not a duration
         "trace_path": trace_path,
+        "platform": platform,
         "config": config,
         "config_env": dict(CONFIG_ENV),
     }
@@ -590,28 +604,33 @@ def _stable_config(run: Dict[str, Any]) -> Dict[str, Any]:
     return stable
 
 
-#: which config kill-switch FIELD owns which decision kind — how a
+#: which config kill-switch FIELDS own which decision kind — how a
 #: removed decision is attributed to the flip that removed it (fusion
 #: has no env switch of its own: only the optimizer construction
-#: changes it).
-_KIND_FIELD = {
-    "megafusion": "megafusion",
-    "placement": "sharding_planner",
-    "precision": "precision_planner",
+#: changes it). Placement and precision decisions have TWO possible
+#: owners since PR 15: the sequential rule's own switch, and the
+#: unified planner that enforces the same kinds jointly when it wins.
+_KIND_FIELDS = {
+    "megafusion": ("megafusion",),
+    "placement": ("sharding_planner", "unified_planner"),
+    "precision": ("precision_planner", "unified_planner"),
+    "chunk": ("unified_planner",),
+    "cache": ("unified_planner",),
 }
 
 
 def _suspect_env(kind: str, config_flips: List[Dict]) -> Optional[str]:
-    """The kill switch to blame for a removed decision — only when the
+    """The kill switch to blame for a removed decision — only when an
     owning config field ACTUALLY flipped between the runs; a decision
     that vanished under identical config (pipeline edit, savings floor)
     names no suspect."""
-    field = _KIND_FIELD.get(kind)
-    if field is None:
+    fields = _KIND_FIELDS.get(kind)
+    if not fields:
         return None
-    for flip in config_flips:
-        if flip.get("field") == field:
-            return flip.get("env", field)
+    for field in fields:
+        for flip in config_flips:
+            if flip.get("field") == field:
+                return flip.get("env", field)
     return None
 
 

@@ -490,9 +490,12 @@ USE_CONFIG_CHUNK = object()
 
 def _resolve_chunk(chunk):
     if chunk is USE_CONFIG_CHUNK:
-        from ..workflow.env import execution_config
+        # the shared resolution: the unified planner's enforced chunk
+        # decision when one is live, else ExecutionConfig.chunk_size —
+        # the dispatcher and the KP2xx memory model read the same one
+        from ..workflow.env import resolved_chunk_size
 
-        return execution_config().chunk_size
+        return resolved_chunk_size()
     return chunk
 
 

@@ -273,6 +273,31 @@ class AutoCacheRule(Rule):
         sd = {s: (cache_id if d == node else d) for s, d in g.sink_dependencies.items()}
         return Graph(g.sources, sd, g.operators, dd)
 
+    @staticmethod
+    def _record_cache_decision(graph: Graph, node: NodeId, chosen: Dict,
+                               alternatives: List[Dict],
+                               predicted: Dict) -> None:
+        """One ledger record per cache-placement choice (kind=``cache``)
+        so cache points are auditable like every other optimizer
+        decision — the greedy loop's own scored menu rides along as the
+        priced alternatives. Never raises: a ledger bug must not break
+        the caching it records."""
+        try:
+            from ..telemetry import ledger
+
+            ledger.record_decision(
+                kind="cache",
+                rule="AutoCacheRule",
+                vertices=[node.id],
+                labels=[graph.get_operator(node).label],
+                chosen=chosen,
+                alternatives=alternatives or [{"entry": "no_cache",
+                                               "saving_ns": 0.0}],
+                predicted=predicted,
+            )
+        except Exception:
+            logger.debug("cache decision not recorded", exc_info=True)
+
     def apply(self, plan: Plan) -> Plan:
         graph, prefixes = plan
         candidates = self._candidates(graph)
@@ -280,7 +305,15 @@ class AutoCacheRule(Rule):
             return plan
 
         if self.strategy == "aggressive":
+            runs = get_runs(graph, set())
             for n in sorted(candidates, key=lambda n: -n.id):
+                self._record_cache_decision(
+                    graph, n,
+                    chosen={"entry": "cache", "strategy": "aggressive",
+                            "runs_collapsed": runs.get(n, 1)},
+                    alternatives=[{"entry": "no_cache",
+                                   "runs": runs.get(n, 1)}],
+                    predicted={"runs_collapsed": runs.get(n, 1)})
                 graph = self._insert_cache(graph, n)
             return graph, prefixes
 
@@ -288,9 +321,14 @@ class AutoCacheRule(Rule):
         budget = self._budget()
         cached: set = set()
         used = 0.0
+        #: node -> the scored menu of the greedy iteration that chose it
+        chosen_menus: Dict[NodeId, List[Dict]] = {}
+        #: node -> its own predicted marginal saving at selection time
+        chosen_savings: Dict[NodeId, float] = {}
         while True:
             current = estimate_cached_run_time(graph, cached, profiles)
             best, best_saving = None, 0.0
+            menu: List[Dict] = []
             for n in candidates:
                 if n in cached:
                     continue
@@ -298,13 +336,31 @@ class AutoCacheRule(Rule):
                 if p is None or used + p.mem_bytes > budget:
                     continue
                 saving = current - estimate_cached_run_time(graph, cached | {n}, profiles)
+                menu.append({"entry": f"cache_{n.id}",
+                             "label": graph.get_operator(n).label,
+                             "saving_ns": float(saving),
+                             "mem_bytes": float(p.mem_bytes)})
                 if saving > best_saving:
                     best, best_saving = n, saving
             if best is None:
                 break
             cached.add(best)
             used += profiles[best].mem_bytes
+            chosen_menus[best] = [m for m in menu
+                                  if m["entry"] != f"cache_{best.id}"]
+            chosen_savings[best] = float(best_saving)
         logger.info("AutoCacheRule(greedy): caching %s", sorted(cached))
         for n in sorted(cached, key=lambda n: -n.id):
+            p = profiles[n]
+            saving = chosen_savings.get(n, 0.0)
+            self._record_cache_decision(
+                graph, n,
+                chosen={"entry": "cache", "strategy": "greedy",
+                        "saving_ns": saving,
+                        "mem_bytes": float(p.mem_bytes)},
+                alternatives=chosen_menus.get(n, []),
+                predicted={"saving_ns": saving,
+                           "mem_bytes": float(p.mem_bytes),
+                           "budget_bytes": float(budget)})
             graph = self._insert_cache(graph, n)
         return graph, prefixes

@@ -161,6 +161,26 @@ class ExecutionConfig:
     writes ``<trace_path>.ledger.jsonl`` alongside the trace artifact;
     an untraced, unarmed run keeps records in memory only (see
     `keystone_tpu.telemetry.ledger` and OBSERVABILITY.md).
+
+    ``unified_planner`` (default on; env ``KEYSTONE_UNIFIED_PLANNER=0``
+    reverts to the PR-13 sequential passes bit-for-bit) turns on the
+    unified plan optimizer: after fusion/megafusion, `UnifiedPlannerRule`
+    solves ONE decision IR spanning {placement family × storage dtype ×
+    chunk size × cache point} per stage boundary (`analysis.plan_ir`),
+    priced in seconds by the calibrated roofline time model
+    (`roofline.stage_cost` + `collective_cost` seconds at family flips)
+    under the declared HBM budget as a hard per-device constraint. When
+    the joint optimum strictly beats the sequential composition it owns
+    enforcement (placement/precision tags, the chunk override below,
+    `CacheMarker` insertion) and the sequential planner rules stand
+    down; otherwise the sequential rules run unchanged.
+
+    ``unified_min_savings_seconds`` (env
+    ``KEYSTONE_UNIFIED_MIN_SAVINGS_S``, default 5 ms) is the unified
+    planner's enforcement floor: a joint win is only enforced when its
+    predicted seconds saved clear it, so tiny pipelines (tests, smoke
+    runs) stay bit-identical to the sequential plan by construction.
+    0 enforces every strict win.
     """
 
     overlap: bool = True
@@ -178,6 +198,8 @@ class ExecutionConfig:
     precision_planner: bool = True
     precision_min_savings_bytes: int = 1 << 20
     ledger_path: Optional[str] = None
+    unified_planner: bool = True
+    unified_min_savings_seconds: float = 5e-3
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -287,6 +309,10 @@ def execution_config() -> ExecutionConfig:
             precision_min_savings_bytes=max(0, int(os.environ.get(
                 "KEYSTONE_PRECISION_MIN_SAVINGS_BYTES", str(1 << 20)))),
             ledger_path=os.environ.get("KEYSTONE_LEDGER") or None,
+            unified_planner=os.environ.get(
+                "KEYSTONE_UNIFIED_PLANNER", "1").lower() not in _OFF,
+            unified_min_savings_seconds=max(0.0, float(os.environ.get(
+                "KEYSTONE_UNIFIED_MIN_SAVINGS_S", "5e-3"))),
         )
         _sync_compile_cache(_exec_config)
     return _exec_config
@@ -298,6 +324,56 @@ def set_execution_config(config: Optional[ExecutionConfig]) -> None:
     _exec_config = config
     if config is not None:
         _sync_compile_cache(config)
+
+
+# --------------------------------------------------------------------------
+# Planned chunk size (the unified plan optimizer's chunk decision)
+
+#: the chunk size the most recently enforced unified plan chose, or
+#: None when no plan owns the knob. Process-global like the optimizer
+#: itself: the LAST optimized plan's decision is the live one, so
+#: optimizing a second pipeline re-decides (or clears) the knob for
+#: everything that dispatches afterwards — interleave two live
+#: pipelines and the later optimize wins, exactly like the process-
+#: global `PipelineEnv` optimizer. In-flight streams are safe either
+#: way: `utils.batching` resolves the chunk ONCE when a stream's plan
+#: is built, so a mid-run flip only affects new dispatches.
+_planned_chunk: Optional[int] = None
+
+
+def set_planned_chunk_size(chunk: Optional[int]) -> None:
+    """Install (or clear, with None) the unified planner's chunk
+    decision. Only `workflow.optimizer.UnifiedPlannerRule` should call
+    this at enforcement time — everything else reads the resolved value
+    through `resolved_chunk_size` (the KJ015 contract)."""
+    global _planned_chunk
+    _planned_chunk = max(1, int(chunk)) if chunk is not None else None
+
+
+def planned_chunk_size() -> Optional[int]:
+    """The unified planner's live chunk decision — None when no plan
+    owns the knob or the unified planner is switched off
+    (``KEYSTONE_UNIFIED_PLANNER=0`` must restore the config knob
+    bit-for-bit, stale overrides included)."""
+    if _planned_chunk is not None and execution_config().unified_planner:
+        return _planned_chunk
+    return None
+
+
+def resolved_chunk_size() -> int:
+    """THE chunk-size resolution: the unified planner's enforced
+    decision when one is live, else ``ExecutionConfig.chunk_size``
+    (env ``KEYSTONE_CHUNK_SIZE``). The host batcher
+    (`utils.batching`), the KP2xx memory model
+    (`analysis.memory.resolve_chunk_rows`), and the roofline's trip
+    accounting all read this one function, so the analyzer can never
+    model a different chunking than the runtime executes and the
+    planner's decision reaches both from one place (jaxlint KJ015
+    keeps ad-hoc readers out of ``nodes/``/``workflow/``)."""
+    planned = planned_chunk_size()
+    if planned is not None:
+        return planned
+    return execution_config().chunk_size
 
 
 @contextmanager
@@ -412,6 +488,9 @@ class PipelineEnv:
     @classmethod
     def reset(cls) -> None:
         cls._instance = None
+        # a fresh env must not inherit a previous pipeline's enforced
+        # chunk decision (tests and benches reset between plans)
+        set_planned_chunk_size(None)
 
 
 class IdentityKey:

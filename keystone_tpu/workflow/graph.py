@@ -65,7 +65,7 @@ class Graph:
 
     __slots__ = (
         "sources", "sinks", "operators", "dependencies", "sink_dependencies",
-        "_users_index",
+        "_users_index", "__weakref__",
     )
 
     def __init__(

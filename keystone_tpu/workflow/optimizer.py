@@ -18,6 +18,7 @@ only the saveable nodes' structural prefixes.
 from __future__ import annotations
 
 import logging
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
@@ -243,6 +244,277 @@ class NodeOptimizationRule(Rule):
         return graph, prefixes
 
 
+#: bytes of resident device-dataset data below which the unified
+#: planner's priced solve cannot clear a nonzero enforcement floor on
+#: any calibrated machine (64 KiB over even the slowest modeled
+#: bandwidth, recomputed tens of times across tens of stages, stays
+#: under a millisecond) — the cheap pre-filter that keeps tiny test
+#: pipelines from paying the jaxpr-priced solve on every optimize.
+UNIFIED_SOLVE_MIN_BYTES = 64 << 10
+
+
+#: graphs whose placement/precision axes an enforced unified plan
+#: OWNS — registered by `UnifiedPlannerRule._enforce` whenever the
+#: joint optimum deviates on a tagged axis, whether or not the
+#: deviation produced tagged operator copies (a joint plan can win by
+#: REVERTING the sequential placement to the defaults, by turning a
+#: sequential precision trail OFF, or by re-seeding only dataset
+#: placements — all tag-free shapes that must still stand the
+#: sequential rules down). Weak references: a dropped plan releases
+#: its entry.
+_UNIFIED_OWNED: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def unified_enforced(graph: Graph) -> bool:
+    """Whether this plan's placement/precision axes are owned by an
+    enforced unified plan — the signal for the sequential planner
+    rules to stand down instead of re-deciding an axis the joint
+    optimizer already decided. The ownership registry covers the
+    current optimization; the ``planned_by_unified`` tag scan
+    additionally covers re-optimizations of an already-enforced
+    graph."""
+    return graph in _UNIFIED_OWNED or any(
+        getattr(op, "planned_by_unified", False)
+        for op in graph.operators.values())
+
+
+class UnifiedPlannerRule(Rule):
+    """Unified plan optimizer: ONE decision IR over {placement family ×
+    storage dtype × chunk size × cache point} per stage boundary,
+    priced in seconds by the calibrated roofline time model and solved
+    jointly under the HBM budget as a hard constraint
+    (`analysis.plan_ir` is the pure decision core; this rule is the
+    enforcement shell).
+
+    Runs after fusion/megafusion (the program boundaries that will
+    actually execute) and before the sequential planner rules. Reads
+    ``ExecutionConfig.unified_planner`` (env
+    ``KEYSTONE_UNIFIED_PLANNER``, default on) at optimization time and
+    is a strict no-op — the sequential PR-13 passes then run unchanged
+    — on host-only plans, on any planner failure, when the joint
+    optimum cannot STRICTLY beat the sequential composition scored by
+    the same function, and when the win is below the
+    ``unified_min_savings_seconds`` enforcement floor.
+
+    Enforcement of a winning joint plan reuses the existing machinery:
+
+      - placement deviations become ``planned_out_spec`` tagged copies
+        / `Dataset.reshard` re-seeds exactly like `ShardingPlannerRule`
+        (and precision trail wins become ``planned_precision`` tagged
+        copies exactly like `PrecisionPlannerRule`); when the joint
+        plan deviates on EITHER axis it enforces BOTH itself and marks
+        the copies ``planned_by_unified`` so the sequential rules stand
+        down — one owner per axis, never two;
+      - the chunk decision flows through
+        `workflow.env.set_planned_chunk_size`, which
+        `utils.batching` and the KP2xx/KP8xx models all read back via
+        the one `resolved_chunk_size` resolution;
+      - chosen cache points insert `autocache.CacheMarker` nodes where
+        the profile-guided greedy used to.
+
+    Every enforced decision kind emits a ledger record
+    (rule=``UnifiedPlannerRule``) whose alternatives are the product
+    menu the solver actually scored, so ``--ledger``/``--diff`` and
+    `reconcile_decisions` cover the joint plan from day one.
+    """
+
+    def apply(self, plan: Plan) -> Plan:
+        from .env import execution_config, set_planned_chunk_size
+
+        cfg = execution_config()
+        if not cfg.unified_planner:
+            return plan  # kill switch: the PR-13 sequential passes
+        # every path through this rule re-decides the chunk knob: clear
+        # a previous plan's override up front so no bail-out below can
+        # leak it into an unrelated pipeline; enforcement re-sets it
+        set_planned_chunk_size(None)
+        graph, prefixes = plan
+        if not ShardingPlannerRule._has_device_dataset(graph):
+            return plan
+        if not self._worth_solving(graph, cfg):
+            return plan
+        from ..telemetry import counter, span
+
+        with span("unified_planner", cat="phase"):
+            try:
+                from ..analysis.plan_ir import plan_unified
+                from ..analysis.propagate import spec_pass
+
+                specs, _ = spec_pass(graph, {})
+                uplan = plan_unified(
+                    graph, specs,
+                    hbm_budget_bytes=cfg.hbm_budget_bytes,
+                    chunk_default=cfg.chunk_size,  # keystone: ignore[KJ015] — the planner IS the decision site: it scores the raw knob as the sequential baseline
+                    include_boundary_policies=False,
+                    precision_floor_bytes=cfg.precision_min_savings_bytes)
+            except Exception:
+                logger.debug("unified planner failed; plan unchanged",
+                             exc_info=True)
+                return plan
+            if uplan is None or not uplan.improved or \
+                    uplan.savings_seconds < cfg.unified_min_savings_seconds:
+                # strict no-op: the sequential rules (place, precision)
+                # run next and reproduce the PR-13 plan exactly
+                return plan
+            counter("planner.unified_plans_enforced").inc()
+            counter("planner.unified_seconds_saved").inc(
+                uplan.savings_seconds)
+            logger.info(
+                "UnifiedPlannerRule: enforcing joint plan, predicted "
+                "%.3es -> %.3es (%s)", uplan.sequential_seconds,
+                uplan.joint_seconds, ", ".join(uplan.changed_kinds()))
+            graph = self._enforce(graph, uplan, cfg)
+        return graph, prefixes
+
+    @staticmethod
+    def _worth_solving(graph: Graph, cfg) -> bool:
+        """Cheap pre-filter: with a nonzero enforcement floor, skip the
+        jaxpr-priced solve when the plan's resident device data is so
+        small no modeled win could clear the floor and the chunk axis
+        has no trips to save. Floor 0 (tests, explicit opt-in) always
+        solves."""
+        if cfg.unified_min_savings_seconds <= 0:
+            return True
+        device_bytes = 0
+        max_rows = 0
+        for op in graph.operators.values():
+            if isinstance(op, DatasetOperator):
+                data = getattr(op.dataset, "data", None)
+                if data is not None:
+                    import jax
+
+                    for leaf in jax.tree_util.tree_leaves(data):
+                        device_bytes += int(getattr(leaf, "nbytes", 0))
+                        shape = getattr(leaf, "shape", ())
+                        if shape:
+                            max_rows = max(max_rows, int(shape[0]))
+        return (device_bytes >= UNIFIED_SOLVE_MIN_BYTES
+                or max_rows > 4 * cfg.chunk_size)  # keystone: ignore[KJ015] — the planner's own pre-filter compares against the undecided knob
+
+    def _enforce(self, graph: Graph, uplan, cfg) -> Graph:
+        from .env import set_planned_chunk_size
+
+        kinds = uplan.changed_kinds()
+        own_tags = "placement" in kinds or "precision" in kinds
+        if own_tags:
+            # the joint plan deviates on a tagged axis: enforce BOTH
+            # tagged axes itself (sequential rules stand down via the
+            # planned_by_unified marks)
+            if uplan.sharding is not None:
+                self._record(uplan, "placement",
+                             uplan.sharding.changed_vertices(), graph)
+                graph = ShardingPlannerRule._enforce(
+                    graph, uplan.sharding, uplan.mesh, mark_unified=True)
+            for vid, decided in sorted(
+                    uplan.program_precision.items(),
+                    key=lambda kv: getattr(kv[0], "id", -1)):
+                if vid not in graph.operators:
+                    continue
+                storage, saved, menu = decided
+                op = graph.get_operator(vid)
+                import copy
+
+                new_op = copy.copy(op)
+                new_op.planned_precision = storage
+                new_op.planned_by_unified = True
+                if PrecisionPlannerRule._all_compute_tolerant(
+                        graph, vid, op):
+                    new_op.planned_matmul_precision = "bfloat16"
+                graph = graph.set_operator(vid, new_op)
+                PrecisionPlannerRule._record_decision(
+                    graph, vid, op, storage, saved, menu,
+                    rule="UnifiedPlannerRule")
+        if "chunk" in kinds:
+            self._record(uplan, "chunk", [], graph)
+            set_planned_chunk_size(uplan.chunk_size)
+        if "cache" in kinds:
+            from .autocache import AutoCacheRule
+
+            self._record(uplan, "cache", uplan.cache_vertices, graph)
+            for vid in sorted(uplan.cache_vertices,
+                              key=lambda v: -getattr(v, "id", -1)):
+                if vid in graph.operators:
+                    graph = AutoCacheRule._insert_cache(graph, vid)
+        if own_tags:
+            # ownership survives tag-free deviations (a reverted
+            # sequential placement, a trail turned off, dataset-only
+            # re-seeds): the sequential rules stand down on THIS graph
+            _UNIFIED_OWNED.add(graph)
+        return graph
+
+    @staticmethod
+    def _record(uplan, kind: str, vertices, graph: Graph) -> None:
+        """One ledger record per enforced joint decision kind: the
+        chosen entry, the product menu the solver actually scored as
+        the alternatives, and the predicted seconds in the shared time
+        model's units. Never raises."""
+        try:
+            from ..analysis.propagate import _label
+            from ..telemetry import ledger
+
+            # one (vertex, label) pair per vertex still present in the
+            # enforced graph — consumers zip the two lists
+            present = [v for v in vertices
+                       if v in getattr(graph, "operators", {})]
+            chosen = {
+                "entry": "joint_optimum",
+                "predicted_seconds": float(uplan.joint_seconds),
+                "chunk_size": int(uplan.chunk_size),
+            }
+            if kind == "chunk":
+                chosen["sequential_chunk_size"] = int(
+                    uplan.default_chunk_size)
+            if kind == "cache":
+                chosen["cache_points"] = [getattr(v, "id", -1)
+                                          for v in present]
+            # each kind's record carries ITS axis's slice of the
+            # product menu (chunk records the ladder, cache records
+            # the cache toggles, precision the trail toggles) plus the
+            # cross-axis baselines — not the full menu duplicated per
+            # kind with other axes' entries posing as alternatives
+            prefixes = {"chunk": ("chunk_",), "cache": ("cache_",),
+                        "precision": ("trail_",),
+                        "placement": ()}.get(kind, ())
+            alternatives = [
+                c for c in uplan.scored_candidates
+                if c.get("entry") in ("sequential", "chain_dp_product")
+                or (prefixes
+                    and str(c.get("entry", "")).startswith(prefixes))
+            ]
+            ledger.record_decision(
+                kind=kind,
+                rule="UnifiedPlannerRule",
+                vertices=[getattr(v, "id", -1) for v in present],
+                labels=[_label(graph, v) for v in present],
+                chosen=chosen,
+                alternatives=alternatives,
+                predicted={
+                    "predicted_seconds": float(uplan.joint_seconds),
+                    "sequential_seconds": float(
+                        uplan.sequential_seconds),
+                    "seconds_saved": float(uplan.savings_seconds),
+                },
+            )
+        except Exception:
+            logger.debug("unified decision not recorded", exc_info=True)
+
+
+class _ClearPlannedChunkRule(Rule):
+    """Built in place of `UnifiedPlannerRule` when the constructor opts
+    out (`DefaultOptimizer(unified_planner=False)`): a pre-unified
+    optimizer must not execute — or statically model — under a
+    PREVIOUS plan's enforced chunk decision, so the process-global
+    override is cleared at the same point in the batch order where the
+    unified rule would have re-decided it. The graph is untouched
+    (bit-for-bit PR-13)."""
+
+    def apply(self, plan: Plan) -> Plan:
+        from .env import set_planned_chunk_size
+
+        set_planned_chunk_size(None)
+        return plan
+
+
 class ShardingPlannerRule(Rule):
     """Sharding-aware plan optimizer: choose, price, and ENFORCE
     per-stage placement as an optimizer decision (`analysis.planner` is
@@ -283,6 +555,8 @@ class ShardingPlannerRule(Rule):
         cfg = execution_config()
         if not cfg.sharding_planner:
             return plan  # kill switch: the PR-8 plan, bit for bit
+        if cfg.unified_planner and unified_enforced(plan[0]):
+            return plan  # the unified planner enforced placement jointly
         from ..parallel import mesh as meshlib
 
         mesh = meshlib.current_mesh()
@@ -375,7 +649,8 @@ class ShardingPlannerRule(Rule):
         return False
 
     @staticmethod
-    def _enforce(graph: Graph, splan, mesh) -> Graph:
+    def _enforce(graph: Graph, splan, mesh,
+                 mark_unified: bool = False) -> Graph:
         import copy
 
         from ..nodes.util.fusion import FusedBatchTransformer
@@ -391,6 +666,8 @@ class ShardingPlannerRule(Rule):
             if isinstance(op, (FusedChainOperator, FusedBatchTransformer)):
                 tagged = copy.copy(op)
                 tagged.planned_out_spec = spec
+                if mark_unified:
+                    tagged.planned_by_unified = True
                 graph = graph.set_operator(vid, tagged)
             elif isinstance(op, DatasetOperator) \
                     and hasattr(op.dataset, "reshard"):
@@ -445,6 +722,8 @@ class PrecisionPlannerRule(Rule):
         cfg = execution_config()
         if not cfg.precision_planner:
             return plan  # kill switch: the PR-9 plan, bit for bit
+        if cfg.unified_planner and unified_enforced(plan[0]):
+            return plan  # the unified planner enforced precision jointly
         graph, prefixes = plan
         from .fusion_rule import FusedChainOperator
 
@@ -512,7 +791,8 @@ class PrecisionPlannerRule(Rule):
 
     @staticmethod
     def _record_decision(graph: Graph, vid, op, storage, saved: int,
-                         menu=None) -> None:
+                         menu=None, rule: str = "PrecisionPlannerRule"
+                         ) -> None:
         """One ledger record per program operator that received a baked
         storage policy: the chosen per-stage dtype trail, the priced
         alternatives it beat — the all-f32 reference (priced by the
@@ -545,7 +825,7 @@ class PrecisionPlannerRule(Rule):
                 })
             ledger.record_decision(
                 kind="precision",
-                rule="PrecisionPlannerRule",
+                rule=rule,
                 vertices=[getattr(vid, "id", -1)],
                 labels=[op.label],
                 chosen={
@@ -587,7 +867,8 @@ class DefaultOptimizer(Optimizer):
     def __init__(self, samples_per_shard: int = 3, fuse: bool = True,
                  fusion_microbatch: int = 2048, fuse_apply: bool = True,
                  megafuse: bool = True, sharding_planner: bool = True,
-                 precision_planner: bool = True):
+                 precision_planner: bool = True,
+                 unified_planner: bool = True):
         from .fusion_rule import MegafusionRule, NodeFusionRule
 
         self._batches = [
@@ -612,6 +893,25 @@ class DefaultOptimizer(Optimizer):
                 # (KEYSTONE_MEGAFUSION) at optimization time.
                 fuse_rules.append(MegafusionRule(fusion_microbatch))
             self._batches.append(Batch("fuse", fuse_rules))
+        if unified_planner:
+            # the unified plan optimizer rides AFTER megafusion (it
+            # must see the program boundaries that will execute) and
+            # BEFORE the sequential planner rules: when its joint
+            # optimum strictly beats the sequential composition it
+            # enforces all tagged axes itself and the sequential rules
+            # stand down; otherwise it is a strict no-op and the PR-13
+            # passes run unchanged. Gated twice like its siblings: the
+            # constructor flag builds the PR-13 optimizer exactly, and
+            # the rule reads `ExecutionConfig.unified_planner`
+            # (KEYSTONE_UNIFIED_PLANNER) at optimization time.
+            self._batches.append(Batch("unified", [UnifiedPlannerRule()]))
+        else:
+            # the constructor opt-out still clears a previous plan's
+            # enforced chunk override (the env kill switch hides it by
+            # itself; the constructor channel must too, or a stale
+            # decision would leak into this PR-13-exact plan)
+            self._batches.append(Batch("unified",
+                                       [_ClearPlannedChunkRule()]))
         if sharding_planner:
             # placement rides AFTER megafusion: the planner must see the
             # program boundaries that will actually execute. Gated twice

@@ -149,6 +149,20 @@ system cannot (see ANALYSIS.md for the full catalog):
          per-request external lookup carries a suppression naming why
          it cannot be batched ahead of the request.
 
+  KJ015  manual-chunk-knob (under ``workflow/`` and ``nodes/``): a
+         direct ``.chunk_size`` config-attribute read or a
+         ``KEYSTONE_CHUNK_SIZE`` environment read outside the
+         sanctioned resolution sites. The chunk size is an OPTIMIZER
+         decision since PR 15: the unified planner's chosen chunk
+         flows through ``workflow.env.resolved_chunk_size`` into the
+         host batcher (``utils/batching.py``) and the KP2xx/KP8xx
+         models (``analysis/memory.resolve_chunk_rows``) from one
+         place. A hot-path module reading the raw knob bypasses the
+         planner's decision — the analyzer then models a chunking the
+         runtime doesn't execute. Call ``resolved_chunk_size()`` (or
+         take an explicit parameter) instead; the config definition
+         site (``workflow/env.py``) is sanctioned by path.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -218,6 +232,11 @@ RULES = {
              "_chunk_loop stall every request for the full host-call "
              "latency — the non-device twin of KJ005 (hoist the I/O to "
              "construction/fit time, or pre-load at ingress)",
+    "KJ015": "manual chunk knob: a direct config .chunk_size read or a "
+             "KEYSTONE_CHUNK_SIZE env read outside the sanctioned "
+             "batcher/memory-model resolution sites bypasses the "
+             "unified planner's chunk decision (read "
+             "workflow.env.resolved_chunk_size() instead)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -1172,6 +1191,48 @@ def _check_blocking_host_io(tree: ast.AST, path: str) -> Iterator[Finding]:
                         "construction/fit time or the serving ingress")
 
 
+def _check_manual_chunk_knob(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ015 (under ``workflow/``/``nodes/``, the config definition
+    site ``workflow/env.py`` excluded by the dispatcher): a direct
+    ``<config>.chunk_size`` attribute read, or any expression carrying
+    the ``"KEYSTONE_CHUNK_SIZE"`` env-key literal. Since PR 15 the
+    chunk size is an optimizer decision — the planner's chosen chunk
+    reaches the host batcher and the KP2xx/KP8xx static models through
+    ONE resolution (`workflow.env.resolved_chunk_size`); a module
+    reading the raw knob executes (or models) a chunking the planner
+    did not decide."""
+    def config_receiver(node) -> bool:
+        # cfg.chunk_size / config.chunk_size / execution_config().chunk_size
+        if isinstance(node, ast.Name):
+            return node.id in ("cfg", "config", "exec_config",
+                               "execution_config")
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            return name == "execution_config"
+        return False
+
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Attribute) and sub.attr == "chunk_size" \
+                and isinstance(sub.ctx, ast.Load) \
+                and config_receiver(sub.value):
+            yield Finding(
+                path, sub.lineno, "KJ015",
+                "direct `.chunk_size` config read bypasses the unified "
+                "planner's chunk decision — call "
+                "workflow.env.resolved_chunk_size() (or take an "
+                "explicit parameter) instead")
+        elif isinstance(sub, ast.Constant) \
+                and sub.value == "KEYSTONE_CHUNK_SIZE":
+            yield Finding(
+                path, sub.lineno, "KJ015",
+                "direct KEYSTONE_CHUNK_SIZE env read bypasses the "
+                "unified planner's chunk decision — the env knob is "
+                "resolved once by ExecutionConfig; read "
+                "workflow.env.resolved_chunk_size() instead")
+
+
 # ----------------------------------------------------------------- driver
 
 
@@ -1202,6 +1263,9 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_dynamic_metric_name(tree, rel))
         findings.extend(_check_transpose_reshape(tree, rel))
         findings.extend(_check_blocking_host_io(tree, rel))
+        if not posix.endswith("workflow/env.py/"):
+            # env.py IS the knob's definition + resolution site
+            findings.extend(_check_manual_chunk_knob(tree, rel))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
 

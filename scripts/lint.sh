@@ -154,6 +154,46 @@ print(f"roofline audit: {len(examples)} example(s), {priced} priced stage "
       f"rows, {candidates} KP801 pallas candidate(s), 0 KP8xx errors OK")
 PY
 
+echo "== unified-planner audit (joint decision IR vs sequential passes, 2x4 mesh) =="
+# The unified plan optimizer's decision gate: on an 8-device CPU mesh
+# arranged 2 (data) x 4 (model), solve the joint {placement x dtype x
+# chunk x cache} IR over every analyzable() example and assert (1) the
+# joint plan's predicted seconds never exceed the sequential PR-13
+# composition's (both scored by the same time model), (2) the joint
+# plan strictly wins on at least 2 examples, and (3) zero unsuppressed
+# WARNING/ERROR KP6xx/KP7xx/KP8xx findings UNDER the chosen plans —
+# the jointly decided placement/dtypes/chunk are clean, not just the
+# sequential reference.
+UNIFIED_JSON="$(mktemp /tmp/keystone_unified_audit.XXXXXX.json)"
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON"' EXIT
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m keystone_tpu.analysis --explain-unified --mesh-shape 2x4 \
+    --json > "$UNIFIED_JSON"
+python - "$UNIFIED_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+assert payload["devices"] == 8, payload["devices"]
+examples = payload["examples"]
+assert len(examples) >= 7, [e["example"] for e in examples]
+strict = 0
+for e in examples:
+    assert "build_error" not in e, e
+    gate = [f for f in e["findings"] if f["severity"] != "INFO"]
+    assert gate == [], (e["example"], gate)
+    planner = e.get("planner")
+    if planner is None:
+        continue  # nothing to decide (host-only pipeline)
+    assert planner["joint_seconds"] <= planner["sequential_seconds"], e
+    if planner["joint_seconds"] < planner["sequential_seconds"]:
+        strict += 1
+assert strict >= 2, f"joint plan strictly won on only {strict} example(s)"
+saved = sum((e.get("planner") or {}).get("savings_seconds", 0.0)
+            for e in examples)
+print(f"unified audit: {len(examples)} example(s), strict wins on {strict}, "
+      f"{saved:.3e} predicted seconds saved, 0 KP6xx/KP7xx/KP8xx under "
+      "chosen plans OK")
+PY
+
 echo "== serving audit (KP9xx readiness certificate over every example) =="
 # The serving-readiness certifier's gate: certify every analyzable()
 # example against the default envelope (batch [1,64], 1s SLO) and
@@ -164,7 +204,7 @@ echo "== serving audit (KP9xx readiness certificate over every example) =="
 # so the audit says exactly what is uncertified and why instead of
 # silently passing.
 SERVING_JSON="$(mktemp /tmp/keystone_serving_audit.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON" "$SERVING_JSON"' EXIT
 JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --certify-serving \
     --json > "$SERVING_JSON"
 python - "$SERVING_JSON" <<'PY'
@@ -193,7 +233,7 @@ PY
 
 echo "== telemetry smoke (trace a tiny pipeline, validate the JSON) =="
 TRACE_TMP="$(mktemp /tmp/keystone_trace_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON" "$SERVING_JSON" "$TRACE_TMP"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_SMOKE_TRACE="$TRACE_TMP" python - <<'PY'
 import json, os
 import numpy as np
@@ -217,7 +257,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$TRACE_TMP" >/dev/null
 
 echo "== dispatch smoke (example pipeline under the concurrent scheduler) =="
 DISPATCH_TRACE="$(mktemp /tmp/keystone_dispatch_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_TRACE="$DISPATCH_TRACE" KEYSTONE_CONCURRENT_DISPATCH=1 \
 python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance) run
@@ -249,7 +289,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$DISPATCH_TRACE" >/dev/null
 echo "== compile smoke (warm second run performs 0 cold compiles) =="
 COMPILE_CACHE="$(mktemp -d /tmp/keystone_compile_smoke.XXXXXX)"
 COMPILE_TRACE="$(mktemp /tmp/keystone_compile_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE"; rm -rf "$COMPILE_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_COMPILE_CACHE="$COMPILE_CACHE" \
 KEYSTONE_TRACE="$COMPILE_TRACE" python - <<'PY'
 # One example pipeline run TWICE against a fresh persistent-cache dir
@@ -293,7 +333,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$COMPILE_TRACE" >/dev/null
 echo "== megafusion smoke (1-program apply run; warm repeat stays 0-cold) =="
 MEGA_CACHE="$(mktemp -d /tmp/keystone_mega_smoke.XXXXXX)"
 MEGA_TRACE="$(mktemp /tmp/keystone_mega_smoke.XXXXXX.json)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu KEYSTONE_MEGAFUSION=1 KEYSTONE_COMPILE_CACHE="$MEGA_CACHE" \
 KEYSTONE_TRACE="$MEGA_TRACE" python - <<'PY'
 # One example apply run TWICE under megafusion against a fresh
@@ -337,7 +377,7 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry "$MEGA_TRACE" >/dev/null
 echo "== ledger smoke (decision records match enforced plan tags; self-diff clean) =="
 LEDGER_TRACE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.json)"
 LEDGER_FILE="$(mktemp /tmp/keystone_ledger_smoke.XXXXXX.jsonl)"
-trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 KEYSTONE_TRACE="$LEDGER_TRACE" KEYSTONE_LEDGER="$LEDGER_FILE" python - <<'PY'
 # One example pipeline (the dispatch-bench MnistRandomFFT instance,

@@ -947,3 +947,73 @@ def test_lint_sh_gate(tmp_path):
         capture_output=True, text=True)
     assert seeded.returncode == 1
     assert "KJ002" in seeded.stdout
+
+
+def test_kj015_flags_manual_chunk_knob_reads(tmp_path):
+    """KJ015: a direct config `.chunk_size` read (cfg/config/
+    execution_config() receivers) or a KEYSTONE_CHUNK_SIZE env read in
+    hot-path modules bypasses the unified planner's chunk decision —
+    the sanctioned path is `workflow.env.resolved_chunk_size()`."""
+    jl = _jaxlint()
+    bad = tmp_path / "workflow" / "bad_chunk.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import os\n"
+        "from .env import execution_config\n"
+        "\n"
+        "\n"
+        "def dispatch(items):\n"
+        "    cfg = execution_config()\n"
+        "    chunk = cfg.chunk_size\n"                           # KJ015
+        "    other = execution_config().chunk_size\n"            # KJ015
+        "    env = os.environ.get('KEYSTONE_CHUNK_SIZE', '256')\n"  # KJ015
+        "    raw = os.environ['KEYSTONE_CHUNK_SIZE']\n"          # KJ015
+        "    return chunk, other, env, raw\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ015"] * 4, findings
+    assert sorted(f.line for f in findings) == [7, 8, 9, 10]
+
+
+def test_kj015_negatives_and_suppression(tmp_path):
+    """The sanctioned reader (`resolved_chunk_size()`), unrelated
+    `.chunk_size` attributes on non-config receivers (a plan's chosen
+    chunk), files outside nodes/+workflow/, the env.py definition site,
+    and explicit suppressions all stay silent."""
+    jl = _jaxlint()
+    good = tmp_path / "workflow" / "good_chunk.py"
+    good.parent.mkdir(parents=True)
+    good.write_text(
+        "from .env import execution_config, resolved_chunk_size\n"
+        "\n"
+        "\n"
+        "def dispatch(items, uplan):\n"
+        "    chunk = resolved_chunk_size()\n"
+        "    chosen = uplan.chunk_size\n"  # a plan's decision, not the knob
+        "    suppressed = execution_config().chunk_size  # keystone: ignore[KJ015]\n"
+        "    return chunk, chosen, suppressed\n"
+    )
+    assert jl.lint_file(good) == []
+
+    # outside nodes/+workflow/ the rule does not apply at all
+    elsewhere = tmp_path / "utils" / "batching_like.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(
+        "import os\n"
+        "\n"
+        "\n"
+        "def resolve(cfg):\n"
+        "    return cfg.chunk_size, os.environ.get('KEYSTONE_CHUNK_SIZE')\n"
+    )
+    assert jl.lint_file(elsewhere) == []
+
+    # the config definition + resolution site is sanctioned by path
+    env_site = tmp_path / "workflow" / "env.py"
+    env_site.write_text(
+        "import os\n"
+        "\n"
+        "\n"
+        "def execution_config_like():\n"
+        "    return int(os.environ.get('KEYSTONE_CHUNK_SIZE', '256'))\n"
+    )
+    assert jl.lint_file(env_site) == []

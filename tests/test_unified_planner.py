@@ -1,0 +1,577 @@
+"""Unified plan optimizer tests (keystone_tpu/analysis/plan_ir.py +
+workflow.optimizer.UnifiedPlannerRule).
+
+The acceptance contract: the joint {placement × dtype × chunk × cache}
+plan scores ≤ the sequential PR-13 composition in predicted seconds on
+the example pipelines (same scoring function on both sides), strictly <
+on at least 2; ``KEYSTONE_UNIFIED_PLANNER=0`` — and each legacy kill
+switch under it — reproduces the PR-13 plan bit-for-bit (same vertices,
+operators, deps, tags); joint-finds-no-win cases are strict no-ops;
+joint-on outputs stay allclose-identical to serial unfused at multiple
+AND ragged counts; the jointly chosen chunk size lifts megafused scan
+trips above the KP804 dispatch floor without tripping KP600; and every
+enforced joint decision has a matching ledger record naming the unified
+planner.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis import as_source_spec
+from keystone_tpu.analysis.examples import build_example
+from keystone_tpu.analysis.plan_ir import (
+    CHUNK_LADDER,
+    machine_from_weights,
+    plan_unified,
+)
+from keystone_tpu.analysis.precision import precision_pass
+from keystone_tpu.analysis.propagate import spec_pass
+from keystone_tpu.analysis.roofline import roofline_pass
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.learning.calibrate import CostWeights
+from keystone_tpu.nodes.stats import (
+    LinearRectifier,
+    PaddedFFT,
+    RandomSignNode,
+)
+from keystone_tpu.nodes.util import ClassLabelIndicatorsFromInt, MaxClassifier
+from keystone_tpu.telemetry import ledger
+from keystone_tpu.workflow import PipelineEnv, Transformer
+from keystone_tpu.workflow.autocache import CacheMarker
+from keystone_tpu.workflow.env import (
+    config_override,
+    planned_chunk_size,
+    resolved_chunk_size,
+)
+from keystone_tpu.workflow.optimizer import DefaultOptimizer
+from keystone_tpu.workflow.operators import DatasetOperator
+
+
+def _predictor(data, labels_ds, dim=64, classes=4):
+    featurizer = (RandomSignNode(dim).to_pipeline() >> PaddedFFT()
+                  >> LinearRectifier(0.0))
+    labels = ClassLabelIndicatorsFromInt(classes)(labels_ds)
+    return featurizer.and_then(
+        BlockLeastSquaresEstimator(32, num_iter=1, lam=1e-3),
+        data, labels) >> MaxClassifier()
+
+
+def _data(n, dim=64, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, dim).astype(np.float32),
+            rng.randint(0, classes, size=n).astype(np.int32))
+
+
+def _optimized_graph(applied):
+    return applied.executor.optimized_graph
+
+
+def _graph_shape(g):
+    out = []
+    for vid in sorted(g.operators, key=lambda v: v.id):
+        op = g.get_operator(vid)
+        out.append((vid.id, type(op).__name__,
+                    tuple(d.id if hasattr(d, "id") else d
+                          for d in g.get_dependencies(vid)),
+                    getattr(op, "planned_out_spec", None),
+                    getattr(op, "planned_precision", None)))
+    return out
+
+
+# ----------------------------------------------------------- the decision
+
+
+def test_joint_beats_sequential_on_examples():
+    """The lint.sh unified-audit gate asserted in-tree: the joint plan
+    never prices worse than the sequential composition (same scorer on
+    both sides), strictly wins on at least 2 of the examples, and the
+    chosen dtype policies stay KP7xx-clean."""
+    strict = 0
+    for name in ("MnistRandomFFT", "LinearPixels", "RandomPatchCifar",
+                 "TimitPipeline"):
+        pipeline, source_spec = build_example(name)
+        specs, _ = spec_pass(
+            pipeline.graph, {pipeline.source: as_source_spec(source_spec)})
+        uplan = plan_unified(pipeline.graph, specs)
+        assert uplan is not None, name
+        assert uplan.joint_seconds <= uplan.sequential_seconds, name
+        if uplan.improved:
+            strict += 1
+            assert uplan.changed_kinds(), name
+        if uplan.boundary_precision is not None:
+            diags = precision_pass(pipeline.graph, specs,
+                                   uplan.boundary_precision)
+            assert not [d for d in diags if d.rule == "KP701"], (name,
+                                                                 diags)
+    assert strict >= 2, f"strict wins on only {strict} example(s)"
+
+
+def test_sequential_is_always_a_scored_candidate():
+    """The product menu the solver scores always contains the
+    sequential composition — the ≤ guarantee is structural, not a
+    post-hoc clamp alone — and the joint optimum entry matches the
+    plan's own score."""
+    pipeline, source_spec = build_example("MnistRandomFFT")
+    specs, _ = spec_pass(
+        pipeline.graph, {pipeline.source: as_source_spec(source_spec)})
+    uplan = plan_unified(pipeline.graph, specs)
+    entries = {c["entry"]: c for c in uplan.scored_candidates}
+    assert "sequential" in entries
+    assert entries["sequential"]["predicted_seconds"] == pytest.approx(
+        uplan.sequential_seconds)
+    assert "joint_optimum" in entries
+    assert entries["joint_optimum"]["predicted_seconds"] == pytest.approx(
+        uplan.joint_seconds)
+
+
+def test_recalibrated_weights_change_the_machine():
+    """A `CostWeights` (the `drift_cost_weights` shape) recalibrates
+    the time model's peaks: scoring under a 10× slower memory system
+    scales the bandwidth-bound predictions up."""
+    pipeline, source_spec = build_example("MnistRandomFFT")
+    specs, _ = spec_pass(
+        pipeline.graph, {pipeline.source: as_source_spec(source_spec)})
+    base = plan_unified(pipeline.graph, specs)
+    slow = CostWeights(cpu_weight=1.0 / 5.0e10, mem_weight=10.0 / 2.0e10,
+                       network_weight=1e-11)
+    m = machine_from_weights(slow)
+    assert m.peak_bw == pytest.approx(2.0e9)
+    recal = plan_unified(pipeline.graph, specs, weights=slow)
+    assert recal.sequential_seconds > base.sequential_seconds
+
+
+# ------------------------------------------------------------ kill switch
+
+
+@pytest.mark.parametrize("legacy", [
+    {},
+    {"megafusion": False},
+    {"sharding_planner": False},
+    {"precision_planner": False},
+    {"megafusion": False, "sharding_planner": False,
+     "precision_planner": False},
+])
+def test_kill_switch_matrix_reproduces_pr13_plan_bit_for_bit(legacy):
+    """KEYSTONE_UNIFIED_PLANNER=0 (config channel), combined with each
+    legacy kill switch, yields exactly the PR-13 plan the pre-unified
+    optimizer constructs under the same switches: same vertices, same
+    operator classes, same dependencies, same tags, no cache markers,
+    no planned chunk."""
+    X, y = _data(256)
+
+    def optimize(optimizer=None):
+        PipelineEnv.reset()
+        if optimizer is not None:
+            PipelineEnv.get().set_optimizer(optimizer)
+        data = Dataset.from_numpy(X)
+        labels = Dataset.from_numpy(y)
+        applied = _predictor(data, labels)(data)
+        return _optimized_graph(applied)
+
+    try:
+        with config_override(unified_planner=False,
+                             unified_min_savings_seconds=0.0, **legacy):
+            g_off = optimize()
+        # the pre-unified optimizer construction must agree with the
+        # kill switch exactly
+        with config_override(unified_planner=True,
+                             unified_min_savings_seconds=0.0, **legacy):
+            g_ctor = optimize(DefaultOptimizer(unified_planner=False))
+    finally:
+        PipelineEnv.reset()
+
+    off = _graph_shape(g_off)
+    assert off == _graph_shape(g_ctor)
+    assert not any(t[1] == "CacheMarker" for t in off)
+    assert planned_chunk_size() is None
+
+
+def test_unified_on_enforces_and_kill_switch_removes_it():
+    """Sanity that the matrix above is comparing against a live
+    deviation: with the floor dropped the unified planner enforces
+    cache points (the graphs differ), and the kill switch removes every
+    one of them."""
+    X, y = _data(256)
+    try:
+        PipelineEnv.reset()
+        with config_override(unified_min_savings_seconds=0.0):
+            data = Dataset.from_numpy(X)
+            labels = Dataset.from_numpy(y)
+            g_on = _optimized_graph(_predictor(data, labels)(data))
+        PipelineEnv.reset()
+        with config_override(unified_planner=False,
+                             unified_min_savings_seconds=0.0):
+            data = Dataset.from_numpy(X)
+            labels = Dataset.from_numpy(y)
+            g_off = _optimized_graph(_predictor(data, labels)(data))
+    finally:
+        PipelineEnv.reset()
+    on_markers = [v for v in g_on.operators
+                  if isinstance(g_on.get_operator(v), CacheMarker)]
+    assert on_markers, "unified planner enforced no cache point"
+    assert not [v for v in g_off.operators
+                if isinstance(g_off.get_operator(v), CacheMarker)]
+
+
+def test_no_win_is_strict_noop():
+    """A plan with no fan-out, no recompute weight, counts at the
+    chunk size, and one device gives the joint solver nothing to win:
+    the optimized graph is bit-for-bit the PR-13 one even with the
+    enforcement floor dropped."""
+    X = np.arange(64, dtype=np.float32).reshape(16, 4)
+    pipe = (Transformer.from_function(lambda x: x * 2.0).to_pipeline()
+            >> Transformer.from_function(lambda x: x + 1.0))
+
+    def optimize(**cfg):
+        PipelineEnv.reset()
+        with config_override(unified_min_savings_seconds=0.0, **cfg):
+            applied = pipe(Dataset.from_numpy(X))
+            return _optimized_graph(applied)
+
+    try:
+        g_on = optimize()
+        g_off = optimize(unified_planner=False)
+    finally:
+        PipelineEnv.reset()
+    assert _graph_shape(g_on) == _graph_shape(g_off)
+    assert planned_chunk_size() is None
+
+
+@pytest.mark.parametrize("count", [64, 43])
+def test_unified_on_outputs_allclose_serial_unfused(count):
+    """Joint-on outputs (floor dropped, enforcement live) are allclose
+    to serial unfused execution at a multiple AND a ragged count."""
+    X, y = _data(count)
+    try:
+        PipelineEnv.reset()
+        with config_override(unified_min_savings_seconds=0.0):
+            data = Dataset.from_numpy(X)
+            labels = Dataset.from_numpy(y)
+            out = np.asarray(_predictor(data, labels)(data).get().numpy())
+        PipelineEnv.reset()
+        PipelineEnv.get().set_optimizer(DefaultOptimizer(
+            fuse=False, sharding_planner=False, precision_planner=False,
+            unified_planner=False))
+        with config_override(megafusion=False, overlap=False,
+                             concurrent_dispatch=False):
+            data = Dataset.from_numpy(X)
+            labels = Dataset.from_numpy(y)
+            ref = np.asarray(_predictor(data, labels)(data).get().numpy())
+    finally:
+        PipelineEnv.reset()
+    assert out.shape == ref.shape == (count,)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------- chunk / KP804
+
+
+def _megafusable_predictor(n_train=64, d=64, k=4, seed=3):
+    """The canonical megafusable apply shape (test_megafusion's
+    featurize → scaler-fit → linear-fit → argmax), sized so the scan's
+    per-trip work at a deliberately underfilled chunk sits below the
+    KP804 dispatch floor while a full-count chunk clears it."""
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+    from keystone_tpu.nodes.stats import NormalizeRows, StandardScaler
+
+    rng = np.random.default_rng(seed)
+    X = np.abs(rng.normal(size=(n_train, d))).astype(np.float32) + 1.0
+    y = rng.integers(0, k, n_train).astype(np.int32)
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+    pipe = (NormalizeRows().to_pipeline()
+            .and_then(StandardScaler(), train)
+            .and_then(LinearMapEstimator(0.1), train, labels)
+            >> MaxClassifier())
+    return pipe, train
+
+
+def test_kp804_closure_joint_chunk_lifts_scan_trips():
+    """On the bench-shaped megafusable example forced to an underfilled
+    chunk size, the jointly chosen chunk lifts the megafused scan's
+    per-trip work above the KP804 dispatch floor without tripping the
+    KP600 budget — the roofline report pins it: KP804 fires at the
+    manual knob, is silent at the chosen chunk, and no budget finding
+    appears."""
+    from keystone_tpu.workflow.fusion_rule import MegafusedPlanOperator
+
+    n_test, d = 1024, 64
+    rng = np.random.default_rng(7)
+    Xt = np.abs(rng.normal(size=(n_test, d))).astype(np.float32) + 1.0
+    try:
+        PipelineEnv.reset()
+        with config_override(unified_min_savings_seconds=0.0,
+                             chunk_size=32,
+                             hbm_budget_bytes=1 << 30):
+            pipe, train = _megafusable_predictor(d=d)
+            pipe(train).get()  # fit run
+            applied = pipe(Dataset.from_numpy(Xt))
+            g = _optimized_graph(applied)
+            mega = [v for v in g.operators
+                    if isinstance(g.get_operator(v),
+                                  MegafusedPlanOperator)]
+            assert mega, "apply plan did not megafuse"
+            chosen = resolved_chunk_size()
+            assert chosen > 32, chosen
+            assert chosen in CHUNK_LADDER
+            specs, _ = spec_pass(g, {})
+            _, at_knob = roofline_pass(g, specs, chunk_rows=32)
+            _, at_chosen = roofline_pass(g, specs, chunk_rows=chosen)
+            assert [d_ for d_ in at_knob if d_.rule == "KP804"], \
+                "manual knob did not underfill — scenario is vacuous"
+            assert not [d_ for d_ in at_chosen if d_.rule == "KP804"]
+            # KP600 not tripped: the chunk decision respected the budget
+            from keystone_tpu.analysis.memory import memory_pass
+
+            _, mem_diags = memory_pass(g, specs)
+            assert not [d_ for d_ in mem_diags if d_.rule == "KP600"]
+            applied.get()  # forces under the planned chunk
+    finally:
+        PipelineEnv.reset()
+    assert planned_chunk_size() is None  # reset cleared the decision
+
+
+def test_host_only_pipeline_clears_stale_chunk_override():
+    """Every path through UnifiedPlannerRule re-decides the chunk knob:
+    a host-only plan (no device dataset) optimized after a chunk-
+    enforcing plan must not inherit the previous pipeline's override."""
+    from keystone_tpu import HostDataset
+    from keystone_tpu.workflow.env import set_planned_chunk_size
+
+    try:
+        PipelineEnv.reset()
+        set_planned_chunk_size(512)  # a previous plan's decision
+        assert resolved_chunk_size() == 512
+        pipe = Transformer.from_function(lambda x: x * 2.0).to_pipeline()
+        host = HostDataset([np.ones((4,), np.float32)] * 3)
+        pipe(host).get()
+        assert planned_chunk_size() is None
+        assert resolved_chunk_size() == 256
+    finally:
+        PipelineEnv.reset()
+
+
+def test_unified_ownership_survives_tagfree_enforcement():
+    """The sequential rules stand down on a graph the unified planner
+    owns even when enforcement produced NO tagged operator copies (a
+    joint win can revert the sequential placement to the defaults or
+    turn a trail off) — the ownership registry, not the tag scan, is
+    the signal."""
+    from keystone_tpu.workflow.optimizer import (
+        _UNIFIED_OWNED,
+        unified_enforced,
+    )
+
+    X = np.ones((8, 4), np.float32)
+    pipe = Transformer.from_function(lambda x: x * 2.0).to_pipeline()
+    try:
+        PipelineEnv.reset()
+        applied = pipe(Dataset.from_numpy(X))
+        g = _optimized_graph(applied)
+        assert not unified_enforced(g)
+        _UNIFIED_OWNED.add(g)
+        assert unified_enforced(g)  # no tags anywhere, still owned
+        assert not any(getattr(op, "planned_by_unified", False)
+                       for op in g.operators.values())
+    finally:
+        _UNIFIED_OWNED.discard(g)
+        PipelineEnv.reset()
+
+
+def test_constructor_optout_clears_stale_chunk_override():
+    """`DefaultOptimizer(unified_planner=False)` (the constructor
+    channel, env switch untouched) must not execute under a previous
+    plan's enforced chunk: the opt-out batch clears the override at
+    the same point the unified rule would have re-decided it."""
+    from keystone_tpu.workflow.env import set_planned_chunk_size
+
+    X = np.ones((8, 4), np.float32)
+    try:
+        PipelineEnv.reset()
+        set_planned_chunk_size(2048)  # a previous plan's decision
+        assert resolved_chunk_size() == 2048
+        PipelineEnv.get().set_optimizer(
+            DefaultOptimizer(unified_planner=False))
+        pipe = Transformer.from_function(lambda x: x * 2.0).to_pipeline()
+        pipe(Dataset.from_numpy(X)).get()
+        assert planned_chunk_size() is None
+        assert resolved_chunk_size() == 256
+    finally:
+        PipelineEnv.reset()
+
+
+def test_planned_chunk_respects_kill_switch():
+    """A live planned chunk is invisible the moment the unified planner
+    is switched off — KEYSTONE_UNIFIED_PLANNER=0 restores the config
+    knob bit-for-bit, stale overrides included."""
+    from keystone_tpu.workflow.env import set_planned_chunk_size
+
+    try:
+        set_planned_chunk_size(512)
+        assert resolved_chunk_size() == 512
+        with config_override(unified_planner=False):
+            assert planned_chunk_size() is None
+            assert resolved_chunk_size() == 256
+        assert resolved_chunk_size() == 512
+    finally:
+        set_planned_chunk_size(None)
+
+
+# ------------------------------------------------------------- the ledger
+
+
+def test_enforced_joint_decisions_have_ledger_records():
+    """Every enforced joint decision kind emits a ledger record naming
+    the unified planner, with the product menu as its alternatives and
+    predicted seconds in the shared units."""
+    X, y = _data(256)
+    try:
+        PipelineEnv.reset()
+        mark = ledger.session_mark()
+        with config_override(unified_min_savings_seconds=0.0):
+            data = Dataset.from_numpy(X)
+            labels = Dataset.from_numpy(y)
+            g = _optimized_graph(_predictor(data, labels)(data))
+        decisions = [d for d in ledger.session_since(mark)
+                     if d["rule"] == "UnifiedPlannerRule"]
+        assert decisions, "enforcement recorded no unified decision"
+        cache_vertices = {v.id for v in g.operators
+                          if isinstance(g.get_operator(v), CacheMarker)}
+        assert cache_vertices
+        for d in decisions:
+            assert d["enforced"]
+            assert d["kind"] in ("placement", "precision", "chunk",
+                                 "cache")
+            assert d["predicted"]["seconds_saved"] > 0
+            entries = {a.get("entry") for a in d["alternatives"]}
+            assert "sequential" in entries, entries
+        cached_recorded = set()
+        for d in decisions:
+            if d["kind"] == "cache":
+                cached_recorded.update(int(v) for v in d["vertices"])
+        # the ledger's cache record covers the vertices that were
+        # actually cached (ids recorded pre-splice)
+        assert cached_recorded
+    finally:
+        PipelineEnv.reset()
+
+
+def test_diff_names_unified_planner_kill_switch():
+    """--diff between a unified-on and a unified-off run names
+    KEYSTONE_UNIFIED_PLANNER as the suspect for every removed joint
+    decision (chunk and cache kinds included)."""
+    from keystone_tpu.telemetry.ledger import diff_runs
+
+    header_on = {"ledger_version": 1,
+                 "config": {"unified_planner": True, "megafusion": True},
+                 "config_env": dict(ledger.CONFIG_ENV)}
+    header_off = {"ledger_version": 1,
+                  "config": {"unified_planner": False,
+                             "megafusion": True},
+                  "config_env": dict(ledger.CONFIG_ENV)}
+
+    def rec(kind, labels):
+        return {"kind": kind, "rule": "UnifiedPlannerRule",
+                "vertices": [1], "labels": labels,
+                "chosen": {"entry": "joint_optimum"},
+                "alternatives": [{"entry": "sequential"}],
+                "predicted": {"seconds_saved": 1e-3}, "enforced": True}
+
+    run_a = {"header": header_on, "headers": [header_on],
+             "decisions": [rec("cache", ["Cache[x]"]),
+                           rec("chunk", []),
+                           rec("placement", ["Fused[x]"])]}
+    run_b = {"header": header_off, "headers": [header_off],
+             "decisions": []}
+    diff = diff_runs(run_a, run_b)
+    assert any(f["env"] == "KEYSTONE_UNIFIED_PLANNER"
+               for f in diff["config_flips"])
+    removed = {d["kind"]: d for d in diff["decisions_removed"]}
+    assert set(removed) == {"cache", "chunk", "placement"}
+    for d in removed.values():
+        assert d["suspect_env"] == "KEYSTONE_UNIFIED_PLANNER", d
+
+
+def test_autocache_greedy_emits_cache_records():
+    """Satellite: `AutoCacheRule` cache-placement choices emit
+    kind=``cache`` decision records with the greedy loop's own priced
+    menu as the alternatives — cache points were the last unaudited
+    optimizer decision."""
+    from keystone_tpu.workflow.optimizer import AutoCachingOptimizer
+
+    X, y = _data(128)
+    try:
+        PipelineEnv.reset()
+        PipelineEnv.get().set_optimizer(AutoCachingOptimizer("greedy"))
+        mark = ledger.session_mark()
+        with config_override(unified_planner=False):
+            data = Dataset.from_numpy(X)
+            labels = Dataset.from_numpy(y)
+            _predictor(data, labels)(data).get()
+        cache_decs = [d for d in ledger.session_since(mark)
+                      if d["kind"] == "cache"
+                      and d["rule"] == "AutoCacheRule"]
+        assert cache_decs, "greedy caching recorded no decision"
+        for d in cache_decs:
+            assert d["chosen"]["strategy"] == "greedy"
+            assert d["chosen"]["mem_bytes"] >= 0
+            assert d["alternatives"], d
+            assert d["labels"], d
+    finally:
+        PipelineEnv.reset()
+
+
+# ----------------------------------------------- calibration round-trip
+
+
+def test_emit_calibration_round_trip(tmp_path, monkeypatch):
+    """Satellite: ``--ledger <run> --emit-calibration <path>`` persists
+    the drift-implied CostWeights in the tpu_calibration.json schema,
+    and `machine_rates()` prefers the emitted file when
+    KEYSTONE_COST_CALIBRATION points at it and the platform matches."""
+    from keystone_tpu.nodes.learning import cost_model
+    from keystone_tpu.nodes.learning.calibrate import machine_rates
+    from keystone_tpu.telemetry.__main__ import main as telemetry_main
+
+    # a minimal run: a ledger JSONL + a trace with one node span whose
+    # seconds/out_bytes imply a mem_weight
+    trace_path = tmp_path / "run.json"
+    ledger_path = tmp_path / "run.ledger.jsonl"
+    trace = {
+        "traceEvents": [
+            {"ph": "X", "cat": "node", "name": "stage", "pid": 1,
+             "tid": 1, "ts": 0, "dur": 1000,
+             "args": {"seconds": 0.5, "out_bytes": 1e9}},
+        ],
+        "keystone": {"metrics": {"counters": {}}},
+    }
+    trace_path.write_text(json.dumps(trace))
+    header = {"ledger_version": 1, "pid": 1, "wall_epoch": 0.0,
+              "trace_path": str(trace_path), "platform": "cpu",
+              "config": {}, "config_env": {}}
+    ledger_path.write_text(json.dumps(header) + "\n")
+
+    out = tmp_path / "drift_calibration.json"
+    rc = telemetry_main(["--ledger", str(ledger_path),
+                         "--emit-calibration", str(out)])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    # observed 0.5 s over 1e9 bytes -> implied mem_weight 5e-10
+    assert payload["mem_weight"] == pytest.approx(5e-10)
+    assert payload["provenance"]["source"] == "drift_cost_weights"
+    assert payload["provenance"]["platform"] == "cpu"
+
+    # the round trip: pointing the env knob at the file recalibrates
+    # machine_rates (same platform), and the cache re-resolves
+    monkeypatch.setenv("KEYSTONE_COST_CALIBRATION", str(out))
+    monkeypatch.setattr(cost_model, "_weights_cache", None)
+    peak_flops, peak_bw = machine_rates()
+    assert peak_bw == pytest.approx(1.0 / payload["mem_weight"])
+    assert peak_flops == pytest.approx(1.0 / payload["cpu_weight"])
+    monkeypatch.setenv("KEYSTONE_COST_CALIBRATION", "analytic")
+    monkeypatch.setattr(cost_model, "_weights_cache", None)
+    assert machine_rates() != (peak_flops, peak_bw)
+    monkeypatch.setattr(cost_model, "_weights_cache", None)
