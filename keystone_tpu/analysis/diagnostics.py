@@ -98,6 +98,10 @@ RULES = {
              "per-trip compute is below the dispatch/loop overhead "
              "floor; the scan cannot amortize its trips — raise "
              "chunk_size",
+    "KP805": "chain-kernel-wins: a KP801 candidate lowers to one "
+             "double-buffered Pallas megakernel (ops/chain_kernels) "
+             "whose predicted seconds beat the XLA chain — the unified "
+             "planner's kernel axis should pick it up — informational",
     # serving tier (static serving-readiness certifier; see analysis/serving)
     "KP901": "serving-host-stage: an apply-path stage whose body cannot "
              "be abstractly traced (host code, or no propagated element "

@@ -22,7 +22,14 @@ The IR: per choosable stage boundary a product menu
      × cache point (legality = `AutoCacheRule._candidates`: demanded
        more than once, not already cached)}
 
-plus one plan-level axis, the chunk size from the PR-5 pow-2 ladder.
+plus one plan-level axis, the chunk size from the PR-5 pow-2 ladder,
+plus a per-fused-program kernel axis: lower a KP801 candidate's stage
+sub-trail to ONE double-buffered Pallas chain megakernel
+(`ops.chain_kernels`) or keep XLA's stage-at-a-time lowering. The
+kernel side prices ONE HBM pass of in+out bytes (the chain's traffic
+minus its 2× boundary round-trips); non-lowerable statics or a
+VMEM-infeasible block geometry price INF and demote cleanly — a scored
+demotion record, never a compile crash.
 
 Every assignment is priced by ONE calibrated time model, in seconds:
 
@@ -137,6 +144,9 @@ class Assignment:
     trails: Tuple[Tuple[Any, bool], ...] = ()
     chunk: int = 256
     caches: FrozenSet = frozenset()
+    #: per-fused-program chain-megakernel on/off (the kernel-vs-XLA
+    #: axis over the KP801 fused-trail candidates)
+    kernels: Tuple[Tuple[Any, bool], ...] = ()
 
     def fam(self) -> Dict[Any, str]:
         return dict(self.families)
@@ -147,9 +157,12 @@ class Assignment:
     def trl(self) -> Dict[Any, bool]:
         return dict(self.trails)
 
+    def krn(self) -> Dict[Any, bool]:
+        return dict(self.kernels)
+
 
 def _assign(families: Dict, policies: Dict, trails: Dict, chunk: int,
-            caches) -> Assignment:
+            caches, kernels: Optional[Dict] = None) -> Assignment:
     return Assignment(
         families=tuple(sorted(families.items(),
                               key=lambda kv: getattr(kv[0], "id", -1))),
@@ -159,6 +172,8 @@ def _assign(families: Dict, policies: Dict, trails: Dict, chunk: int,
                             key=lambda kv: getattr(kv[0], "id", -1))),
         chunk=int(chunk),
         caches=frozenset(caches),
+        kernels=tuple(sorted((kernels or {}).items(),
+                             key=lambda kv: getattr(kv[0], "id", -1))),
     )
 
 
@@ -241,6 +256,25 @@ class _UnifiedModel:
                 if decided is not None:
                     self.program_trails[vid] = decided
 
+        # --- kernel axis: KP801 fused-trail candidates — the
+        # chain-megakernel-vs-XLA choice per fused program. Every
+        # candidate joins the menu (one per vertex, highest boundary
+        # savings wins); non-lowerable statics or a VMEM-infeasible
+        # block geometry price INF in the scorer, so the toggle is
+        # scored-and-demoted with a ledger record instead of crashing
+        # or silently vanishing.
+        self.kernel_candidates: Dict[Any, Dict[str, Any]] = {}
+        for cand in self.roof.candidates:
+            if cand.get("kind") != "fused_trail" \
+                    or not cand.get("stage_slice"):
+                continue
+            kvid = cand["vertices"][0]
+            prev = self.kernel_candidates.get(kvid)
+            if prev is None or cand["seconds_saved"] > prev["seconds_saved"]:
+                self.kernel_candidates[kvid] = cand
+        for kvid, cand in self.kernel_candidates.items():
+            cand["vmem_feasible"] = self._kernel_feasible(kvid, cand)
+
         # --- cache axis: the autocache candidate set, restricted to
         # boundaries whose residency the model can price
         self.cache_candidates: List[Any] = []
@@ -291,6 +325,40 @@ class _UnifiedModel:
                 return d
         return None
 
+    def _kernel_feasible(self, vid, cand) -> Tuple[bool, str]:
+        """Probe the candidate slice's block geometry against the VMEM
+        budget at the ACTUAL propagated element shapes — the
+        memory-safety side of the kernel axis (arXiv 2206.14148
+        discipline): an infeasible geometry prices INF downstream, it
+        never reaches a compiler."""
+        try:
+            import jax
+
+            from ..nodes.util.fusion import _peephole
+            from ..ops.chain_kernels import chain_feasible
+            from ..workflow.fusion_rule import FusedChainOperator
+
+            if not (cand.get("lowerable") or {}).get("lowerable"):
+                return False, (cand.get("lowerable") or {}).get(
+                    "reason", "not lowerable")
+            op = self.graph.get_operator(vid)
+            stage_list = (list(op.stage_specs)
+                          if isinstance(op, FusedChainOperator)
+                          else list(op.stages))
+            stages = list(_peephole(stage_list))
+            i, j = cand["stage_slice"]
+            dep = self._data_dep(vid)
+            spec = self.specs.get(dep)
+            elem = spec.element
+            # walk the element to the slice's input shape
+            for s in stages[:i]:
+                elem = jax.eval_shape(
+                    lambda x, s=s: s.single_transform([x]), elem)
+            return chain_feasible(stages[i:j], tuple(elem.shape),
+                                  elem.dtype)
+        except Exception as e:
+            return False, f"feasibility probe failed: {e}"
+
     # ------------------------------------------------------------ scorer
 
     def score(self, a: Assignment) -> float:
@@ -301,6 +369,7 @@ class _UnifiedModel:
         families = a.fam()
         policies = a.pol()
         trails = a.trl()
+        kernels = a.krn()
         chunk = max(1, a.chunk)
         runs = self._get_runs(self.graph, set(a.caches))
         total = 0.0
@@ -339,6 +408,17 @@ class _UnifiedModel:
                 nbytes = max(0, nbytes - 2 * saved)
                 casts = sum(1 for s in trail[0] if s is not None)
                 total += casts * CAST_PENALTY_BYTES / bw
+            kc = self.kernel_candidates.get(vid)
+            if kc is not None and kernels.get(vid):
+                # the chain megakernel: the slice's internal boundaries
+                # never round-trip HBM (one streamed pass of in+out
+                # bytes). Non-lowerable statics or a VMEM-infeasible
+                # geometry make the WHOLE assignment infeasible — the
+                # toggle demotes with a priced-INF record, it is never
+                # enforced.
+                if not kc["vmem_feasible"][0]:
+                    return _INF
+                nbytes = max(0, nbytes - 2 * kc["boundary_bytes"])
             count = self._count(vid)
             trips = max(1, math.ceil(count / chunk))
             if self.budget and count:
@@ -590,6 +670,16 @@ class _UnifiedModel:
                  f"{'on' if trails[vid] else 'off'}",
                  replace(best, trails=_assign({}, {}, trails, 0,
                                               ()).trails))
+        # chain-megakernel toggles (the kernel-vs-XLA axis): an
+        # infeasible kernel scores INF here — the scored entry IS the
+        # demotion record
+        for vid in self.kernel_candidates:
+            kernels = best.krn()
+            kernels[vid] = not kernels.get(vid, False)
+            try_(f"kernel_{getattr(vid, 'id', vid)}_"
+                 f"{'on' if kernels[vid] else 'off'}",
+                 replace(best, kernels=_assign({}, {}, {}, 0, (),
+                                               kernels).kernels))
         # greedy cache additions (the autocache greedy shape, priced
         # statically): add the best strict improvement until none
         while True:
@@ -669,6 +759,11 @@ class UnifiedPlan:
     #: choice — the KP7xx lint surface (`precision_pass(plan=...)`),
     #: None when the dtype axis had nothing to decide
     boundary_precision: Optional[Any] = None
+    #: vid -> the KP801 candidate dict (stage_slice, lowerable verdict,
+    #: kernel_seconds vs chain_seconds, boundary_bytes) for every
+    #: fused program the joint plan lowers to a chain megakernel — the
+    #: `UnifiedPlannerRule` kernel-enforcement payload
+    kernel_choices: Dict[Any, Dict[str, Any]] = field(default_factory=dict)
     unpriced_stages: int = 0
 
     @property
@@ -707,6 +802,8 @@ class UnifiedPlan:
             out.append("chunk")
         if self.chosen.caches != self.sequential_assignment.caches:
             out.append("cache")
+        if self.chosen.kernels != self.sequential_assignment.kernels:
+            out.append("kernel")
         return out
 
     def rows(self, graph: Graph) -> List[Dict[str, Any]]:
@@ -718,12 +815,14 @@ class UnifiedPlan:
         trails = self.chosen.trl()
         seq_trails = self.sequential_assignment.trl()
         caches = set(self.chosen.caches)
+        kernels = self.chosen.krn()
         rows = []
         for vid in order:
             if not isinstance(vid, NodeId):
                 continue
             if vid not in fams and vid not in pols \
-                    and vid not in trails and vid not in caches:
+                    and vid not in trails and vid not in caches \
+                    and vid not in kernels:
                 continue
             rows.append({
                 "vertex": vid.id,
@@ -735,10 +834,12 @@ class UnifiedPlan:
                 "trail": trails.get(vid),
                 "sequential_trail": seq_trails.get(vid),
                 "cached": vid in caches,
+                "kernel": bool(kernels.get(vid)),
                 "changed": (fams.get(vid) != seq_fams.get(vid)
                             or pols.get(vid) != seq_pols.get(vid)
                             or trails.get(vid) != seq_trails.get(vid)
-                            or vid in caches),
+                            or vid in caches
+                            or bool(kernels.get(vid))),
             })
         return rows
 
@@ -752,7 +853,7 @@ def format_plan(plan: UnifiedPlan, graph: Graph) -> str:
         f"{len(plan.cache_vertices)} cache point(s))"
     ]
     header = (f"{'stage':<36} {'family':<22} {'policy':<14} "
-              f"{'cache':>5}")
+              f"{'cache':>5} {'kern':>5}")
     body = [header]
     for r in plan.rows(graph):
         mark = "*" if r["changed"] else " "
@@ -765,7 +866,8 @@ def format_plan(plan: UnifiedPlan, graph: Graph) -> str:
         body.append(
             f"{mark}{(r['label'] + '@' + str(r['vertex']))[:35]:<35} "
             f"{fam[:22]:<22} {pol[:14]:<14} "
-            f"{'yes' if r['cached'] else '':>5}")
+            f"{'yes' if r['cached'] else '':>5} "
+            f"{'yes' if r.get('kernel') else '':>5}")
     if len(body) > 1:
         lines.extend(body)
     return "\n".join(lines)
@@ -812,6 +914,7 @@ def plan_unified(
     if not model.roof.stages:
         return None
     has_axis = bool(model.cache_candidates or model.program_trails
+                    or model.kernel_candidates
                     or (model.pmodel and model.pmodel.menus)
                     or (model.prmodel and model.prmodel.menus)
                     or any(model._count(v) > min(ladder)
@@ -880,6 +983,11 @@ def plan_unified(
         for vid, on in best.trl().items()
         if on and vid in model.program_trails
     }
+    kernel_choices = {
+        vid: model.kernel_candidates[vid]
+        for vid, on in best.krn().items()
+        if on and vid in model.kernel_candidates
+    }
     boundary_precision = None
     if model.pplan is not None and model.prmodel is not None:
         from .precision import PrecisionPlan
@@ -906,5 +1014,6 @@ def plan_unified(
         sharding=sharding,
         program_precision=program_precision,
         boundary_precision=boundary_precision,
+        kernel_choices=kernel_choices,
         unpriced_stages=model.unpriced_stages,
     )

@@ -193,6 +193,10 @@ def reconcile_decisions(run: Dict[str, Any]) -> Dict[str, Any]:
         e for e in trace.get("traceEvents", [])
         if e.get("ph") == "X" and e.get("name") == "megafused_program"
     ]
+    kernel_spans = [
+        e for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and e.get("name") == "chain_kernel"
+    ]
 
     unique: Dict = {}
     for d in decisions:
@@ -248,6 +252,28 @@ def reconcile_decisions(run: Dict[str, Any]) -> Dict[str, Any]:
                     if labels and labels[0] in lbl]
             if hits:
                 observed["out_bytes"] = max(h["out_bytes"] for h in hits)
+        elif kind == "kernel":
+            # the chain-kernel decision observes its own span: one
+            # `chain_kernel` interval per kernel-bearing dispatch, with
+            # the planner's predicted seconds riding as a span arg
+            hits = []
+            for e in kernel_spans:
+                sl = str(e.get("args", {}).get("label", ""))
+                if any(lbl and (lbl in sl or sl in lbl)
+                       for lbl in labels):
+                    hits.append(e)
+            if hits:
+                observed["kernel_dispatches"] = len(hits)
+                obs_sec = max(float(e.get("dur", 0.0) or 0.0) / 1e6
+                              for e in hits)
+                if obs_sec:
+                    observed["kernel_seconds"] = obs_sec
+                    pred_k = sum(
+                        float(k.get("kernel_seconds") or 0.0)
+                        for k in ((d.get("chosen") or {})
+                                  .get("kernels") or []))
+                    if pred_k:
+                        residuals["kernel_seconds"] = pred_k - obs_sec
         rows.append({
             "seq": d.get("seq"),
             "kind": kind,
@@ -362,8 +388,11 @@ def reconcile_roofline(trace: Dict[str, Any]) -> Dict[str, Any]:
     seconds, the KP803 metadata the executor records) against the
     observed per-node span seconds.
 
-    Returns ``{"rows", "predicted_seconds", "observed_seconds",
-    "flops_residual_seconds", "stages_joined", "machine"}`` where each
+    Returns ``{"rows", "kernels", "predicted_seconds",
+    "observed_seconds", "flops_residual_seconds", "stages_joined",
+    "machine"}`` — ``kernels`` joins every ``chain_kernel`` span's
+    planner-predicted seconds against its observed wall duration (the
+    kernel-axis side of the drift report). Each stage
     row carries ``predicted_seconds``, ``observed_seconds``,
     ``residual`` (predicted − observed; positive means the model
     promised more time than the run took) and the static ``flops`` /
@@ -405,8 +434,30 @@ def reconcile_roofline(trace: Dict[str, Any]) -> Dict[str, Any]:
         })
     rows.sort(key=lambda r: (r["residual"] is None,
                              -(r["observed_seconds"] or 0.0)))
+    # chain-kernel spans carry their OWN predicted seconds (the unified
+    # planner's kernel-axis price rides `predicted_seconds` on every
+    # `chain_kernel` interval), so the kernel join needs no static
+    # metadata: predicted vs the span's observed wall seconds, per
+    # kernel-bearing dispatch
+    kernel_rows: List[Dict[str, Any]] = []
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("name") != "chain_kernel":
+            continue
+        args = e.get("args", {})
+        pred = args.get("predicted_seconds")
+        obs = float(e.get("dur", 0.0) or 0.0) / 1e6
+        kernel_rows.append({
+            "label": args.get("label"),
+            "family": args.get("family"),
+            "predicted_seconds": (float(pred) if pred is not None
+                                  else None),
+            "observed_seconds": obs if obs else None,
+            "residual": (float(pred) - obs
+                         if pred is not None and obs else None),
+        })
     return {
         "rows": rows,
+        "kernels": kernel_rows,
         "predicted_seconds": pred_total,
         "observed_seconds": obs_total,
         "flops_residual_seconds": (

@@ -64,6 +64,11 @@ Lints (all advisory — the roofline informs, placement/precision decide):
   - **KP804** (INFO): a megafused scan body whose per-trip compute is
     below the dispatch/loop overhead floor cannot amortize its trips —
     raise ``chunk_size``.
+  - **KP805** (INFO): a KP801 candidate that actually LOWERS — its
+    `_stage_fuse` statics match a chain-kernel family in
+    `ops/chain_kernels.py` — and whose one-HBM-pass kernel pricing
+    beats the XLA chain's predicted seconds; the unified planner's
+    kernel axis prices the scored pair and records the decision.
 
 Everything here is pure spec arithmetic over abstract values — no data
 moves, no device allocates, no program compiles.
@@ -715,6 +720,20 @@ def roofline_pass(
             f"round-trips (≈{cand['seconds_saved']:.2e}s at "
             f"{_fmt_rate(machine.peak_bw)}B/s)",
             vertex=head, label=_label(graph, head)))
+        # KP805: the candidate actually lowers, and the kernel's one
+        # HBM pass beats the XLA chain's predicted seconds
+        verdict = cand.get("lowerable") or {}
+        if verdict.get("lowerable") \
+                and cand["kernel_seconds"] < cand["chain_seconds"]:
+            diags.append(Diagnostic(
+                "KP805", Severity.INFO,
+                f"chain-kernel-wins: lowers to ONE "
+                f"{verdict['family']} Pallas kernel "
+                f"(ops/chain_kernels) — predicted "
+                f"≈{cand['kernel_seconds']:.2e}s vs the XLA chain's "
+                f"≈{cand['chain_seconds']:.2e}s; the unified planner's "
+                "kernel axis prices this pair",
+                vertex=head, label=_label(graph, head)))
 
     if est.stages:
         diags.append(Diagnostic(
@@ -786,15 +805,21 @@ def _pallas_candidates(graph: Graph, est: RooflineEstimate,
             continue
         boundary = sum(_chain_boundary_bytes(est, v) for v in chain[:-1])
         chain_seconds = sum(est.stages[v].predicted_seconds for v in chain)
-        out.append({
+        cand = {
             "vertices": [v for v in chain],
             "stages": [est.stages[v].label for v in chain],
             "n_stages": len(chain),
             "boundary_bytes": int(boundary),
             "seconds_saved": 2.0 * boundary / machine.peak_bw,
             "chain_seconds": chain_seconds,
+            "chain_flops": sum(est.stages[v].flops for v in chain),
+            "chain_hbm_bytes": int(
+                sum(est.stages[v].hbm_bytes for v in chain)),
+            "stage_slice": None,
             "kind": "graph_chain",
-        })
+        }
+        _annotate_kernel_lowering(graph, cand, machine)
+        out.append(cand)
 
     # fused-trail runs
     for vid, st in est.stages.items():
@@ -825,17 +850,96 @@ def _pallas_candidates(graph: Graph, est: RooflineEstimate,
                                         nxt["hbm_bytes"]) // 2)
                 seconds = sum(st.trail[k]["predicted_seconds"]
                               for k in range(i, j))
-                out.append({
+                cand = {
                     "vertices": [vid],
                     "stages": [st.trail[k]["stage"] for k in range(i, j)],
                     "n_stages": j - i,
                     "boundary_bytes": int(boundary),
                     "seconds_saved": 2.0 * boundary / machine.peak_bw,
                     "chain_seconds": seconds,
+                    "chain_flops": sum(st.trail[k]["flops"]
+                                       for k in range(i, j)),
+                    "chain_hbm_bytes": int(
+                        sum(st.trail[k]["hbm_bytes"] for k in range(i, j))),
+                    "stage_slice": (i, j),
                     "kind": "fused_trail",
-                })
+                }
+                _annotate_kernel_lowering(graph, cand, machine)
+                out.append(cand)
             i = j
     return out
+
+
+def _candidate_stage_objects(graph: Graph, cand: Dict[str, Any]):
+    """The actual stage objects a KP801 candidate's kernel would
+    replace, or None when the chain has no static fuse bodies
+    (`_FitSlot`s — the decomposition depends on a fit that has not
+    happened). A fused_trail candidate slices the operator's PEEPHOLED
+    stage list (the list `_build_program` executes, which the trail
+    indices address); a graph_chain candidate concatenates its member
+    stages — the list the fusion rules WILL collapse."""
+    from ..nodes.util.fusion import FusedBatchTransformer, _peephole
+    from ..workflow.fusion_rule import FusedChainOperator, _FitSlot
+
+    stages: List[Any] = []
+    if cand["kind"] == "fused_trail":
+        op = graph.get_operator(cand["vertices"][0])
+        stage_list = (list(op.stage_specs)
+                      if isinstance(op, FusedChainOperator)
+                      else list(op.stages))
+        i, j = cand["stage_slice"]
+        stages = list(_peephole(stage_list))[i:j]
+    else:
+        for vid in cand["vertices"]:
+            op = graph.get_operator(vid)
+            if isinstance(op, (FusedChainOperator, FusedBatchTransformer)):
+                stages.extend(op.stage_specs
+                              if isinstance(op, FusedChainOperator)
+                              else op.stages)
+            else:
+                stages.append(op)
+    if any(isinstance(s, _FitSlot) for s in stages) \
+            or not all(hasattr(s, "fuse") for s in stages):
+        return None
+    return stages
+
+
+def _annotate_kernel_lowering(graph: Graph, cand: Dict[str, Any],
+                              machine: Machine) -> None:
+    """Attach the chain-kernel verdict to one KP801 candidate:
+
+    - ``lowerable``: the `ops.chain_kernels.lowerability` verdict on
+      the candidate's `_stage_fuse` statics — family when it lowers,
+      the blocking stages (and any NAMED suppression) when it doesn't;
+    - ``kernel_seconds``: the kernel side of the planner's
+      kernel-vs-XLA axis — ONE HBM pass of in+out bytes (the chain's
+      traffic minus the 2× boundary round-trips the kernel keeps in
+      VMEM) at the same calibrated roofline; INF when not lowerable,
+      so the planner demotes cleanly instead of picking a kernel that
+      cannot compile.
+    """
+    try:
+        from ..ops.chain_kernels import lowerability, stage_statics
+
+        stages = _candidate_stage_objects(graph, cand)
+        if stages is None:
+            verdict = {"lowerable": False, "family": None,
+                       "reason": "fit-dependent stage: no static fuse "
+                                 "body to lower"}
+        else:
+            verdict = lowerability(stage_statics(stages))
+    except Exception as e:  # never let the verdict break the pass
+        verdict = {"lowerable": False, "family": None,
+                   "reason": f"fuse decomposition failed: {e}"}
+    cand["lowerable"] = verdict
+    if verdict.get("lowerable"):
+        kernel_bytes = max(
+            float(cand["chain_hbm_bytes"] - 2 * cand["boundary_bytes"]),
+            0.0)
+        cand["kernel_seconds"] = stage_cost(
+            cand["chain_flops"], kernel_bytes, machine)
+    else:
+        cand["kernel_seconds"] = float("inf")
 
 
 def _chain_boundary_bytes(est: RooflineEstimate, vid: NodeId) -> int:

@@ -26,6 +26,15 @@ plans and checks the outputs are identical:
     tolerance band (`analysis.precision.DEFAULT_BAND_*`), not exact
     equality — the ``precision_in_band`` verdict `bench.finalize_record`
     fails records on.
+  - ``kernel`` — the PR-16 plan: ``megafused`` plus the unified
+    planner (enforcement floor dropped to 0 so the small bench
+    instances actually plan) with its chain-megakernel axis live:
+    eligible fused stage sub-trails dispatch as ONE Pallas kernel
+    (`ops/chain_kernels.py`; interpret mode off-TPU, forced via the
+    ``KEYSTONE_CHAIN_KERNELS=interpret`` hook so the swap path — not
+    just the pricing — is what this column measures). Outputs stay on
+    the exact-equality gate: interpret-mode kernels are the same f32
+    jnp bodies XLA runs.
 
 Each measurement reports the *fit run* (first application: estimator
 fits + train apply) and the *apply run* (re-applying the fitted
@@ -45,7 +54,7 @@ from typing import Callable, Dict, List, Tuple
 import numpy as np
 
 PLANS = ("serial_unfused", "legacy", "optimized", "megafused",
-         "precision")
+         "precision", "kernel")
 
 
 # ---------------------------------------------------------------- examples
@@ -159,11 +168,41 @@ def _build_timit():
     return predictor, train, Dataset.from_numpy(Xt)
 
 
+def _build_linear_pixels():
+    """LinearPixels (pipelines/linear_pixels.py): PixelScaler →
+    GrayScaler → ImageVectorizer → BlockLeastSquares → argmax. The
+    featurizer trail is exactly the elementwise chain-megakernel
+    family, so this is the bench instance where the ``kernel`` plan's
+    swap actually fires (the report's default set keeps the historical
+    three; tests and ad-hoc sweeps pass it explicitly)."""
+    from .data.dataset import Dataset
+    from .nodes.images.core import GrayScaler, ImageVectorizer, PixelScaler
+    from .nodes.learning import BlockLeastSquaresEstimator
+    from .nodes.util import ClassLabelIndicatorsFromInt, MaxClassifier
+
+    rng = np.random.default_rng(3)
+    h = w = 8
+    c, k = 3, 4
+    X = rng.uniform(0, 255, size=(48, h, w, c)).astype(np.float32)
+    Xt = rng.uniform(0, 255, size=(24, h, w, c)).astype(np.float32)
+    y = rng.integers(0, k, 48).astype(np.int32)
+
+    featurizer = (PixelScaler().to_pipeline() >> GrayScaler()
+                  >> ImageVectorizer())
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(k)(Dataset.from_numpy(y)).get()
+    predictor = featurizer.and_then(
+        BlockLeastSquaresEstimator(h * w, num_iter=1, lam=1e-2), train,
+        labels) >> MaxClassifier()
+    return predictor, train, Dataset.from_numpy(Xt)
+
+
 #: name (matching the analysis-set registry) -> builder
 EXAMPLES: Dict[str, Callable] = {
     "MnistRandomFFT": _build_mnist_random_fft,
     "RandomPatchCifar": _build_random_patch_cifar,
     "TimitPipeline": _build_timit,
+    "LinearPixels": _build_linear_pixels,
 }
 
 
@@ -211,7 +250,47 @@ def _plan_context(plan: str):
         return DefaultOptimizer(unified_planner=False), True, True, \
             dict(megafusion=True, precision_planner=True,
                  precision_min_savings_bytes=0, unified_planner=False)
+    if plan == "kernel":
+        # the PR-16 plan: megafused + the unified planner (floor 0 so
+        # the small instances actually plan) with the chain-megakernel
+        # axis live; precision stays off so the column isolates the
+        # kernel decision against ``megafused`` exactly
+        return DefaultOptimizer(precision_planner=False), True, True, \
+            dict(megafusion=True, precision_planner=False,
+                 unified_planner=True, unified_min_savings_seconds=0.0,
+                 pallas_kernels=True)
     raise ValueError(f"unknown plan {plan!r}; expected one of {PLANS}")
+
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def _chain_kernel_interpret():
+    """Force `KEYSTONE_CHAIN_KERNELS=interpret` for the ``kernel`` plan
+    off-TPU, so the bench measures the actual swap path (one chain
+    dispatch per planned sub-trail), not just the planner's pricing.
+    On a TPU backend the default gate already dispatches native
+    kernels — the env is left alone."""
+    import jax
+
+    try:
+        native = jax.default_backend() == "tpu"
+    except Exception:
+        native = False
+    if native:
+        yield
+        return
+    prev = os.environ.get("KEYSTONE_CHAIN_KERNELS")
+    os.environ["KEYSTONE_CHAIN_KERNELS"] = "interpret"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("KEYSTONE_CHAIN_KERNELS", None)
+        else:
+            os.environ["KEYSTONE_CHAIN_KERNELS"] = prev
 
 
 def measure_example(name: str, plan: str) -> Dict:
@@ -230,9 +309,11 @@ def measure_example(name: str, plan: str) -> Dict:
     optimizer, overlap_on, concurrent_on, overrides = _plan_context(plan)
     PipelineEnv.reset()
     mark = ledger.session_mark()
+    kernel_env = (_chain_kernel_interpret() if plan == "kernel"
+                  else contextlib.nullcontext())
     try:
         PipelineEnv.get().set_optimizer(optimizer)
-        with overlap_override(overlap_on), \
+        with kernel_env, overlap_override(overlap_on), \
                 dispatch_override(concurrent_on), \
                 config_override(**overrides):
             predictor, train, test = EXAMPLES[name]()
@@ -297,7 +378,8 @@ def dispatch_count_report(
         outputs_match = True
         in_band = True
         if check_outputs:
-            for r in (runs["legacy"], runs["optimized"], mega):
+            for r in (runs["legacy"], runs["optimized"], mega,
+                      runs["kernel"]):
                 try:
                     np.testing.assert_allclose(
                         r["train_pred"], base["train_pred"],

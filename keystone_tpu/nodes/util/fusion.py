@@ -356,6 +356,25 @@ class FusedBatchTransformer(Transformer):
     #: of the program cache key.
     planned_matmul_precision = None
 
+    #: the unified planner's chain-megakernel tag (set by
+    #: `UnifiedPlannerRule` on a tagged copy): ``(start, stop, family)``
+    #: over the PEEPHOLED stage list. `_build_program` swaps that stage
+    #: sub-trail for ONE `pl.pallas_call` (ops/chain_kernels.py) that
+    #: streams batch blocks HBM→VMEM double-buffered and applies every
+    #: stage body in VMEM — the chain boundaries inside the slice never
+    #: round-trip HBM. The effective tag (`_kernel_plan`, which folds in
+    #: the `KEYSTONE_CHAIN_KERNELS` gate and the interpret mode) is part
+    #: of the program cache key, so the kernel form never collides with
+    #: the XLA form's entry and a kill-switch flip recompiles instead of
+    #: reusing the wrong program. None (the default) or a stale tag
+    #: compiles exactly the pre-kernel XLA program (bit-for-bit).
+    planned_kernel = None
+
+    #: the planner's predicted seconds for the kernel side of the swap
+    #: (set alongside `planned_kernel`); rides the ``chain_kernel`` span
+    #: so `reconcile_roofline` can join predicted vs observed.
+    planned_kernel_seconds = None
+
     def __init__(self, stages: Sequence[Transformer], microbatch: int = 2048):
         self.stages = list(stages)
         self.microbatch = microbatch
@@ -406,6 +425,39 @@ class FusedBatchTransformer(Transformer):
         flat, treedef = jax.tree_util.tree_flatten(params)
         return statics, flat, treedef, fns
 
+    def _kernel_plan(self):
+        """The EFFECTIVE chain-kernel tag: ``((start, stop, family),
+        interpret)`` — or None when unplanned or the gate is off. Folds
+        in `use_chain_kernels()` and the interpret mode so the program
+        cache key changes whenever a `KEYSTONE_CHAIN_KERNELS` flip would
+        change the built program."""
+        if self.planned_kernel is None:
+            return None
+        from ...ops import chain_kernels as _ck
+
+        if not _ck.use_chain_kernels():
+            return None
+        return tuple(self.planned_kernel), _ck.chain_interpret()
+
+    def _kernel_swap(self, statics):
+        """Resolve the planned kernel against THIS decomposition:
+        ``(start, stop, kern_fn)`` when the tagged sub-trail lowers, else
+        None (stale tag, unmatched statics, gate off) — the same
+        ignore-don't-miscompile discipline as a stale precision tag."""
+        kplan = self._kernel_plan()
+        if kplan is None or statics is None:
+            return None
+        (start, stop, family), interp = kplan
+        if not (0 <= start < stop <= len(statics)):
+            return None
+        from ...ops.chain_kernels import build_chain_fn
+
+        fn = build_chain_fn(tuple(statics[start:stop]), family=family,
+                            interpret=interp)
+        if fn is None:
+            return None
+        return start, stop, fn
+
     def _program_key(self, statics, flat, treedef, array_shape, dtype_name,
                      padded_count, n_shards, mesh):
         return (
@@ -421,6 +473,7 @@ class FusedBatchTransformer(Transformer):
             self.planned_out_spec,
             self.planned_precision,
             self.planned_matmul_precision,
+            self._kernel_plan(),
         )
 
     def _program_cache(self, statics):
@@ -458,11 +511,27 @@ class FusedBatchTransformer(Transformer):
                 program = cache.get(key)
         if program is None:
             program = self._build_program(
-                data.mesh, data.n_shards, data.padded_count, treedef, fns)
+                data.mesh, data.n_shards, data.padded_count,
+                treedef, fns, statics=statics)
             cache[key] = program
         from ...telemetry import record_dispatch
 
         record_dispatch()  # the whole chain is ONE executed program
+        swap = self._kernel_swap(statics)
+        if swap is not None:
+            # the planned chain megakernel is live in this program:
+            # span-visible so reconcile_roofline can join the planner's
+            # predicted seconds against the observed wall span
+            from ...telemetry import counter, span
+
+            start, stop, _ = swap
+            with span("chain_kernel", cat="node", label=self.label,
+                      family=self.planned_kernel[2], stages=stop - start,
+                      rows=data.count,
+                      predicted_seconds=self.planned_kernel_seconds):
+                out = data.with_data(program(flat, data.array, data.mask))
+            counter("pallas.chain_programs").inc()
+            return out
         return data.with_data(program(flat, data.array, data.mask))
 
     def warmup(self, element, count: int, mesh=None) -> Optional[str]:
@@ -508,7 +577,7 @@ class FusedBatchTransformer(Transformer):
             with span("aot_warmup", cat="compile", label=self.label,
                       rows=padded):
                 jitted = self._build_program(mesh, shards, padded,
-                                             treedef, fns)
+                                             treedef, fns, statics=statics)
                 xs_aval = jax.ShapeDtypeStruct(
                     array_shape, dtype,
                     sharding=leaf_sharding(mesh, array_shape))
@@ -541,7 +610,8 @@ class FusedBatchTransformer(Transformer):
         XLA's own donated accumulation buffer."""
         return lax.map(lambda xm: chunk_fn(params, xm[0], xm[1]), (xs, ms))
 
-    def _build_program(self, mesh, shards, padded_count, treedef, fns):
+    def _build_program(self, mesh, shards, padded_count, treedef, fns,
+                       statics=None):
         local_n = padded_count // shards
         chunk = min(self.microbatch, local_n)
         n_chunks = -(-local_n // chunk)
@@ -565,14 +635,31 @@ class FusedBatchTransformer(Transformer):
             _counter("precision.casts_baked").inc(
                 sum(1 for p in planned_prec if p is not None))
 
+        # the unified planner's chain-megakernel tag: when the tagged
+        # sub-trail lowers, ONE pallas_call replaces those stage bodies
+        # (a stale/unmatched tag builds exactly the XLA form, like a
+        # stale precision tag)
+        swap = self._kernel_swap(statics)
+        kstart, kstop, kern_fn = swap if swap is not None else (-1, -1, None)
+
         def chunk_fn(params, xb, mb):
-            for i, (f, p) in enumerate(zip(fns, params)):
-                xb = f(p, xb, mb)
+            i = 0
+            while i < len(fns):
+                if i == kstart and kern_fn is not None:
+                    # the chain megakernel: every boundary inside
+                    # [kstart, kstop) stays in VMEM, so the planner's
+                    # intra-slice storage casts are subsumed — only the
+                    # slice-end cast below still applies
+                    xb = kern_fn(tuple(params[kstart:kstop]), xb, mb)
+                    i = kstop - 1
+                else:
+                    xb = fns[i](params[i], xb, mb)
                 if planned_prec is not None and planned_prec[i] is not None \
                         and jnp.issubdtype(xb.dtype, jnp.floating):
                     # the chosen boundary storage dtype, baked into the
                     # traced program (convert_element_type in the jaxpr)
                     xb = xb.astype(jnp.dtype(planned_prec[i]))
+                i += 1
             return xb
 
         if matmul_prec is not None:

@@ -54,9 +54,24 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _kernels_enabled() -> bool:
+    """The ONE master switch over every Pallas kernel this library
+    owns: `ExecutionConfig.pallas_kernels` (env
+    ``KEYSTONE_CHAIN_KERNELS``, ledger-header recorded so ``--diff``
+    names a kernel flip as the suspect kill switch). The per-kernel env
+    knobs below remain as documented overrides UNDER this switch —
+    their opt-in/opt-out defaults reflect each kernel's measured
+    verdict, the master switch reflects trust in Pallas at all."""
+    from ..workflow.env import execution_config
+
+    return execution_config().pallas_kernels
+
+
 def use_pallas() -> bool:
     """Trace-time gate for the RBF kernel: opt-in (measured XLA parity,
     module docstring) and TPU-only."""
+    if not _kernels_enabled():
+        return False
     if os.environ.get("KEYSTONE_ENABLE_PALLAS") != "1":
         return False
     try:
@@ -70,6 +85,8 @@ def use_rectify_pallas() -> bool:
     default-ON on TPU (measured 1.1-1.54× over XLA's fusion at every
     shape point, module docstring); KEYSTONE_DISABLE_PALLAS_RECTIFY=1
     reverts to the XLA path."""
+    if not _kernels_enabled():
+        return False
     if os.environ.get("KEYSTONE_DISABLE_PALLAS_RECTIFY") == "1":
         return False
     try:
@@ -260,6 +277,8 @@ def rbf_block(X, Yb, gamma):
 
 
 def use_fused_conv() -> bool:
+    if not _kernels_enabled():
+        return False
     if os.environ.get("KEYSTONE_DISABLE_FUSED_CONV") == "1":
         return False
     try:

@@ -62,7 +62,7 @@ LEDGER_VERSION = 1
 
 #: decision kinds the optimizer rules emit.
 KINDS = ("fusion", "megafusion", "placement", "precision", "chunk",
-         "cache")
+         "cache", "kernel")
 
 #: the config fields a run header snapshots, with the env var that
 #: flips each — the channel by which ``--diff`` names a kill-switch
@@ -77,6 +77,7 @@ CONFIG_ENV = {
     "pad_chunks": "KEYSTONE_PAD_CHUNKS",
     "aot_warmup": "KEYSTONE_AOT_WARMUP",
     "overlap": "KEYSTONE_OVERLAP",
+    "pallas_kernels": "KEYSTONE_CHAIN_KERNELS",
 }
 
 _LOCK = threading.Lock()
@@ -616,6 +617,7 @@ _KIND_FIELDS = {
     "precision": ("precision_planner", "unified_planner"),
     "chunk": ("unified_planner",),
     "cache": ("unified_planner",),
+    "kernel": ("pallas_kernels", "unified_planner"),
 }
 
 

@@ -181,6 +181,19 @@ class ExecutionConfig:
     predicted seconds saved clear it, so tiny pipelines (tests, smoke
     runs) stay bit-identical to the sequential plan by construction.
     0 enforces every strict win.
+
+    ``pallas_kernels`` (default on; env ``KEYSTONE_CHAIN_KERNELS=0``
+    kills, ledger-header recorded so ``--diff`` can name the flip) is
+    the ONE master switch for every Pallas kernel the library owns:
+    the single-op kernels in ``ops/pallas_kernels.py`` (their
+    per-kernel env knobs remain as documented overrides UNDER this
+    switch) and the planned chain megakernels in
+    ``ops/chain_kernels.py``. Off-TPU the chain kernels are
+    interpret-validated only — the planner still prices and records the
+    kernel-vs-XLA decision, but built programs keep the XLA body unless
+    ``KEYSTONE_CHAIN_KERNELS=interpret`` forces the interpret-mode swap
+    (the e2e test hook). ``=0`` is bit-for-bit: programs are exactly
+    the XLA form.
     """
 
     overlap: bool = True
@@ -200,6 +213,7 @@ class ExecutionConfig:
     ledger_path: Optional[str] = None
     unified_planner: bool = True
     unified_min_savings_seconds: float = 5e-3
+    pallas_kernels: bool = True
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -313,6 +327,8 @@ def execution_config() -> ExecutionConfig:
                 "KEYSTONE_UNIFIED_PLANNER", "1").lower() not in _OFF,
             unified_min_savings_seconds=max(0.0, float(os.environ.get(
                 "KEYSTONE_UNIFIED_MIN_SAVINGS_S", "5e-3"))),
+            pallas_kernels=os.environ.get(
+                "KEYSTONE_CHAIN_KERNELS", "1").lower() not in _OFF,
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

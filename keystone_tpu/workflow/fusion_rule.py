@@ -166,6 +166,15 @@ class FusedChainOperator(Operator):
     planned_precision = None
     planned_matmul_precision = None
 
+    #: the unified planner's chain-megakernel tag ``(start, stop,
+    #: family)`` over the peepholed stage list (set by
+    #: `UnifiedPlannerRule` on a tagged copy) plus its predicted
+    #: seconds; `materialize` hands both to the built fused transformer,
+    #: whose program builder swaps the tagged sub-trail for ONE
+    #: pallas_call (ops/chain_kernels.py)
+    planned_kernel = None
+    planned_kernel_seconds = None
+
     def _fused_cls(self):
         from ..nodes.util.fusion import FusedBatchTransformer
 
@@ -197,6 +206,9 @@ class FusedChainOperator(Operator):
             if self.planned_matmul_precision is not None:
                 fused.planned_matmul_precision = \
                     self.planned_matmul_precision
+            if self.planned_kernel is not None:
+                fused.planned_kernel = self.planned_kernel
+                fused.planned_kernel_seconds = self.planned_kernel_seconds
             return fused
         return TransformerChain(stages)
 

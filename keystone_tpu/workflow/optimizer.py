@@ -280,9 +280,9 @@ def unified_enforced(graph: Graph) -> bool:
 
 class UnifiedPlannerRule(Rule):
     """Unified plan optimizer: ONE decision IR over {placement family ×
-    storage dtype × chunk size × cache point} per stage boundary,
-    priced in seconds by the calibrated roofline time model and solved
-    jointly under the HBM budget as a hard constraint
+    storage dtype × chunk size × cache point × chain megakernel} per
+    stage boundary, priced in seconds by the calibrated roofline time
+    model and solved jointly under the HBM budget as a hard constraint
     (`analysis.plan_ir` is the pure decision core; this rule is the
     enforcement shell).
 
@@ -310,7 +310,13 @@ class UnifiedPlannerRule(Rule):
         `utils.batching` and the KP2xx/KP8xx models all read back via
         the one `resolved_chunk_size` resolution;
       - chosen cache points insert `autocache.CacheMarker` nodes where
-        the profile-guided greedy used to.
+        the profile-guided greedy used to;
+      - chosen chain megakernels become ``planned_kernel`` tagged
+        copies of the fused program: `_build_program` swaps the tagged
+        stage sub-trail for ONE `pl.pallas_call`
+        (`ops.chain_kernels`), with the ``KEYSTONE_CHAIN_KERNELS``
+        gate folded into the program cache key so the kill switch is
+        bit-for-bit and ledger-attributable.
 
     Every enforced decision kind emits a ledger record
     (rule=``UnifiedPlannerRule``) whose alternatives are the product
@@ -424,6 +430,30 @@ class UnifiedPlannerRule(Rule):
                 PrecisionPlannerRule._record_decision(
                     graph, vid, op, storage, saved, menu,
                     rule="UnifiedPlannerRule")
+        if "kernel" in kinds and getattr(cfg, "pallas_kernels", True):
+            # the kernel-vs-XLA axis: tag each chosen fused program
+            # with its chain-megakernel slice. The tag is latent off
+            # the gate (`_kernel_plan` folds in `use_chain_kernels()`),
+            # so `KEYSTONE_CHAIN_KERNELS=0` still builds the bit-for-bit
+            # XLA program — the ledger record names the flip.
+            import copy
+
+            self._record(uplan, "kernel",
+                         sorted(uplan.kernel_choices,
+                                key=lambda v: getattr(v, "id", -1)), graph)
+            for vid, cand in sorted(
+                    uplan.kernel_choices.items(),
+                    key=lambda kv: getattr(kv[0], "id", -1)):
+                if vid not in graph.operators:
+                    continue
+                start, stop = cand["stage_slice"]
+                family = (cand.get("lowerable") or {}).get("family")
+                new_op = copy.copy(graph.get_operator(vid))
+                new_op.planned_kernel = (int(start), int(stop), family)
+                new_op.planned_kernel_seconds = float(
+                    cand["kernel_seconds"])
+                new_op.planned_by_unified = True
+                graph = graph.set_operator(vid, new_op)
         if "chunk" in kinds:
             self._record(uplan, "chunk", [], graph)
             set_planned_chunk_size(uplan.chunk_size)
@@ -467,6 +497,19 @@ class UnifiedPlannerRule(Rule):
             if kind == "cache":
                 chosen["cache_points"] = [getattr(v, "id", -1)
                                           for v in present]
+            if kind == "kernel":
+                chosen["kernels"] = [
+                    {
+                        "vertex": getattr(v, "id", -1),
+                        "family": (c.get("lowerable") or {}).get("family"),
+                        "stage_slice": list(c.get("stage_slice") or ()),
+                        "kernel_seconds": c.get("kernel_seconds"),
+                        "chain_seconds": c.get("chain_seconds"),
+                        "boundary_bytes": c.get("boundary_bytes"),
+                    }
+                    for v in present
+                    for c in [uplan.kernel_choices[v]]
+                ]
             # each kind's record carries ITS axis's slice of the
             # product menu (chunk records the ladder, cache records
             # the cache toggles, precision the trail toggles) plus the
@@ -474,6 +517,7 @@ class UnifiedPlannerRule(Rule):
             # kind with other axes' entries posing as alternatives
             prefixes = {"chunk": ("chunk_",), "cache": ("cache_",),
                         "precision": ("trail_",),
+                        "kernel": ("kernel_",),
                         "placement": ()}.get(kind, ())
             alternatives = [
                 c for c in uplan.scored_candidates

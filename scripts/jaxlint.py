@@ -163,6 +163,19 @@ system cannot (see ANALYSIS.md for the full catalog):
          take an explicit parameter) instead; the config definition
          site (``workflow/env.py``) is sanctioned by path.
 
+  KJ016  pallas-call-outside-ops (everywhere except ``ops/``): a
+         ``pl.pallas_call`` (or bare ``pallas_call``) invocation in a
+         module outside ``keystone_tpu/ops/``. Kernels live in one
+         place so the chain-kernel audit (scripts/lint.sh), the
+         interpret-mode test oracles, the live-chip canary
+         (scripts/kernel_live_check.py), and the
+         ``KEYSTONE_CHAIN_KERNELS`` kill switch cover every kernel the
+         runtime can dispatch. A pallas_call minted elsewhere dodges
+         all four: no ``*_reference`` oracle, no canary record, no
+         gate. Move the kernel into ``ops/`` (with its pure-jnp
+         reference) and call the builder, or suppress with a rationale
+         naming why this one cannot live there.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -237,6 +250,12 @@ RULES = {
              "batcher/memory-model resolution sites bypasses the "
              "unified planner's chunk decision (read "
              "workflow.env.resolved_chunk_size() instead)",
+    "KJ016": "pallas_call outside keystone_tpu/ops/: kernels live in "
+             "one audited home so the chain-kernel audit, the "
+             "interpret-mode oracles, the live-chip canary, and the "
+             "KEYSTONE_CHAIN_KERNELS kill switch cover every kernel "
+             "the runtime can dispatch — move the kernel (and its "
+             "pure-jnp reference) into ops/ and call the builder",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -1233,6 +1252,27 @@ def _check_manual_chunk_knob(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "workflow.env.resolved_chunk_size() instead")
 
 
+def _check_pallas_outside_ops(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ016 (everywhere except ``ops/``): a ``pl.pallas_call`` /
+    ``pallas.pallas_call`` / bare ``pallas_call`` invocation outside
+    the one audited kernel home. Comments and docstrings naming the
+    API do not trip this — only a real call expression does."""
+    for sub in ast.walk(tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name == "pallas_call":
+            yield Finding(
+                path, sub.lineno, "KJ016",
+                "pallas_call outside keystone_tpu/ops/ — kernels live "
+                "in ops/ (with a pure-jnp *_reference oracle) so the "
+                "lint.sh chain-kernel audit, the live-chip canary, and "
+                "the KEYSTONE_CHAIN_KERNELS kill switch cover them; "
+                "move the kernel there and call the builder")
+
+
 # ----------------------------------------------------------------- driver
 
 
@@ -1268,6 +1308,8 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
             findings.extend(_check_manual_chunk_knob(tree, rel))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
+    if "ops/" not in posix:
+        findings.extend(_check_pallas_outside_ops(tree, rel))
 
     # nested loops make ast.walk revisit inner statements: keep one
     # finding per (line, rule)

@@ -1,20 +1,30 @@
-"""Live (on-chip) validation + timing of the fused conv+rectify+pool
-Pallas kernel after a geometry/structure change.
+"""Live (on-chip) validation + timing of the Pallas kernels after a
+geometry/structure change: the fused conv+rectify+pool kernel and the
+two chain-megakernel families (`ops/chain_kernels.py` — the
+elementwise chain and rectify→pool→vectorize, the KP801 lowerings the
+unified planner's kernel axis prices).
 
-Three gates, in order (each is a prerequisite for trusting the next):
+Three gates per kernel, in order (each is a prerequisite for trusting
+the next):
 
-1. COMPILE: the kernel at the CIFAR flagship geometry (k=256, the
-   largest block the VMEM chooser picks) must compile — a scoped-vmem
-   OOM here is the failure class interpret-mode tests cannot see.
+1. COMPILE: the kernel at the flagship geometry (conv: CIFAR k=256 at
+   the largest VMEM block; chains: the bench-tier item shapes) must
+   compile at a ragged batch (2·block+3, forcing a padded tail block)
+   — a scoped-vmem OOM or Mosaic reject here is the failure class
+   interpret-mode tests cannot see.
 2. NUMERICS: on-chip agreement vs the XLA reference path at the same
-   geometry (tolerance: the documented bf16-patch-feed class, ~5e-4
-   relative, pooled over 196-element windows).
+   geometry (conv tolerance: the documented bf16-patch-feed class,
+   ~5e-4 relative pooled over 196-element windows; chains: the same
+   2e-3 gate — they are pure f32 so the observed error should sit at
+   float roundoff).
 3. TIMING: chained fresh-valued reps inside one program, R vs R/2
    differenced so tunnel RTT/dispatch cancels (PERF.md methodology) —
    prints per-rep seconds and kernel-only images/sec for the Pallas
    path and the XLA reference path at the bench tier's batch.
 
 Run from the repo root on the live chip: python scripts/kernel_live_check.py
+``--interpret`` runs the chain-kernel gates 1+2 in Pallas interpret
+mode (CPU smoke of this script's own harness; not a chip verdict).
 """
 
 import sys
@@ -25,10 +35,150 @@ sys.path.insert(0, ".")
 import numpy as np
 
 
-def main():
+def _timing_gate(name, fn_one, xb, reps=120):
+    """Gate 3: differenced chained-rep timing (R vs R/2 inside one
+    program so tunnel RTT/dispatch cancels) — shared by the conv
+    canary and both chain families."""
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    def chained(r):
+        @jax.jit
+        def run(x, seed):
+            def body(i, acc):
+                key = jax.random.fold_in(seed, i)
+                xp = x * (1.0 + 1e-6 * jax.random.uniform(key))
+                y = fn_one(xp)
+                return acc + y.reshape(x.shape[0], -1)[:, :8].sum()
+
+            return lax.fori_loop(0, r, body, jnp.float32(0.0))
+
+        return run
+
+    seconds = {}
+    for r in (reps // 2, reps):
+        run = chained(r)
+        float(run(xb, jax.random.PRNGKey(0)))  # compile+warm
+        t0 = time.perf_counter()
+        s = float(run(xb, jax.random.PRNGKey(1)))
+        seconds[r] = time.perf_counter() - t0
+        assert np.isfinite(s)
+    per_rep = (seconds[reps] - seconds[reps // 2]) / (reps - reps // 2)
+    print(f"{name}: full={seconds[reps]:.3f}s half={seconds[reps//2]:.3f}s "
+          f"per_rep={per_rep*1e3:.2f}ms "
+          f"kernel_only={xb.shape[0]/per_rep:,.0f} img/s", flush=True)
+
+
+def check_chain_elementwise(interpret=False, timing=True):
+    """Chain family 1: the elementwise megakernel at the LinearPixels
+    geometry (PixelScaler >> GrayScaler >> ImageVectorizer on 32×32×3)
+    — the exact stage trail the unified planner tags `planned_kernel`
+    on that example's fused operator."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.nodes.images import (
+        GrayScaler,
+        ImageVectorizer,
+        PixelScaler,
+    )
+    from keystone_tpu.nodes.util.fusion import _peephole, _stage_fuse
+    from keystone_tpu.ops.chain_kernels import (
+        _compile_bodies,
+        _elementwise_geometry,
+        elementwise_chain_pallas,
+        elementwise_chain_reference,
+    )
+
+    stages = [PixelScaler(), GrayScaler(), ImageVectorizer()]
+    fused = [_stage_fuse(s) for s in _peephole(stages)]
+    statics = tuple(f[0] for f in fused)
+    params = [f[1] for f in fused]
+
+    rng = np.random.default_rng(1)
+    item = (32, 32, 3)
+    bodies = _compile_bodies(statics)
+    assert bodies is not None, "elementwise trail no longer lowers"
+    ops = [prep(p) for (_, prep, _), p in zip(bodies, params)]
+    probe = jnp.zeros((8,) + item, jnp.float32)
+    b = _elementwise_geometry(bodies, ops, probe)
+    assert b > 0, f"gate 1 FAILED: no VMEM block at item {item}"
+    print(f"elementwise_chain block chooser at item={item}: b={b}",
+          flush=True)
+
+    # gates 1+2: compile at a ragged batch (padded tail block) + numerics
+    n_small = 2 * b + 3
+    x = jnp.asarray(rng.random((n_small,) + item).astype(np.float32))
+    got = np.asarray(elementwise_chain_pallas(
+        statics, params, x, interpret=interpret))
+    want = np.asarray(elementwise_chain_reference(statics, params, x))
+    scale = max(np.abs(want).max(), 1e-12)
+    err = np.abs(got - want).max() / scale
+    assert err < 2e-3, f"gate 2 FAILED: max rel err {err:.2e}"
+    print(f"elementwise_chain gate 1+2 ok: compiled at b={b}, n={n_small}; "
+          f"max rel err vs XLA = {err:.2e}", flush=True)
+
+    if timing:
+        batch = 16384
+        xb = jnp.asarray(rng.random((batch,) + item).astype(np.float32))
+        _timing_gate("elementwise_chain pallas",
+                     lambda xp: elementwise_chain_pallas(statics, params, xp),
+                     xb)
+        _timing_gate("elementwise_chain xla",
+                     lambda xp: elementwise_chain_reference(
+                         statics, params, xp),
+                     xb)
+
+
+def check_chain_rectify_pool(interpret=False, timing=True):
+    """Chain family 2: rectify→pool→vectorize at the RandomPatchCifar
+    conv-output geometry (27×27 positions, k=256 filters, 14/13
+    pooling) — the highest-priced KP801 family on that example."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.chain_kernels import (
+        _rectify_pool_vectorize_block,
+        rectify_pool_vectorize_pallas,
+        rectify_pool_vectorize_reference,
+    )
+
+    h = w = 27
+    k, pool, stride, alpha = 256, 14, 13, 0.25
+    b = _rectify_pool_vectorize_block(h, w, k, pool, stride)
+    assert b > 0, f"gate 1 FAILED: no VMEM block at (h={h}, w={w}, k={k})"
+    print(f"rectify_pool_vectorize block chooser at (h={h}, w={w}, k={k}): "
+          f"b={b}", flush=True)
+
+    rng = np.random.default_rng(2)
+    n_small = 2 * b + 3
+    x = jnp.asarray(rng.standard_normal((n_small, h, w, k)).astype(np.float32))
+    got = np.asarray(rectify_pool_vectorize_pallas(
+        x, alpha, 0.0, pool, stride, interpret=interpret))
+    want = np.asarray(rectify_pool_vectorize_reference(
+        x, alpha, 0.0, pool, stride))
+    scale = max(np.abs(want).max(), 1e-12)
+    err = np.abs(got - want).max() / scale
+    assert err < 2e-3, f"gate 2 FAILED: max rel err {err:.2e}"
+    print(f"rectify_pool_vectorize gate 1+2 ok: compiled at b={b}, "
+          f"n={n_small}; max rel err vs XLA = {err:.2e}", flush=True)
+
+    if timing:
+        batch = 2048
+        xb = jnp.asarray(
+            rng.standard_normal((batch, h, w, k)).astype(np.float32))
+        _timing_gate("rectify_pool_vectorize pallas",
+                     lambda xp: rectify_pool_vectorize_pallas(
+                         xp, alpha, 0.0, pool, stride),
+                     xb)
+        _timing_gate("rectify_pool_vectorize xla",
+                     lambda xp: rectify_pool_vectorize_reference(
+                         xp, alpha, 0.0, pool, stride),
+                     xb)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
 
     from keystone_tpu.ops import (
         conv_rectify_pool_pallas,
@@ -36,6 +186,14 @@ def main():
         hwio_to_cmajor,
     )
     from keystone_tpu.ops.pallas_kernels import _fused_conv_block_images
+
+    interpret = "--interpret" in sys.argv[1:]
+    if interpret:
+        # CPU smoke of the chain-kernel harness only — not a chip verdict
+        check_chain_elementwise(interpret=True, timing=False)
+        check_chain_rectify_pool(interpret=True, timing=False)
+        print("interpret-mode chain smoke ok (no chip verdict)", flush=True)
+        return
 
     dev = jax.devices()[0]
     print(f"device: {dev} ({dev.platform})", flush=True)
@@ -72,21 +230,7 @@ def main():
           f"max rel err vs XLA on-chip = {err:.2e}", flush=True)
 
     # --- gate 3: differenced chained-rep timing ------------------------
-    batch, reps = 16384, 120
-
-    def chained(fn_one, r):
-        @jax.jit
-        def run(xb, seed):
-            def body(i, acc):
-                key = jax.random.fold_in(seed, i)
-                xp = xb * (1.0 + 1e-6 * jax.random.uniform(key))
-                y = fn_one(xp)
-                return acc + y.reshape(xb.shape[0], -1)[:, :8].sum()
-
-            return lax.fori_loop(0, r, body, jnp.float32(0.0))
-
-        return run
-
+    batch = 16384
     xb = jnp.asarray(rng.random((batch, h, w, c)).astype(np.float32))
 
     def pallas_one(xp):
@@ -97,19 +241,12 @@ def main():
         return conv_rectify_pool_reference(
             xp, kern, colsum, bias, alpha, 0.0, pool, stride, True)
 
-    for name, fn_one in (("pallas", pallas_one), ("xla", ref_one)):
-        seconds = {}
-        for r in (reps // 2, reps):
-            run = chained(fn_one, r)
-            float(run(xb, jax.random.PRNGKey(0)))  # compile+warm
-            t0 = time.perf_counter()
-            s = float(run(xb, jax.random.PRNGKey(1)))
-            seconds[r] = time.perf_counter() - t0
-            assert np.isfinite(s)
-        per_rep = (seconds[reps] - seconds[reps // 2]) / (reps - reps // 2)
-        print(f"{name}: full={seconds[reps]:.3f}s half={seconds[reps//2]:.3f}s "
-              f"per_rep={per_rep*1e3:.2f}ms "
-              f"kernel_only={batch/per_rep:,.0f} img/s", flush=True)
+    _timing_gate("pallas", pallas_one, xb)
+    _timing_gate("xla", ref_one, xb)
+
+    # --- chain megakernels (ops/chain_kernels.py) ----------------------
+    check_chain_elementwise()
+    check_chain_rectify_pool()
 
 
 if __name__ == "__main__":

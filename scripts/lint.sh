@@ -154,6 +154,52 @@ print(f"roofline audit: {len(examples)} example(s), {priced} priced stage "
       f"rows, {candidates} KP801 pallas candidate(s), 0 KP8xx errors OK")
 PY
 
+echo "== chain-kernel audit (every KP801 candidate lowers, prices worse, or is suppressed) =="
+# The chain-megakernel backend's gate (ops/chain_kernels.py): every
+# KP801 Pallas candidate the roofline finds must resolve one of three
+# ways — (1) it LOWERS (a lowerable verdict naming the kernel family,
+# with a finite kernel-seconds price), (2) it prices WORSE than the XLA
+# chain with the reason rendered, or (3) it carries a NAMED suppression
+# (chain_kernels.SUPPRESSED_STAGES — each blocker states why it stays
+# on XLA deliberately). An unlowerable candidate with no named
+# suppression is an open lowering gap: exit 1. At least 2 candidates
+# must lower with a winning price (the PR-16 acceptance floor).
+python - "$ROOFLINE_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+total = wins = worse = suppressed = 0
+gaps = []
+for e in payload["examples"]:
+    for c in e.get("candidates", []):
+        total += 1
+        v = c.get("lowerable")
+        anchor = f"{e['example']}:{c['vertices']}"
+        assert v is not None and v.get("reason"), (
+            f"{anchor}: KP801 candidate carries no lowerability verdict")
+        ks, cs = c.get("kernel_seconds"), c.get("chain_seconds")
+        if v.get("lowerable"):
+            assert ks is not None and ks == ks and ks != float("inf"), (
+                f"{anchor}: lowerable but kernel price is not finite")
+            if ks < cs:
+                wins += 1
+            else:
+                worse += 1  # priced worse, reason rendered in the verdict
+        elif v.get("suppressed"):
+            suppressed += 1
+        else:
+            gaps.append(f"{anchor}: {v.get('reason')}")
+if gaps:
+    print("chain-kernel audit: open lowering gap(s) with no named "
+          "suppression:", file=sys.stderr)
+    for g in gaps:
+        print(f"  {g}", file=sys.stderr)
+    sys.exit(1)
+assert wins >= 2, f"only {wins} candidate(s) lower with a winning price"
+print(f"chain-kernel audit: {total} KP801 candidate(s) — {wins} lower and "
+      f"win, {worse} price worse (reason rendered), {suppressed} carry "
+      "named suppressions, 0 open gaps OK")
+PY
+
 echo "== unified-planner audit (joint decision IR vs sequential passes, 2x4 mesh) =="
 # The unified plan optimizer's decision gate: on an 8-device CPU mesh
 # arranged 2 (data) x 4 (model), solve the joint {placement x dtype x

@@ -1017,3 +1017,59 @@ def test_kj015_negatives_and_suppression(tmp_path):
         "    return int(os.environ.get('KEYSTONE_CHUNK_SIZE', '256'))\n"
     )
     assert jl.lint_file(env_site) == []
+
+
+def test_kj016_flags_pallas_call_outside_ops(tmp_path):
+    """KJ016: a `pl.pallas_call` (or bare `pallas_call`) invocation in
+    any module outside ops/ dodges the chain-kernel audit, the
+    interpret oracles, the live canary, and the kill switch — flagged
+    wherever it is minted; comments/docstrings naming the API do not
+    trip it."""
+    jl = _jaxlint()
+    bad = tmp_path / "workflow" / "rogue_kernel.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax.experimental.pallas as pl\n"
+        "from jax.experimental.pallas import pallas_call\n"
+        "\n"
+        "\n"
+        "def body(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "\n"
+        "\n"
+        "def launch(x):\n"
+        "    # pl.pallas_call in a comment stays silent\n"
+        "    a = pl.pallas_call(body, out_shape=x)(x)\n"          # KJ016
+        "    b = pallas_call(body, out_shape=x)(x)\n"             # KJ016
+        "    return a, b\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ016"] * 2, findings
+    assert sorted(f.line for f in findings) == [11, 12]
+
+
+def test_kj016_negatives_and_suppression(tmp_path):
+    """Kernels under ops/ are the sanctioned home; a suppressed call
+    elsewhere (with its rationale) stays silent."""
+    jl = _jaxlint()
+    home = tmp_path / "ops" / "my_kernels.py"
+    home.parent.mkdir(parents=True)
+    home.write_text(
+        "import jax.experimental.pallas as pl\n"
+        "\n"
+        "\n"
+        "def build(body, shape):\n"
+        "    return pl.pallas_call(body, out_shape=shape)\n"
+    )
+    assert jl.lint_file(home) == []
+
+    elsewhere = tmp_path / "nodes" / "suppressed.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(
+        "import jax.experimental.pallas as pl\n"
+        "\n"
+        "\n"
+        "def launch(body, x):\n"
+        "    return pl.pallas_call(body, out_shape=x)(x)  # keystone: ignore[KJ016]\n"
+    )
+    assert jl.lint_file(elsewhere) == []
