@@ -37,6 +37,13 @@ from .diagnostics import (
 from .contracts import audit_operator, audit_registry, contract_pass
 from .effects import class_effects, interference_pass, operator_effects
 from .hazards import hazard_pass
+from .kernels import (
+    audit_kernels,
+    batcher_pad_targets,
+    kernel_pass,
+    statically_verified,
+    verify_lowering,
+)
 from .memory import (
     DEFAULT_CHUNK_ROWS,
     MemoryEstimate,
@@ -188,6 +195,14 @@ def validate_graph(
         roofline, roof_diags = roofline_pass(graph, specs,
                                              chunk_rows=chunk_rows)
         diags.extend(roof_diags)
+        # kernel verification tier (KP10xx): prove every lowerable
+        # KP801 candidate's chain-kernel geometry safe from the
+        # propagated element specs — coverage, ragged bounds, VMEM,
+        # mask discipline, oracle equivalence — before any TPU time
+        from .kernels import kernel_pass
+
+        _, kern_diags = kernel_pass(graph, specs, roofline)
+        diags.extend(kern_diags)
 
     serving_cert = None
     if tier >= 3:
@@ -233,8 +248,13 @@ __all__ = [
     "UNKNOWN",
     "ValidationReport",
     "as_source_spec",
+    "audit_kernels",
     "audit_operator",
     "audit_registry",
+    "batcher_pad_targets",
+    "kernel_pass",
+    "statically_verified",
+    "verify_lowering",
     "class_effects",
     "contract_pass",
     "element_nbytes",

@@ -6,6 +6,8 @@ registry.
     python -m keystone_tpu.analysis --level specs --hbm-budget-gb 16
     python -m keystone_tpu.analysis --audit-operators   # registry-wide KP5xx
     python -m keystone_tpu.analysis --audit-operators --json
+    python -m keystone_tpu.analysis --audit-kernels     # KP10xx chain-kernel
+    python -m keystone_tpu.analysis --audit-kernels --json
     python -m keystone_tpu.analysis --explain-sharding  # per-stage placement
     python -m keystone_tpu.analysis --explain-sharding --json
     python -m keystone_tpu.analysis --explain-sharding --plan --mesh-shape 2x4
@@ -118,6 +120,62 @@ def _audit_main(args) -> int:
     print(f"{mark} operator contract audit: {stats['classes']} class(es) "
           f"swept ({stats['probed']} probed), {len(findings)} finding(s)")
     return 1 if findings else 0
+
+
+def _audit_kernels_main(args) -> int:
+    """Registry-wide chain-kernel verification audit (KP10xx): sweep
+    every example pipeline's lowerable KP801 candidates through the
+    static verifier (analysis/kernels.py — coverage, ragged bounds,
+    VMEM proof, mask discipline, oracle equivalence). Same
+    CI-annotation schema and exit discipline as --audit-operators:
+    exit 1 on any unsuppressed KP10xx finding or a broken example."""
+    from .kernels import audit_kernels
+
+    names = args.examples or None
+    findings, stats = audit_kernels(names)
+    if args.ignore:
+        findings = [(n, p, d) for n, p, d in findings
+                    if d.rule not in args.ignore]
+    failed = bool(findings) or bool(stats["build_errors"])
+    if args.json:
+        print(json.dumps({
+            "audited_examples": stats["examples"],
+            "verified_lowerings": stats["verified"],
+            "total_lowerings": stats["lowerings"],
+            "build_errors": stats["build_errors"],
+            "suppressed": stats["suppressed"],
+            "proofs": [
+                {k: v for k, v in p.items() if k != "vertices"}
+                for p in stats["proofs"]
+            ],
+            "findings": [
+                {
+                    "example": name,
+                    "lowering": proof.get("label", ""),
+                    "family": proof.get("family"),
+                    "rule": d.rule,
+                    "severity": d.severity.name,
+                    "message": d.message,
+                }
+                for name, proof, d in findings
+            ],
+        }, indent=2, default=str))
+        return 1 if failed else 0
+    for name, ex_err in sorted(stats["build_errors"].items()):
+        print(f"✗ {name}: failed to build/verify: {ex_err}")
+    for name, proof, d in findings:
+        print(f"✗ {name} [{proof.get('family')}] "
+              f"{proof.get('label', '')}: [{d.severity.name}] {d.rule} "
+              f"{d.message}")
+    for s in stats["suppressed"]:
+        print(f"  suppressed {s['rule']} on {s['example']}: "
+              f"{s['reason']}")
+    mark = "✗" if failed else "✓"
+    print(f"{mark} chain-kernel verification audit: "
+          f"{stats['examples']} example(s) swept, "
+          f"{stats['verified']}/{stats['lowerings']} lowering(s) "
+          f"statically verified, {len(findings)} finding(s)")
+    return 1 if failed else 0
 
 
 def _parse_mesh_shape(raw):
@@ -724,6 +782,13 @@ def main(argv=None) -> int:
     p.add_argument("--audit-operators", action="store_true",
                    help="sweep EVERY registered Operator/Estimator subclass "
                         "for KP5xx contract violations (zero tolerated)")
+    p.add_argument("--audit-kernels", action="store_true",
+                   help="statically verify EVERY lowerable KP801 "
+                        "chain-kernel candidate across the example "
+                        "registry (KP10xx: grid coverage, ragged-tail "
+                        "bounds, VMEM working-set proof, mask "
+                        "discipline, oracle equivalence); fail on any "
+                        "unsuppressed finding")
     p.add_argument("--explain-sharding", action="store_true",
                    help="render each example's per-stage partition table "
                         "(spec, per-device bytes, boundary collective "
@@ -791,6 +856,9 @@ def main(argv=None) -> int:
 
     if args.audit_operators:
         return _audit_main(args)
+
+    if args.audit_kernels:
+        return _audit_kernels_main(args)
 
     if args.explain_sharding:
         return _explain_sharding_main(args)

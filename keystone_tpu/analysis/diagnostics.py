@@ -102,6 +102,30 @@ RULES = {
              "double-buffered Pallas megakernel (ops/chain_kernels) "
              "whose predicted seconds beat the XLA chain — the unified "
              "planner's kernel axis should pick it up — informational",
+    # kernel verification tier (static chain-kernel proofs; see
+    # analysis/kernels)
+    "KP1001": "kernel-grid-coverage: a chain-kernel lowering's grid × "
+              "block shape does not tile the padded output exactly — a "
+              "double-write, gap, or out-of-bounds write in the "
+              "index-map coverage proof",
+    "KP1002": "kernel-ragged-bounds: a chain-kernel block read escapes "
+              "the padded operand shapes for a batch count the host "
+              "batcher's pad ladder can emit (checked against "
+              "utils/batching's actual pad targets)",
+    "KP1003": "kernel-vmem-proof: the chain kernel's working set (2x "
+              "double-buffered streamed blocks + intermediates + "
+              "closure params, the SAME chain_vmem_bytes arithmetic "
+              "the runtime chooser uses) exceeds the VMEM budget, or "
+              "the static choice diverges from chain_feasible",
+    "KP1004": "kernel-mask-discipline: a fuse_masks_output stage inside "
+              "a kernel body does not consume the streamed mask operand "
+              "at its original chain position — the padded-row "
+              "corruption class, detected structurally from "
+              "stage_statics",
+    "KP1005": "kernel-oracle-equivalence: the per-block kernel body "
+              "disagrees with the pure-jnp reference oracle on shape "
+              "or dtype at a stage boundary (or does not preserve the "
+              "block's batch axis)",
     # serving tier (static serving-readiness certifier; see analysis/serving)
     "KP901": "serving-host-stage: an apply-path stage whose body cannot "
              "be abstractly traced (host code, or no propagated element "

@@ -274,6 +274,8 @@ class _UnifiedModel:
                 self.kernel_candidates[kvid] = cand
         for kvid, cand in self.kernel_candidates.items():
             cand["vmem_feasible"] = self._kernel_feasible(kvid, cand)
+            cand["statically_verified"] = self._kernel_verified(
+                kvid, cand)
 
         # --- cache axis: the autocache candidate set, restricted to
         # boundaries whose residency the model can price
@@ -325,6 +327,30 @@ class _UnifiedModel:
                 return d
         return None
 
+    def _kernel_slice(self, vid, cand):
+        """(slice stage objects, element aval entering the slice) for a
+        fused-trail kernel candidate — the one walk both the VMEM
+        feasibility probe and the KP10xx static verifier consume."""
+        import jax
+
+        from ..nodes.util.fusion import _peephole
+        from ..workflow.fusion_rule import FusedChainOperator
+
+        op = self.graph.get_operator(vid)
+        stage_list = (list(op.stage_specs)
+                      if isinstance(op, FusedChainOperator)
+                      else list(op.stages))
+        stages = list(_peephole(stage_list))
+        i, j = cand["stage_slice"]
+        dep = self._data_dep(vid)
+        spec = self.specs.get(dep)
+        elem = spec.element
+        # walk the element to the slice's input shape
+        for s in stages[:i]:
+            elem = jax.eval_shape(
+                lambda x, s=s: s.single_transform([x]), elem)
+        return stages[i:j], elem
+
     def _kernel_feasible(self, vid, cand) -> Tuple[bool, str]:
         """Probe the candidate slice's block geometry against the VMEM
         budget at the ACTUAL propagated element shapes — the
@@ -332,32 +358,32 @@ class _UnifiedModel:
         discipline): an infeasible geometry prices INF downstream, it
         never reaches a compiler."""
         try:
-            import jax
-
-            from ..nodes.util.fusion import _peephole
             from ..ops.chain_kernels import chain_feasible
-            from ..workflow.fusion_rule import FusedChainOperator
 
             if not (cand.get("lowerable") or {}).get("lowerable"):
                 return False, (cand.get("lowerable") or {}).get(
                     "reason", "not lowerable")
-            op = self.graph.get_operator(vid)
-            stage_list = (list(op.stage_specs)
-                          if isinstance(op, FusedChainOperator)
-                          else list(op.stages))
-            stages = list(_peephole(stage_list))
-            i, j = cand["stage_slice"]
-            dep = self._data_dep(vid)
-            spec = self.specs.get(dep)
-            elem = spec.element
-            # walk the element to the slice's input shape
-            for s in stages[:i]:
-                elem = jax.eval_shape(
-                    lambda x, s=s: s.single_transform([x]), elem)
-            return chain_feasible(stages[i:j], tuple(elem.shape),
-                                  elem.dtype)
+            stages, elem = self._kernel_slice(vid, cand)
+            return chain_feasible(stages, tuple(elem.shape), elem.dtype)
         except Exception as e:
             return False, f"feasibility probe failed: {e}"
+
+    def _kernel_verified(self, vid, cand):
+        """The KP10xx static proof for the candidate slice
+        (analysis/kernels.statically_verified): False prices the kernel
+        toggle INF — a lowering the verifier refuted must never reach
+        the runtime canary, let alone a chip. None (verifier could not
+        run) keeps the pre-verifier behavior: the canary decides."""
+        try:
+            from .kernels import statically_verified
+
+            if not (cand.get("lowerable") or {}).get("lowerable"):
+                return None
+            stages, elem = self._kernel_slice(vid, cand)
+            return statically_verified(stages, tuple(elem.shape),
+                                       elem.dtype)
+        except Exception:
+            return None
 
     # ------------------------------------------------------------ scorer
 
@@ -417,6 +443,11 @@ class _UnifiedModel:
                 # toggle demotes with a priced-INF record, it is never
                 # enforced.
                 if not kc["vmem_feasible"][0]:
+                    return _INF
+                if kc.get("statically_verified") is False:
+                    # the KP10xx verifier refuted the lowering: the
+                    # kernel toggle is pruned statically instead of
+                    # relying on the runtime canary to demote it
                     return _INF
                 nbytes = max(0, nbytes - 2 * kc["boundary_bytes"])
             count = self._count(vid)

@@ -454,6 +454,10 @@ def reconcile_roofline(trace: Dict[str, Any]) -> Dict[str, Any]:
             "observed_seconds": obs if obs else None,
             "residual": (float(pred) - obs
                          if pred is not None and obs else None),
+            # the KP10xx static verifier's verdict for this lowering
+            # (True proved / False refuted / None unverifiable), carried
+            # on the span by the dispatcher
+            "statically_verified": args.get("statically_verified"),
         })
     return {
         "rows": rows,

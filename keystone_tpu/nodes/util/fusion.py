@@ -375,6 +375,12 @@ class FusedBatchTransformer(Transformer):
     #: so `reconcile_roofline` can join predicted vs observed.
     planned_kernel_seconds = None
 
+    #: the KP10xx static verifier's verdict for the planned lowering
+    #: (True proved, False refuted, None unverifiable) — rides the
+    #: ``chain_kernel`` span so the ledger records whether the executed
+    #: kernel carried a static proof.
+    planned_kernel_statically_verified = None
+
     def __init__(self, stages: Sequence[Transformer], microbatch: int = 2048):
         self.stages = list(stages)
         self.microbatch = microbatch
@@ -528,7 +534,9 @@ class FusedBatchTransformer(Transformer):
             with span("chain_kernel", cat="node", label=self.label,
                       family=self.planned_kernel[2], stages=stop - start,
                       rows=data.count,
-                      predicted_seconds=self.planned_kernel_seconds):
+                      predicted_seconds=self.planned_kernel_seconds,
+                      statically_verified=(
+                          self.planned_kernel_statically_verified)):
                 out = data.with_data(program(flat, data.array, data.mask))
             counter("pallas.chain_programs").inc()
             return out

@@ -62,6 +62,37 @@ from .pallas_kernels import (
 #: scheduling slop and double-buffer headroom
 _VMEM_BUDGET = 10 * (1 << 20)
 
+#: block-row ladders each family's chooser descends (largest first).
+#: Shared with the KP1003 static proof (analysis/kernels.py) so the
+#: prover walks the exact candidate set the runtime chooser walks.
+_RECTIFY_BLOCK_LADDER = tuple(range(8, 0, -1))
+_ELEMENTWISE_BLOCK_LADDER = (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+
+
+def chain_vmem_bytes(bn: int, io_bytes: int, inter_bytes: int = 0,
+                     param_bytes: int = 0) -> int:
+    """THE chain-kernel VMEM working-set formula — the one shared
+    arithmetic behind both families' block choosers AND the KP1003
+    static proof (the `collective_cost`/`live_set_walk` precedent: one
+    function, so the static verdict and the runtime demotion can never
+    diverge). At batch block ``bn``: the grid pipeline double-buffers
+    every streamed block (2× the in+out bytes), intermediates are
+    single-buffered transients, closure params are resident once."""
+    return 2 * bn * io_bytes + bn * inter_bytes + param_bytes
+
+
+def chain_block_rows(io_bytes: int, inter_bytes: int = 0,
+                     param_bytes: int = 0, *,
+                     ladder=_ELEMENTWISE_BLOCK_LADDER,
+                     budget=None) -> int:
+    """Largest ladder block whose `chain_vmem_bytes` working set fits
+    the budget (0 = the geometry cannot fit VMEM at any block)."""
+    budget = _VMEM_BUDGET if budget is None else budget
+    for bn in ladder:
+        if chain_vmem_bytes(bn, io_bytes, inter_bytes, param_bytes) <= budget:
+            return bn
+    return 0
+
 
 class ChainKernelIneligibleError(ValueError):
     """The chain kernel's block geometry cannot fit VMEM."""
@@ -287,23 +318,29 @@ def rectify_pool_vectorize_reference(x, alpha, max_val, pool, stride):
     return y.reshape(y.shape[0], -1)
 
 
-def _rectify_pool_vectorize_block(h, w, k, pool, stride) -> int:
-    """Largest eligible batch block (0 = the geometry cannot fit VMEM):
-    input and pooled-output blocks both double-buffered under the
-    budget, with Mosaic's (8, 128) f32 tile padding on the two minor
-    dims of each."""
+def _rectify_pool_vectorize_parts(h, w, k, pool, stride):
+    """(io_bytes, inter_bytes, param_bytes, ladder) — the exact inputs
+    this family's chooser feeds `chain_block_rows`, or None when the
+    pool grid is empty. Input and pooled-output blocks both stream
+    (double-buffered), with Mosaic's (8, 128) f32 tile padding on the
+    two minor dims of each; no intermediates or closure params."""
     gy = (h - pool) // stride + 1
     gx = (w - pool) // stride + 1
     if gy <= 0 or gx <= 0:
-        return 0
+        return None
     in_per = h * _round_up(w, 8) * _round_up(k, 128) * 4
     out_per = gy * _round_up(gx, 8) * _round_up(2 * k, 128) * 4
-    best = 0
-    for bn in range(1, 9):
-        if 2 * bn * (in_per + out_per) > _VMEM_BUDGET:
-            break
-        best = bn
-    return best
+    return in_per + out_per, 0, 0, _RECTIFY_BLOCK_LADDER
+
+
+def _rectify_pool_vectorize_block(h, w, k, pool, stride) -> int:
+    """Largest eligible batch block (0 = the geometry cannot fit VMEM),
+    chosen by the shared `chain_vmem_bytes` working-set formula."""
+    parts = _rectify_pool_vectorize_parts(h, w, k, pool, stride)
+    if parts is None:
+        return 0
+    io_bytes, inter, param_bytes, ladder = parts
+    return chain_block_rows(io_bytes, inter, param_bytes, ladder=ladder)
 
 
 def rectify_pool_vectorize_pallas(
@@ -431,23 +468,37 @@ def _padded_item_bytes(shape, dtype) -> int:
     return total * itemsize
 
 
-def _elementwise_geometry(bodies, ops, x) -> int:
-    """Largest batch block (0 = infeasible): in+out double-buffered
-    plus every intermediate boundary's transient, under the budget."""
+def _elementwise_avals(bodies, ops, x):
+    """Per-boundary avals of the chain at batch probe ``x`` (index 0 =
+    the input, index i = after stage i) — `jax.eval_shape` only, shared
+    by the geometry chooser and the KP1005 boundary check."""
     avals = [jax.eval_shape(lambda xx: xx, x)]
     cur = avals[0]
     for (_, _, body), o in zip(bodies, ops):
         cur = jax.eval_shape(lambda xx, oo: body(xx, oo), cur, o)
         avals.append(cur)
+    return avals
+
+
+def _elementwise_parts(bodies, ops, x):
+    """(io_bytes, inter_bytes, param_bytes, ladder) — the exact inputs
+    this family's chooser feeds `chain_block_rows`: in+out blocks
+    stream (double-buffered), every internal boundary's transient is
+    single-buffered, closure params are resident once."""
+    avals = _elementwise_avals(bodies, ops, x)
     per_item = [_padded_item_bytes(a.shape[1:], a.dtype) for a in avals]
     io_bytes = per_item[0] + per_item[-1]
     inter = sum(per_item[1:-1])
     param_bytes = sum(_padded_item_bytes(a.shape, a.dtype)
-                     for stage in ops for a in stage)
-    for bn in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if 2 * bn * io_bytes + bn * inter + param_bytes <= _VMEM_BUDGET:
-            return bn
-    return 0
+                      for stage in ops for a in stage)
+    return io_bytes, inter, param_bytes, _ELEMENTWISE_BLOCK_LADDER
+
+
+def _elementwise_geometry(bodies, ops, x) -> int:
+    """Largest batch block (0 = infeasible), chosen by the shared
+    `chain_vmem_bytes` working-set formula."""
+    io_bytes, inter, param_bytes, ladder = _elementwise_parts(bodies, ops, x)
+    return chain_block_rows(io_bytes, inter, param_bytes, ladder=ladder)
 
 
 def elementwise_chain_pallas(

@@ -166,7 +166,11 @@ def rectify_pool(x, alpha: float, max_val: float, pool: int, stride: int):
         # tiling pads the sublane dim (W) to 8 and the lane dim (K) to
         # 128 — keep the nominal input block under ~3 MB of the 16 MB VMEM
         per_img = x.shape[1] * _round_up(x.shape[2], 8) * _round_up(x.shape[3], 128) * 4
-        block_n = max(1, min(8, (3 << 20) // max(per_img, 1)))
+        # conv-era standalone kernel: its working set is input-only (the
+        # pooled output is negligible), so the 2x-double-buffer chain
+        # formula over-reserves; the chain path's chooser covers the
+        # fused RectifyPool>>Vectorizer form instead
+        block_n = max(1, min(8, (3 << 20) // max(per_img, 1)))  # keystone: ignore[KJ017]
         return rectify_pool_pallas(x, alpha, max_val, pool, stride, block_n=block_n)
     return rectify_pool_reference(x, alpha, max_val, pool, stride)
 
@@ -565,7 +569,10 @@ def _fused_conv_geometry(posp: int, dp: int, k: int,
                 + R * g * posp * 4               # group pool matrix
                 + dp * kp * 2
             )
-            if bytes_needed > 10 * (1 << 20):
+            # grouped conv working set (patches + per-group z/act +
+            # pooled out + pool matrix + filters) has no chain-formula
+            # equivalent; its own live-chip canary gates it
+            if bytes_needed > 10 * (1 << 20):  # keystone: ignore[KJ017]
                 break
             best = cand
             cand += g

@@ -174,6 +174,7 @@ class FusedChainOperator(Operator):
     #: pallas_call (ops/chain_kernels.py)
     planned_kernel = None
     planned_kernel_seconds = None
+    planned_kernel_statically_verified = None
 
     def _fused_cls(self):
         from ..nodes.util.fusion import FusedBatchTransformer
@@ -209,6 +210,8 @@ class FusedChainOperator(Operator):
             if self.planned_kernel is not None:
                 fused.planned_kernel = self.planned_kernel
                 fused.planned_kernel_seconds = self.planned_kernel_seconds
+                fused.planned_kernel_statically_verified = \
+                    self.planned_kernel_statically_verified
             return fused
         return TransformerChain(stages)
 

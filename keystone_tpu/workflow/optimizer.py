@@ -452,6 +452,12 @@ class UnifiedPlannerRule(Rule):
                 new_op.planned_kernel = (int(start), int(stop), family)
                 new_op.planned_kernel_seconds = float(
                     cand["kernel_seconds"])
+                # the KP10xx static verdict rides with the tag so the
+                # chain_kernel span (and reconcile_roofline) can report
+                # whether the dispatched geometry was proven safe
+                # before any TPU time (analysis/kernels.py)
+                new_op.planned_kernel_statically_verified = cand.get(
+                    "statically_verified")
                 new_op.planned_by_unified = True
                 graph = graph.set_operator(vid, new_op)
         if "chunk" in kinds:
@@ -506,6 +512,8 @@ class UnifiedPlannerRule(Rule):
                         "kernel_seconds": c.get("kernel_seconds"),
                         "chain_seconds": c.get("chain_seconds"),
                         "boundary_bytes": c.get("boundary_bytes"),
+                        "statically_verified": c.get(
+                            "statically_verified"),
                     }
                     for v in present
                     for c in [uplan.kernel_choices[v]]

@@ -176,6 +176,21 @@ system cannot (see ANALYSIS.md for the full catalog):
          reference) and call the builder, or suppress with a rationale
          naming why this one cannot live there.
 
+  KJ017  hard-coded-kernel-geometry (``ops/`` only): a literal VMEM
+         byte budget (a ``<< 20`` MiB shift or a >=1 MiB integer
+         constant) outside the one sanctioned definition site
+         (``chain_kernels._VMEM_BUDGET``), or a literal leading
+         block-row count baked into a ``pl.BlockSpec`` shape. The
+         KP1003 static VMEM proof and `chain_feasible`'s runtime
+         chooser share ONE working-set formula
+         (``chain_kernels.chain_vmem_bytes`` /
+         ``chain_block_rows``) precisely so the verifier's verdict
+         and the dispatched geometry can never diverge; an inline
+         byte cap or a pinned block size reintroduces a second,
+         unverified arithmetic the static tier cannot see. Route the
+         geometry through the shared chooser, or suppress with a
+         rationale naming the kernel-specific working set.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -256,6 +271,12 @@ RULES = {
              "KEYSTONE_CHAIN_KERNELS kill switch cover every kernel "
              "the runtime can dispatch — move the kernel (and its "
              "pure-jnp reference) into ops/ and call the builder",
+    "KJ017": "hard-coded kernel geometry in ops/: a literal VMEM byte "
+             "budget outside chain_kernels._VMEM_BUDGET, or a literal "
+             "leading block-row count in a pl.BlockSpec shape — the "
+             "static KP1003 proof and the runtime chooser share one "
+             "formula (chain_vmem_bytes/chain_block_rows); inline "
+             "byte caps and pinned block sizes dodge it",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -1273,6 +1294,62 @@ def _check_pallas_outside_ops(tree: ast.AST, path: str) -> Iterator[Finding]:
                 "move the kernel there and call the builder")
 
 
+def _check_hardcoded_kernel_geometry(tree: ast.AST,
+                                     path: str) -> Iterator[Finding]:
+    """KJ017 (``ops/`` only): a hard-coded VMEM byte budget (a
+    ``<< 20`` MiB shift or a >=1 MiB integer constant) outside the one
+    sanctioned ``_VMEM_BUDGET`` definition, or a literal leading
+    block-row count in a ``pl.BlockSpec`` shape tuple. A leading
+    literal of 1 is a broadcast/scalar block dimension, not a chosen
+    batch block — only literals > 1 trip."""
+    sanctioned: Set[int] = set()
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_VMEM_BUDGET"
+                for t in sub.targets):
+            sanctioned.update(id(inner) for inner in ast.walk(sub))
+    mib = 1 << 20
+    for sub in ast.walk(tree):
+        if id(sub) in sanctioned:
+            continue
+        if (isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.LShift)
+                and isinstance(sub.right, ast.Constant)
+                and isinstance(sub.right.value, int)
+                and sub.right.value >= 20):
+            yield Finding(
+                path, sub.lineno, "KJ017",
+                "hard-coded VMEM byte budget (MiB shift) outside "
+                "chain_kernels._VMEM_BUDGET — route the geometry "
+                "through the shared chooser "
+                "(chain_vmem_bytes/chain_block_rows) so the KP1003 "
+                "static proof covers it")
+        elif (isinstance(sub, ast.Constant) and isinstance(sub.value, int)
+                and not isinstance(sub.value, bool) and sub.value >= mib):
+            yield Finding(
+                path, sub.lineno, "KJ017",
+                "hard-coded >=1 MiB byte constant outside "
+                "chain_kernels._VMEM_BUDGET — a second inline VMEM "
+                "arithmetic the KP1003 static proof cannot see")
+        elif isinstance(sub, ast.Call):
+            fn = sub.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name == "BlockSpec" and sub.args:
+                shape = sub.args[0]
+                if (isinstance(shape, (ast.Tuple, ast.List)) and shape.elts
+                        and isinstance(shape.elts[0], ast.Constant)
+                        and isinstance(shape.elts[0].value, int)
+                        and not isinstance(shape.elts[0].value, bool)
+                        and shape.elts[0].value > 1):
+                    yield Finding(
+                        path, shape.elts[0].lineno, "KJ017",
+                        "literal leading block-row count in a "
+                        "pl.BlockSpec shape — the batch block is the "
+                        "shared chooser's decision "
+                        "(chain_block_rows), not a constant; a pinned "
+                        "block dodges the KP1003 VMEM proof")
+
+
 # ----------------------------------------------------------------- driver
 
 
@@ -1310,6 +1387,8 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_bare_device_put(tree, rel))
     if "ops/" not in posix:
         findings.extend(_check_pallas_outside_ops(tree, rel))
+    else:
+        findings.extend(_check_hardcoded_kernel_geometry(tree, rel))
 
     # nested loops make ast.walk revisit inner statements: keep one
     # finding per (line, rule)

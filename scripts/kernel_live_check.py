@@ -35,6 +35,27 @@ sys.path.insert(0, ".")
 import numpy as np
 
 
+def _static_refutation(stages, item_shape):
+    """KP10xx pre-flight: the static kernel verifier's refuting rule
+    code (and message) when it proves this geometry unsafe/infeasible —
+    the live check skips such geometries rather than burning TPU time
+    on a lowering the unified planner prices to INF anyway. Returns
+    None when the lowering verifies (or the verifier can't run)."""
+    from keystone_tpu.analysis.kernels import verify_lowering
+
+    try:
+        proof, _ = verify_lowering(stages, item_shape)
+    except Exception:
+        return None  # verifier unavailable: the live gates decide
+    code = proof.get("refuted_by")
+    if code is None:
+        code = next((r for r, v in (proof.get("rules") or {}).items()
+                     if str(v).startswith("REFUTED")), None)
+    if code is None:
+        return None
+    return code, (proof.get("rules") or {}).get(code, "")
+
+
 def _timing_gate(name, fn_one, xb, reps=120):
     """Gate 3: differenced chained-rep timing (R vs R/2 inside one
     program so tunnel RTT/dispatch cancels) — shared by the conv
@@ -91,12 +112,18 @@ def check_chain_elementwise(interpret=False, timing=True):
     )
 
     stages = [PixelScaler(), GrayScaler(), ImageVectorizer()]
+    item = (32, 32, 3)
+    refuted = _static_refutation(stages, item)
+    if refuted:
+        code, msg = refuted
+        print(f"elementwise_chain SKIPPED (statically refuted {code}): "
+              f"{msg}", flush=True)
+        return
     fused = [_stage_fuse(s) for s in _peephole(stages)]
     statics = tuple(f[0] for f in fused)
     params = [f[1] for f in fused]
 
     rng = np.random.default_rng(1)
-    item = (32, 32, 3)
     bodies = _compile_bodies(statics)
     assert bodies is not None, "elementwise trail no longer lowers"
     ops = [prep(p) for (_, prep, _), p in zip(bodies, params)]
@@ -144,6 +171,17 @@ def check_chain_rectify_pool(interpret=False, timing=True):
 
     h = w = 27
     k, pool, stride, alpha = 256, 14, 13, 0.25
+    from keystone_tpu.nodes.images import ImageVectorizer
+    from keystone_tpu.nodes.util.fusion import _RectifyPoolStage
+
+    refuted = _static_refutation(
+        [_RectifyPoolStage(alpha, 0.0, pool, stride), ImageVectorizer()],
+        (h, w, k))
+    if refuted:
+        code, msg = refuted
+        print(f"rectify_pool_vectorize SKIPPED (statically refuted "
+              f"{code}): {msg}", flush=True)
+        return
     b = _rectify_pool_vectorize_block(h, w, k, pool, stride)
     assert b > 0, f"gate 1 FAILED: no VMEM block at (h={h}, w={w}, k={k})"
     print(f"rectify_pool_vectorize block chooser at (h={h}, w={w}, k={k}): "

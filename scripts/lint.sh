@@ -200,6 +200,41 @@ print(f"chain-kernel audit: {total} KP801 candidate(s) — {wins} lower and "
       "named suppressions, 0 open gaps OK")
 PY
 
+echo "== kernel-verifier audit (KP10xx: every registered lowering statically proved) =="
+# The static Pallas kernel verifier (analysis/kernels.py): every
+# lowerable KP801 candidate must carry a full KP1001-KP1005 proof —
+# grid coverage, ragged-tail bounds, VMEM working set (the SAME
+# arithmetic as chain_feasible's runtime chooser), mask discipline,
+# and abstract oracle equivalence — or a named
+# `# keystone: ignore[KP100x]` suppression. An unsuppressed KP10xx
+# finding means a lowering could dispatch without a static safety
+# proof: exit 1.
+KERNELS_JSON="$(mktemp /tmp/keystone_kernels_audit.XXXXXX.json)"
+JAX_PLATFORMS=cpu python -m keystone_tpu.analysis --audit-kernels \
+    --json > "$KERNELS_JSON"
+python - "$KERNELS_JSON" <<'PY'
+import json, sys
+payload = json.load(open(sys.argv[1]))
+assert not payload["build_errors"], payload["build_errors"]
+findings = payload["findings"]
+if findings:
+    print("kernel-verifier audit: unsuppressed KP10xx finding(s):",
+          file=sys.stderr)
+    for f in findings:
+        print(f"  {f['example']}:{f['lowering']}: {f['rule']} "
+              f"{f['message']}", file=sys.stderr)
+    sys.exit(1)
+verified, total = payload["verified_lowerings"], payload["total_lowerings"]
+assert total >= 6, f"only {total} registered lowering(s) audited"
+assert verified == total, (
+    f"only {verified}/{total} lowerings statically verified")
+print(f"kernel-verifier audit: {payload['audited_examples']} example(s) "
+      f"swept, {verified}/{total} lowerings statically verified, "
+      f"{len(payload['suppressed'])} suppression(s), "
+      "0 unsuppressed KP10xx OK")
+PY
+rm -f "$KERNELS_JSON"
+
 echo "== unified-planner audit (joint decision IR vs sequential passes, 2x4 mesh) =="
 # The unified plan optimizer's decision gate: on an 8-device CPU mesh
 # arranged 2 (data) x 4 (model), solve the joint {placement x dtype x

@@ -563,7 +563,7 @@ def test_interference_pass_direct():
 def _catalog_codes():
     text = (REPO / "ANALYSIS.md").read_text()
     return {m.group(1) for m in
-            re.finditer(r"^\|\s*(K[PJ]\d{3})\s*\|", text, re.M)}
+            re.finditer(r"^\|\s*(K[PJ]\d{3,4})\s*\|", text, re.M)}
 
 
 def test_analysis_md_documents_every_rule():

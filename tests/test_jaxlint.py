@@ -1073,3 +1073,83 @@ def test_kj016_negatives_and_suppression(tmp_path):
         "    return pl.pallas_call(body, out_shape=x)(x)  # keystone: ignore[KJ016]\n"
     )
     assert jl.lint_file(elsewhere) == []
+
+
+def test_kj017_flags_hardcoded_geometry_in_ops(tmp_path):
+    """KJ017: inside ops/, a hard-coded VMEM byte budget (MiB shift or
+    >=1 MiB constant) outside `_VMEM_BUDGET`, and a literal leading
+    block-row count in a `pl.BlockSpec` shape, each reintroduce a
+    second geometry arithmetic the KP1003 static proof cannot see."""
+    jl = _jaxlint()
+    bad = tmp_path / "ops" / "rogue_geometry.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import jax.experimental.pallas as pl\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "\n"
+        "_VMEM_BUDGET = 10 * (1 << 20)\n"      # sanctioned definition
+        "\n"
+        "\n"
+        "def choose(per_row):\n"
+        "    cap = 4 << 20\n"                   # KJ017: inline MiB shift
+        "    if per_row > 2097152:\n"           # KJ017: >=1 MiB constant
+        "        return 0\n"
+        "    return cap // per_row\n"
+        "\n"
+        "\n"
+        "def launch(body, h, w, k, x):\n"
+        "    return pl.pallas_call(\n"
+        "        body,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((8, h, w, k),\n"   # KJ017: pinned block
+        "                               lambda i: (i, 0, 0, 0),\n"
+        "                               memory_space=pltpu.VMEM)],\n"
+        "        out_shape=x,\n"
+        "    )(x)\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ017"] * 3, findings
+    assert sorted(f.line for f in findings) == [8, 9, 18]
+
+
+def test_kj017_negatives_and_suppression(tmp_path):
+    """The `_VMEM_BUDGET` definition is the sanctioned site; chooser-fed
+    block variables and broadcast-dim literals of 1 stay silent; outside
+    ops/ the rule does not run; a suppressed site (with its rationale)
+    stays silent."""
+    jl = _jaxlint()
+    clean = tmp_path / "ops" / "clean_geometry.py"
+    clean.parent.mkdir(parents=True)
+    clean.write_text(
+        "import jax.experimental.pallas as pl\n"
+        "from jax.experimental.pallas import tpu as pltpu\n"
+        "\n"
+        "_VMEM_BUDGET = 10 * (1 << 20)\n"
+        "\n"
+        "\n"
+        "def launch(body, bn, k, x):\n"
+        "    return pl.pallas_call(\n"
+        "        body,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((bn, k), lambda i: (i, 0),\n"
+        "                               memory_space=pltpu.VMEM),\n"
+        "                  pl.BlockSpec((1, k), lambda i: (0, 0),\n"
+        "                               memory_space=pltpu.VMEM)],\n"
+        "        out_shape=x,\n"
+        "    )(x)\n"
+    )
+    assert jl.lint_file(clean) == []
+
+    # outside ops/, the rule does not apply (KJ016 owns that half)
+    elsewhere = tmp_path / "analysis" / "budget_notes.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text("CAP = 12 * (1 << 20)\n")
+    assert jl.lint_file(elsewhere) == []
+
+    suppressed = tmp_path / "ops" / "legacy_chooser.py"
+    suppressed.write_text(
+        "def choose(per_img):\n"
+        "    # conv-era kernel: input-only working set, own canary\n"
+        "    return (3 << 20) // per_img  # keystone: ignore[KJ017]\n"
+    )
+    assert jl.lint_file(suppressed) == []
