@@ -197,6 +197,10 @@ def reconcile_decisions(run: Dict[str, Any]) -> Dict[str, Any]:
         e for e in trace.get("traceEvents", [])
         if e.get("ph") == "X" and e.get("name") == "chain_kernel"
     ]
+    request_spans = [
+        e for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and e.get("cat") == "request"
+    ]
 
     unique: Dict = {}
     for d in decisions:
@@ -274,6 +278,35 @@ def reconcile_decisions(run: Dict[str, Any]) -> Dict[str, Any]:
                                   .get("kernels") or []))
                     if pred_k:
                         residuals["kernel_seconds"] = pred_k - obs_sec
+        elif kind == "conformance":
+            # the watchdog's breach record joins against the live
+            # request spans at the SAME padded shape: observed is the
+            # worst request the trace holds for that shape, residual is
+            # certified bound minus observed (negative == breach held
+            # up in the artifact, not only in the counter)
+            chosen = d.get("chosen") or {}
+            shape = chosen.get("chunk_shape")
+            hits = [
+                e for e in request_spans
+                if shape is None
+                or e.get("args", {}).get("chunk_shape") == shape
+            ]
+            if hits:
+                observed["request_spans"] = len(hits)
+                obs_sec = max(
+                    float(e.get("dur", 0.0) or 0.0) / 1e6 for e in hits)
+                observed["observed_seconds"] = obs_sec
+                if "bound_seconds" in pred and pred["bound_seconds"]:
+                    residuals["bound_seconds"] = (
+                        float(pred["bound_seconds"]) - obs_sec)
+            elif "observed_seconds" in chosen:
+                # dump window may have rotated past the request span:
+                # the record itself still carries the observation
+                observed["observed_seconds"] = chosen["observed_seconds"]
+                if "bound_seconds" in pred and pred["bound_seconds"]:
+                    residuals["bound_seconds"] = (
+                        float(pred["bound_seconds"])
+                        - float(chosen["observed_seconds"]))
         rows.append({
             "seq": d.get("seq"),
             "kind": kind,

@@ -58,6 +58,21 @@ from .export import (
 )
 from .instrument import estimate_bytes, instrument_node_force, record_dispatch
 from .compile_events import compiles_snapshot, install_compile_listeners
+from .flight import (
+    FlightRecorder,
+    ensure_flight,
+    flight_recorder,
+    flight_snapshot,
+    reset_flight,
+)
+from .streaming import QuantileSketch, format_health, health, reset_live
+from .watchdog import (
+    ConformanceWatchdog,
+    active_watchdog,
+    arm_watchdog,
+    disarm_watchdog,
+    request_scope,
+)
 
 # Compile accounting is armed with the package: the monitoring hooks are
 # passive (they fire only inside jax's own compile path), and installing
@@ -78,4 +93,9 @@ __all__ = [
     "summarize", "to_chrome_trace", "write_trace",
     "estimate_bytes", "instrument_node_force", "record_dispatch",
     "compiles_snapshot", "install_compile_listeners",
+    "FlightRecorder", "ensure_flight", "flight_recorder",
+    "flight_snapshot", "reset_flight",
+    "QuantileSketch", "format_health", "health", "reset_live",
+    "ConformanceWatchdog", "active_watchdog", "arm_watchdog",
+    "disarm_watchdog", "request_scope",
 ]

@@ -4,6 +4,8 @@
     python -m keystone_tpu.telemetry --ledger <run> [--json]
     python -m keystone_tpu.telemetry --ledger <run> --emit-calibration <path>
     python -m keystone_tpu.telemetry --diff <run_a> <run_b> [--json]
+    python -m keystone_tpu.telemetry --flight <dump> [--top N] [--json]
+    python -m keystone_tpu.telemetry --live [--json]
 
 The trace form prints the span digest (top nodes by self-time, solver
 iteration and stream-chunk totals), overlap queue-stall totals, bytes
@@ -26,6 +28,20 @@ pointing ``KEYSTONE_COST_CALIBRATION`` at it makes
 `calibrate.machine_rates()` — hence every roofline classification and
 every unified-planner menu price — prefer the trace-implied rates
 whenever the recorded platform matches the live backend.
+
+``--flight`` renders a flight-recorder dump (`flight.flight_snapshot`
+/ SIGUSR2 / a watchdog breach artifact): the ring-window header
+(capacity, spans held, evictions, in-flight-at-dump count) followed by
+the ordinary trace digest — a dump IS a Chrome trace, so every other
+consumer (``--ledger``, reconcile, ``perf_table.py --trace``) accepts
+it unchanged.
+
+``--live`` renders this process's live-health view
+(`streaming.health`): per-(pipeline, padded-shape) apply-latency
+percentiles from the streaming sketches, throughput, in-flight depth,
+conformance check/breach counters, and the armed watchdog's
+certificate digest. (Meaningful in-process — e.g. from a serving
+wrapper's debug hook; a fresh CLI process reports an empty table.)
 
 ``--diff`` is run-over-run regression detection between two runs'
 ledgers: config kill-switch flips are named by env var (an injected
@@ -158,6 +174,48 @@ def _diff_main(path_a: str, path_b: str, as_json: bool) -> int:
     return 1 if diff["regressions"] else 0
 
 
+def _flight_main(path: str, top: int, as_json: bool) -> int:
+    try:
+        trace = load_trace(path)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    meta = trace.get("keystone", {}).get("flight") or {}
+    incomplete = sum(
+        1 for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and e.get("args", {}).get("incomplete"))
+    if as_json:
+        json.dump({
+            "flight": meta,
+            "incomplete_spans": incomplete,
+            "metrics": trace.get("keystone", {}).get("metrics", {}),
+            "spans": aggregate_spans(trace),
+        }, sys.stdout, indent=1)
+        print()
+        return 0
+    if meta:
+        dropped = int(meta.get("dropped_spans", 0))
+        print(f"flight dump: {int(meta.get('spans_held', 0))}/"
+              f"{int(meta.get('capacity', 0))} span(s) in ring, "
+              f"{dropped} evicted before dump, "
+              f"{incomplete} in-flight at dump")
+        print()
+    print(summarize(trace, top=top))
+    return 0
+
+
+def _live_main(as_json: bool) -> int:
+    from .streaming import format_health, health
+
+    h = health()
+    if as_json:
+        json.dump(h, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        print(format_health(h))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m keystone_tpu.telemetry",
@@ -177,6 +235,14 @@ def main(argv=None) -> int:
     p.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
                    help="run-over-run regression detection between two "
                         "runs' ledgers (exit 1 on any regression)")
+    p.add_argument("--flight", metavar="DUMP",
+                   help="render a flight-recorder dump: ring-window "
+                        "header (capacity / evictions / in-flight "
+                        "spans) followed by the trace digest")
+    p.add_argument("--live", action="store_true",
+                   help="render this process's live health view "
+                        "(streaming latency percentiles, throughput, "
+                        "conformance counters, armed watchdog)")
     p.add_argument("--emit-calibration", metavar="PATH",
                    help="with --ledger: persist the run's drift-implied "
                         "cost weights as a tpu_calibration.json-schema "
@@ -191,8 +257,13 @@ def main(argv=None) -> int:
     if args.ledger:
         return _ledger_main(args.ledger, args.as_json,
                             emit_calibration=args.emit_calibration)
+    if args.live:
+        return _live_main(args.as_json)
+    if args.flight:
+        return _flight_main(args.flight, args.top, args.as_json)
     if not args.trace:
-        p.error("a trace path, --ledger, or --diff is required")
+        p.error("a trace path, --ledger, --diff, --flight, or --live "
+                "is required")
     try:
         trace = load_trace(args.trace)
     except (OSError, ValueError) as e:

@@ -32,24 +32,37 @@ def to_chrome_trace(tracer: Tracer) -> Dict[str, Any]:
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
         "args": {"name": "keystone_tpu"},
     }]
-    for s in tracer.spans:
+    now = tracer.now()
+    closed = list(tracer.spans)  # snapshot: appends may race the export
+    seen = {id(s) for s in closed}
+    # In-flight spans export as complete events running to "now", marked
+    # ``args.incomplete`` — a dump racing an open span (flight snapshot,
+    # atexit flush mid-run) stays fully parseable instead of silently
+    # dropping the span that was on the CPU when the dump fired.
+    open_spans = [s for s in tracer.open_spans() if id(s) not in seen]
+    for s, incomplete in ([(s, False) for s in closed]
+                          + [(s, True) for s in open_spans]):
         args = dict(s.args)
         args["span_id"] = s.sid
         if s.parent is not None:
             args["parent_id"] = s.parent
         if s.error:
             args["error"] = True
+        dur = s.dur
+        if incomplete:
+            args["incomplete"] = True
+            dur = max(0.0, now - s.t0)
         events.append({
             "name": s.name,
             "cat": s.cat,
             "ph": "X",
             "ts": round(s.t0 * 1e6, 3),
-            "dur": round(s.dur * 1e6, 3),
+            "dur": round(dur * 1e6, 3),
             "pid": pid,
             "tid": s.tid,
             "args": args,
         })
-    for name, t, value, tid in tracer.counter_samples:
+    for name, t, value, tid in list(tracer.counter_samples):
         events.append({
             "name": name,
             "ph": "C",
@@ -271,8 +284,12 @@ def summarize(trace: Dict[str, Any], top: int = 15) -> str:
     analyzer's static estimates) the static-vs-observed memory
     reconciliation table."""
     lines: List[str] = []
-    n_events = len(_complete_events(trace))
-    lines.append(f"{n_events} span(s)")
+    events = _complete_events(trace)
+    n_events = len(events)
+    n_open = sum(1 for e in events
+                 if e.get("args", {}).get("incomplete"))
+    open_note = f" ({n_open} in-flight at dump)" if n_open else ""
+    lines.append(f"{n_events} span(s){open_note}")
 
     for cat, title in (("node", "top node forces by self-time"),
                        ("step", "solver iterations"),

@@ -60,9 +60,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 LEDGER_VERSION = 1
 
-#: decision kinds the optimizer rules emit.
+#: decision kinds the optimizer rules emit — plus "conformance", the
+#: runtime watchdog's record kind: a live apply that breached its KP903
+#: certified bound (bound vs observed vs flight-dump artifact).
 KINDS = ("fusion", "megafusion", "placement", "precision", "chunk",
-         "cache", "kernel")
+         "cache", "kernel", "conformance")
 
 #: the config fields a run header snapshots, with the env var that
 #: flips each — the channel by which ``--diff`` names a kill-switch
@@ -78,6 +80,7 @@ CONFIG_ENV = {
     "aot_warmup": "KEYSTONE_AOT_WARMUP",
     "overlap": "KEYSTONE_OVERLAP",
     "pallas_kernels": "KEYSTONE_CHAIN_KERNELS",
+    "live_telemetry": "KEYSTONE_LIVE_TELEMETRY",
 }
 
 _LOCK = threading.Lock()
@@ -618,6 +621,7 @@ _KIND_FIELDS = {
     "chunk": ("unified_planner",),
     "cache": ("unified_planner",),
     "kernel": ("pallas_kernels", "unified_planner"),
+    "conformance": ("live_telemetry",),
 }
 
 

@@ -87,6 +87,10 @@ class Tracer:
         self.metadata: Dict[str, Any] = {}
         self._ids = itertools.count(1)
         self._local = threading.local()
+        # sid → still-open SpanRecord, so a dump/export racing an open
+        # span can emit it as incomplete-but-parseable instead of
+        # dropping it (dict add/pop are atomic under the GIL)
+        self._open: Dict[int, SpanRecord] = {}
 
     # ------------------------------------------------------------ spans
 
@@ -108,6 +112,7 @@ class Tracer:
             args,
         )
         st.append(rec)
+        self._open[rec.sid] = rec
         return rec
 
     def end(self, rec: SpanRecord, error: bool = False, **args) -> None:
@@ -121,7 +126,10 @@ class Tracer:
             st.pop()
         if st:
             st.pop()
+        self._open.pop(rec.sid, None)
         self.spans.append(rec)
+        if _TEES:
+            _tee_span(self, rec)
 
     def record_complete(self, name: str, cat: str, t0: float, dur: float,
                         error: bool = False, **args) -> SpanRecord:
@@ -138,17 +146,26 @@ class Tracer:
         rec.dur = dur
         rec.error = error
         self.spans.append(rec)
+        if _TEES:
+            _tee_span(self, rec)
         return rec
 
     def now(self) -> float:
         """Seconds since this tracer's epoch (for `record_complete`)."""
         return time.perf_counter() - self.epoch
 
+    def open_spans(self) -> List[SpanRecord]:
+        """Snapshot of the spans still open right now (dump/export use:
+        each is emitted as an incomplete-but-parseable event). The list
+        is a copy; the records themselves are live."""
+        return list(self._open.values())
+
     def counter_sample(self, name: str, value: float) -> None:
-        self.counter_samples.append(
-            (name, time.perf_counter() - self.epoch, value,
-             threading.get_ident())
-        )
+        t = time.perf_counter() - self.epoch
+        tid = threading.get_ident()
+        self.counter_samples.append((name, t, value, tid))
+        if _TEES:
+            _tee_counter(self, name, t, value, tid)
 
     # ------------------------------------------------- live-set tracking
 
@@ -201,6 +218,54 @@ class _NoopSpan:
 
 
 _NOOP = _NoopSpan()
+
+# ------------------------------------------------------------------ tees
+#
+# A tee is a passive sink (the flight recorder) that receives a copy of
+# every CLOSED span and counter sample any tracer records — so the
+# always-on ring stays populated even while a scoped `trace_run` tracer
+# owns the active slot. The registry is an immutable tuple swapped
+# whole-sale (read is one global load; the hot path pays a falsy check
+# when no tee is installed). A tee that is itself a Tracer never
+# receives its own records.
+
+_TEES: tuple = ()
+
+
+def add_tee(sink) -> None:
+    """Register ``sink`` (needs ``tee_span(src, rec)`` and
+    ``tee_counter(src, name, t, value, tid)``) to receive copies of all
+    closed spans / counter samples from every tracer. Idempotent."""
+    global _TEES
+    if sink not in _TEES:
+        _TEES = _TEES + (sink,)
+
+
+def remove_tee(sink) -> None:
+    global _TEES
+    _TEES = tuple(s for s in _TEES if s is not sink)
+
+
+def _tee_span(src: Tracer, rec: SpanRecord) -> None:
+    for sink in _TEES:
+        if sink is src:
+            continue
+        try:
+            sink.tee_span(src, rec)
+        except Exception:
+            pass  # telemetry must never take down the measured run
+
+
+def _tee_counter(src: Tracer, name: str, t: float, value: float,
+                 tid: int) -> None:
+    for sink in _TEES:
+        if sink is src:
+            continue
+        try:
+            sink.tee_counter(src, name, t, value, tid)
+        except Exception:
+            pass
+
 
 # ---------------------------------------------------------------- active
 

@@ -1,0 +1,293 @@
+"""Streaming quantile sketches and the live serving-latency table.
+
+A long-lived serving process must answer "what is my apply-latency p99
+for batch shape 256 right now?" without retaining samples: this module
+keeps one `QuantileSketch` per (pipeline, padded ladder shape) — a
+fixed-memory histogram sketch in the Ben-Haim/Yom-Tov streaming style
+(the same family as t-digest / Hive's NumericHistogram) — plus
+queue-depth and throughput gauges, all surfaced through `health()` and
+the ``python -m keystone_tpu.telemetry --live`` CLI rendering.
+
+Sketch properties:
+
+  - fixed memory: at most ``max_bins`` (centroid, count) pairs, ~1 KiB
+    per sketch at the default 64 bins, regardless of observation count;
+  - mergeable: ``merge`` combines two sketches bin-wise then re-compacts
+    — per-thread or per-process sketches can be unioned for a fleet
+    view without sample exchange;
+  - exact count / sum / min / max ride alongside, so totals and worst
+    cases are never approximated — only interior quantiles are, with
+    error shrinking as mass concentrates (unimodal latency
+    distributions, the serving case, resolve p50/p99 to well under the
+    bin width).
+
+The table itself is process-global and lock-guarded (observations are
+per-apply, not per-element — contention is irrelevant), reset by
+`reset_live()` (tests; a fresh bench tier), and fed by
+`watchdog.request_scope` so it populates exactly when the live
+telemetry plane is armed (``KEYSTONE_LIVE_TELEMETRY`` — see
+`workflow.env.ExecutionConfig`).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: default sketch width: 64 (centroid, count) bins ≈ 1 KiB — interior
+#: quantile error for unimodal latency data is well under one bin width
+DEFAULT_MAX_BINS = 64
+
+_LOCK = threading.Lock()
+
+
+class QuantileSketch:
+    """Fixed-memory streaming quantile sketch (Ben-Haim/Yom-Tov
+    streaming-parallel decision-tree histogram): keep at most
+    ``max_bins`` weighted centroids sorted by value; inserting past
+    capacity merges the two closest adjacent centroids (weighted mean).
+    Quantiles interpolate the cumulative weight curve. All mutation is
+    caller-locked (the module table holds one lock) or single-threaded.
+    """
+
+    __slots__ = ("max_bins", "count", "total", "min", "max", "_bins")
+
+    def __init__(self, max_bins: int = DEFAULT_MAX_BINS):
+        if max_bins < 2:
+            raise ValueError("max_bins must be >= 2")
+        self.max_bins = int(max_bins)
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._bins: List[List[float]] = []  # [value, weight], sorted
+
+    # ---------------------------------------------------------- update
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        values = [b[0] for b in self._bins]
+        i = bisect.bisect_left(values, v)
+        if i < len(self._bins) and self._bins[i][0] == v:
+            self._bins[i][1] += 1.0
+        else:
+            self._bins.insert(i, [v, 1.0])
+            self._compact()
+
+    def _compact(self) -> None:
+        while len(self._bins) > self.max_bins:
+            # merge the closest adjacent pair (weighted mean) — O(bins)
+            # per insert past capacity, bins is a small constant
+            best_i = 0
+            best_gap = float("inf")
+            for i in range(len(self._bins) - 1):
+                gap = self._bins[i + 1][0] - self._bins[i][0]
+                if gap < best_gap:
+                    best_gap = gap
+                    best_i = i
+            a, b = self._bins[best_i], self._bins[best_i + 1]
+            w = a[1] + b[1]
+            self._bins[best_i] = [(a[0] * a[1] + b[0] * b[1]) / w, w]
+            del self._bins[best_i + 1]
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other`` into self (bin-wise union, then re-compact).
+        Exact aggregates add; returns self for chaining."""
+        for v, w in other._bins:
+            values = [b[0] for b in self._bins]
+            i = bisect.bisect_left(values, v)
+            if i < len(self._bins) and self._bins[i][0] == v:
+                self._bins[i][1] += w
+            else:
+                self._bins.insert(i, [v, w])
+        self._compact()
+        self.count += other.count
+        self.total += other.total
+        if other.min is not None and (self.min is None or other.min < self.min):
+            self.min = other.min
+        if other.max is not None and (self.max is None or other.max > self.max):
+            self.max = other.max
+        return self
+
+    # ----------------------------------------------------------- query
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1]); 0.0 when empty. The
+        cumulative-weight curve is interpolated between centroids;
+        extremes clamp to the exact observed min/max."""
+        if not self._bins or self.count == 0:
+            return 0.0
+        q = max(0.0, min(1.0, q))
+        target = q * self.count
+        if target <= self._bins[0][1] * 0.5:
+            return self.min if self.min is not None else self._bins[0][0]
+        cum = 0.0
+        for i, (v, w) in enumerate(self._bins):
+            mid = cum + w * 0.5
+            if target <= mid:
+                if i == 0:
+                    prev_v = self.min if self.min is not None else v
+                    prev_mid = 0.0
+                else:
+                    pv, pw = self._bins[i - 1]
+                    prev_v = pv
+                    prev_mid = cum - pw * 0.5
+                denom = mid - prev_mid
+                frac = (target - prev_mid) / denom if denom > 0 else 1.0
+                return prev_v + (v - prev_v) * frac
+            cum += w
+        return self.max if self.max is not None else self._bins[-1][0]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "total": self.total,
+            "min": self.min if self.min is not None else 0.0,
+            "max": self.max if self.max is not None else 0.0,
+            "mean": self.mean,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+            "bins": len(self._bins),
+        }
+
+
+# ---------------------------------------------------------------- table
+#
+# (pipeline, padded chunk shape) → QuantileSketch of apply-latency
+# seconds, plus process throughput/in-flight accounting. Keys are
+# strings so the health dict is JSON-ready.
+
+_sketches: Dict[Tuple[str, int], QuantileSketch] = {}
+_started: Optional[float] = None
+_last_request: Optional[float] = None
+
+
+def observe_apply(pipeline: str, chunk_shape: int, seconds: float) -> None:
+    """Record one live apply latency under its padded ladder shape."""
+    global _started, _last_request
+    key = (str(pipeline), int(chunk_shape))
+    now = time.time()  # keystone: ignore[KJ004] — wall anchor for throughput, not a duration
+    with _LOCK:
+        sk = _sketches.get(key)
+        if sk is None:
+            sk = _sketches[key] = QuantileSketch()
+        sk.observe(seconds)
+        if _started is None:
+            _started = now
+        _last_request = now
+
+
+def latency_sketch(pipeline: str, chunk_shape: int) -> Optional[QuantileSketch]:
+    with _LOCK:
+        return _sketches.get((str(pipeline), int(chunk_shape)))
+
+
+def reset_live() -> None:
+    """Drop all live sketch state (tests; a fresh bench tier)."""
+    global _started, _last_request
+    with _LOCK:
+        _sketches.clear()
+        _started = None
+        _last_request = None
+
+
+def health() -> Dict[str, Any]:
+    """JSON-ready live-health view: per-(pipeline, shape) latency
+    percentiles from the sketches, request totals and throughput, the
+    in-flight/queue-depth gauges, breach counters, and — when a
+    watchdog is armed — its certificate digest. This is the payload the
+    ``--live`` CLI renders and a serving wrapper would export."""
+    from .metrics import registry
+
+    with _LOCK:
+        rows = [
+            {
+                "pipeline": pipe,
+                "chunk_shape": shape,
+                **sk.snapshot(),
+            }
+            for (pipe, shape), sk in sorted(_sketches.items())
+        ]
+        started = _started
+        last = _last_request
+    total = sum(r["count"] for r in rows)
+    window = (last - started) if (started is not None and last is not None
+                                  and last > started) else 0.0
+    reg = registry()
+    gauges = {name: g.snapshot() for name, g in sorted(reg.gauges.items())
+              if name.startswith(("serving.", "prefetch.", "overlap."))}
+    counters = {name: c.snapshot()
+                for name, c in sorted(reg.counters.items())
+                if name.startswith("serving.")}
+    out: Dict[str, Any] = {
+        "requests": total,
+        "throughput_rps": (total - 1) / window if window > 0 and total > 1
+        else 0.0,
+        "latency": rows,
+        "gauges": gauges,
+        "counters": counters,
+    }
+    from .watchdog import active_watchdog
+
+    wd = active_watchdog()
+    if wd is not None:
+        out["watchdog"] = wd.describe()
+    return out
+
+
+def format_health(h: Dict[str, Any]) -> str:
+    """Human rendering of a `health()` dict (the ``--live`` CLI)."""
+    lines: List[str] = []
+    lines.append(
+        f"live telemetry: {int(h.get('requests', 0))} request(s), "
+        f"{h.get('throughput_rps', 0.0):.2f} req/s")
+    rows = h.get("latency") or []
+    if rows:
+        lines.append("")
+        lines.append(f"{'pipeline':<28} {'shape':>7} {'count':>7} "
+                     f"{'p50 ms':>9} {'p90 ms':>9} {'p99 ms':>9} "
+                     f"{'max ms':>9}")
+        for r in rows:
+            lines.append(
+                f"{str(r['pipeline'])[:28]:<28} {int(r['chunk_shape']):>7} "
+                f"{int(r['count']):>7} {r['p50'] * 1e3:>9.2f} "
+                f"{r['p90'] * 1e3:>9.2f} {r['p99'] * 1e3:>9.2f} "
+                f"{r['max'] * 1e3:>9.2f}")
+    counters = h.get("counters") or {}
+    breaches = counters.get("serving.slo_breaches", {}).get("value", 0)
+    checked = counters.get("serving.conformance_checks", {}).get("value", 0)
+    if checked or breaches:
+        lines.append("")
+        lines.append(f"conformance: {int(checked)} check(s), "
+                     f"{int(breaches)} breach(es)")
+    gauges = h.get("gauges") or {}
+    inflight = gauges.get("serving.inflight")
+    if inflight:
+        lines.append(f"in-flight: {int(inflight.get('value', 0))} "
+                     f"(peak {int(inflight.get('max', 0))})")
+    wd = h.get("watchdog")
+    if wd:
+        state = "armed" if wd.get("armed") else "disarmed"
+        shapes = wd.get("shapes") or {}
+        lines.append("")
+        lines.append(
+            f"watchdog: {state} [{wd.get('pipeline', '?')}], "
+            f"{len(shapes)} certified shape(s), SLO "
+            f"{(wd.get('slo_seconds') or 0) * 1e3:.0f}ms")
+        for shape in sorted(shapes, key=int):
+            lines.append(f"  shape {shape}: bound "
+                         f"{shapes[shape] * 1e3:.2f}ms")
+    return "\n".join(lines)

@@ -1,0 +1,280 @@
+"""Certificate-conformance watchdog: the live half of the KP9xx story.
+
+`analysis.serving.serving_pass` proves, statically, that every ladder
+shape's apply latency fits under a certified bound (KP903). Until now
+that proof was only ever *audited* after the fact
+(`reconcile_serving`). The watchdog closes the loop at runtime: arm it
+with a fitted pipeline's certificate record (`ServingCertificate
+.as_record()` — the exact ``keystone.serving`` trace payload) and every
+live apply's wall-clock is checked against its padded-shape bound the
+moment the request finishes. A breach:
+
+  1. increments ``serving.slo_breaches`` (and every check increments
+     ``serving.conformance_checks``);
+  2. dumps the flight recorder (`flight.flight_snapshot`, tagged
+     ``breach``) so the ring's context around the slow request is
+     preserved;
+  3. emits a ledger ``kind="conformance"`` record joining the static
+     bound, the observed latency, and the dump artifact — renderable by
+     ``--ledger`` and joined by `reconcile.reconcile_decisions` like
+     any optimizer decision.
+
+`request_scope` is the per-apply instrumentation the executor path
+wraps around `FittedPipeline.apply`: it tags the request with its
+padded ladder shape (`utils.batching._pad_target`, the same arithmetic
+the dispatcher pads by, so live shapes join the certificate's shape
+table exactly), feeds the streaming latency sketches, maintains the
+``serving.inflight`` gauge, and runs the conformance check. With
+``KEYSTONE_LIVE_TELEMETRY=0`` it is a no-op context manager — the
+kill-switch bit-for-bit contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+from .metrics import counter, gauge, histogram
+
+
+def _live_enabled() -> bool:
+    from ..workflow.env import execution_config
+
+    try:
+        return bool(execution_config().live_telemetry)
+    except Exception:
+        return True
+
+
+class ConformanceWatchdog:
+    """Per-shape bound table + breach policy for ONE armed pipeline.
+
+    ``bounds`` maps padded ladder batch → certified seconds (the
+    certificate's per-shape ``predicted_seconds``, i.e. the KP903
+    bound). A live shape with no exact entry conservatively borrows the
+    bound of the smallest certified batch that covers it (bounds are
+    monotone in batch); shapes larger than every certified batch are
+    out of envelope — counted (``serving.uncovered_shapes``), never
+    breached, because the certificate makes no claim about them."""
+
+    def __init__(self, pipeline: str, bounds: Dict[int, float],
+                 slo_seconds: Optional[float] = None,
+                 certified: bool = False):
+        self.pipeline = str(pipeline)
+        self.bounds = {int(k): float(v) for k, v in bounds.items()}
+        self.slo_seconds = slo_seconds
+        self.certified = bool(certified)
+        self.checked = 0
+        self.breaches = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_certificate(cls, record: Dict[str, Any],
+                         pipeline: str = "pipeline",
+                         ) -> Optional["ConformanceWatchdog"]:
+        """Build from a `ServingCertificate.as_record()` payload (the
+        ``keystone.serving`` trace metadata / `certify_example` report
+        form). None when the record carries no priced shapes."""
+        shapes = (record or {}).get("shapes") or []
+        bounds = {}
+        for s in shapes:
+            try:
+                bounds[int(s["batch"])] = float(s["predicted_seconds"])
+            except (KeyError, TypeError, ValueError):
+                continue
+        if not bounds:
+            return None
+        return cls(pipeline, bounds,
+                   slo_seconds=record.get("slo_seconds"),
+                   certified=bool(record.get("certified")))
+
+    def bound_for(self, chunk_shape: int) -> Optional[float]:
+        chunk_shape = int(chunk_shape)
+        b = self.bounds.get(chunk_shape)
+        if b is not None:
+            return b
+        covering = [n for n in self.bounds if n >= chunk_shape]
+        if covering:
+            return self.bounds[min(covering)]
+        return None
+
+    def check(self, chunk_shape: int, seconds: float,
+              batch: Optional[int] = None) -> bool:
+        """Audit one finished apply; returns True when it breached.
+        Breach handling (dump + ledger record) happens inline — it is
+        cheap (ring copy + one JSON write) and only on the slow path."""
+        bound = self.bound_for(chunk_shape)
+        with self._lock:
+            self.checked += 1
+        counter("serving.conformance_checks").inc()
+        if bound is None:
+            counter("serving.uncovered_shapes").inc()
+            return False
+        if seconds <= bound:
+            return False
+        with self._lock:
+            self.breaches += 1
+        counter("serving.slo_breaches").inc()
+        from .flight import flight_snapshot
+
+        dump = flight_snapshot(tag="breach")
+        from .ledger import record_decision
+
+        record_decision(
+            kind="conformance",
+            rule="ConformanceWatchdog",
+            vertices=[],
+            labels=[self.pipeline, f"shape={int(chunk_shape)}"],
+            chosen={
+                "entry": "breach",
+                "observed_seconds": float(seconds),
+                "chunk_shape": int(chunk_shape),
+                "batch": int(batch) if batch is not None else None,
+                "flight_dump": dump,
+            },
+            alternatives=[{
+                "entry": "within certified bound",
+                "cost_seconds": float(bound),
+            }],
+            predicted={
+                "bound_seconds": float(bound),
+                "slo_seconds": self.slo_seconds,
+                "certified": self.certified,
+            },
+            enforced=False,  # the watchdog observes; it does not gate
+        )
+        return True
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-ready digest for `streaming.health` / the --live CLI."""
+        with self._lock:
+            checked, breaches = self.checked, self.breaches
+        return {
+            "armed": True,
+            "pipeline": self.pipeline,
+            "certified": self.certified,
+            "slo_seconds": self.slo_seconds,
+            "shapes": {str(n): b for n, b in sorted(self.bounds.items())},
+            "checked": checked,
+            "breaches": breaches,
+        }
+
+
+# ----------------------------------------------------------- arm / disarm
+
+_active_watchdog: Optional[ConformanceWatchdog] = None
+_arm_lock = threading.Lock()
+
+
+def active_watchdog() -> Optional[ConformanceWatchdog]:
+    return _active_watchdog
+
+
+def arm_watchdog(record: Dict[str, Any],
+                 pipeline: str = "pipeline") -> Optional[ConformanceWatchdog]:
+    """Arm (or re-arm) the process watchdog from a certificate record.
+    Returns the watchdog, or None when the record has no shapes or the
+    live telemetry plane is disabled."""
+    global _active_watchdog
+    if not _live_enabled():
+        return None
+    wd = ConformanceWatchdog.from_certificate(record, pipeline=pipeline)
+    if wd is None:
+        return None
+    with _arm_lock:
+        _active_watchdog = wd
+    from .flight import ensure_flight
+
+    ensure_flight()  # breach dumps need the ring recording already
+    return wd
+
+
+def disarm_watchdog() -> None:
+    global _active_watchdog
+    with _arm_lock:
+        _active_watchdog = None
+
+
+def maybe_arm_from_certificate(record: Optional[Dict[str, Any]],
+                               pipeline: str = "pipeline") -> None:
+    """Executor hook: when a run embeds its serving certificate
+    (``KEYSTONE_SLO_MS`` armed → `_record_static_estimates` computes
+    ``keystone.serving``), arm the watchdog against it so subsequent
+    applies in the same process are conformance-checked. Never raises;
+    an already-armed watchdog for the same pipeline is refreshed."""
+    if not record:
+        return
+    try:
+        arm_watchdog(record, pipeline=pipeline)
+    except Exception:
+        pass  # telemetry must never take down the measured run
+
+
+# ------------------------------------------------------ per-request scope
+
+
+def _padded_shape(batch: int) -> int:
+    """The padded leading dim this request dispatches under — the SAME
+    arithmetic the chunk planner uses (`_pad_target` with the resolved
+    chunk rows), so live observations key into the certificate's ladder
+    shape table exactly."""
+    from ..analysis.memory import resolve_chunk_rows
+    from ..utils.batching import _pad_target
+
+    chunk = resolve_chunk_rows(None)
+    return int(_pad_target(int(batch), chunk, int(batch)))
+
+
+@contextmanager
+def request_scope(batch: int, pipeline: str = "pipeline"):
+    """Instrument one live apply request.
+
+    Emits a ``cat="request"`` span (into the active tracer when one is
+    scoped, else directly into the flight ring), maintains
+    ``serving.requests`` / ``serving.inflight`` / the
+    ``serving.apply_seconds`` histogram, feeds the per-shape streaming
+    sketch, and runs the conformance check on exit. Exceptions
+    propagate (marked on the span) — instrumentation never swallows
+    the pipeline's own failure. No-op when
+    ``KEYSTONE_LIVE_TELEMETRY=0``."""
+    if not _live_enabled():
+        yield None
+        return
+    batch = int(batch)
+    chunk_shape = _padded_shape(batch)
+    counter("serving.requests").inc()
+    inflight = gauge("serving.inflight")
+    inflight.add(1)
+    from .flight import ensure_flight
+    from .spans import current_tracer
+
+    tracer = current_tracer()
+    sink = tracer if tracer is not None else ensure_flight()
+    t0 = sink.now() if sink is not None else 0.0
+    error = False
+    try:
+        yield chunk_shape
+    except BaseException:
+        error = True
+        raise
+    finally:
+        inflight.add(-1)
+        if sink is not None:
+            dur = sink.now() - t0
+            sink.record_complete(
+                "apply_request", "request", t0, dur, error=error,
+                batch=batch, chunk_shape=chunk_shape, pipeline=pipeline)
+        else:  # live plane on but flight creation failed: still time it
+            dur = 0.0
+        if not error and dur > 0.0:
+            histogram("serving.apply_seconds").observe(dur)
+            from .streaming import observe_apply
+
+            observe_apply(pipeline, chunk_shape, dur)
+            wd = active_watchdog()
+            if wd is not None:
+                try:
+                    wd.check(chunk_shape, dur, batch=batch)
+                except Exception:
+                    pass  # a watchdog bug must never break serving

@@ -194,6 +194,13 @@ class ExecutionConfig:
     ``KEYSTONE_CHAIN_KERNELS=interpret`` forces the interpret-mode swap
     (the e2e test hook). ``=0`` is bit-for-bit: programs are exactly
     the XLA form.
+
+    ``live_telemetry`` (``KEYSTONE_LIVE_TELEMETRY``) arms the live
+    telemetry plane: the bounded flight recorder, streaming latency
+    sketches, per-apply request spans, and the KP9xx conformance
+    watchdog (``telemetry/flight.py`` / ``streaming.py`` /
+    ``watchdog.py``). ``=0`` is bit-for-bit the post-hoc-only behavior:
+    no request spans, no sketch updates, no watchdog checks.
     """
 
     overlap: bool = True
@@ -214,6 +221,7 @@ class ExecutionConfig:
     unified_planner: bool = True
     unified_min_savings_seconds: float = 5e-3
     pallas_kernels: bool = True
+    live_telemetry: bool = True
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -329,6 +337,8 @@ def execution_config() -> ExecutionConfig:
                 "KEYSTONE_UNIFIED_MIN_SAVINGS_S", "5e-3"))),
             pallas_kernels=os.environ.get(
                 "KEYSTONE_CHAIN_KERNELS", "1").lower() not in _OFF,
+            live_telemetry=os.environ.get(
+                "KEYSTONE_LIVE_TELEMETRY", "1").lower() not in _OFF,
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

@@ -392,7 +392,20 @@ class GraphExecutor:
                     cert, _ = serving_pass(
                         graph, specs, envelope, memory=est,
                         roofline=roof, record=False)
-                    tracer.metadata["serving"] = cert.as_record()
+                    record = cert.as_record()
+                    tracer.metadata["serving"] = record
+                    # live half: arm the conformance watchdog against
+                    # the certificate just embedded, so every later
+                    # apply in this process is checked online against
+                    # its padded-shape KP903 bound (no-op when
+                    # KEYSTONE_LIVE_TELEMETRY=0)
+                    from ..telemetry.watchdog import (
+                        maybe_arm_from_certificate,
+                    )
+
+                    maybe_arm_from_certificate(
+                        record,
+                        pipeline=cert.dominating_stage or "pipeline")
             except Exception:
                 pass
         except Exception:  # estimation must never break execution

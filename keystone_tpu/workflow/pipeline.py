@@ -350,13 +350,25 @@ class FittedPipeline(Chainable):
         return self.to_pipeline().validate(source_spec, **kwargs)
 
     def apply(self, data: Any):
+        # every apply is one live serving request: request_scope tags it
+        # with its padded ladder shape, feeds the streaming latency
+        # sketches, and runs the conformance watchdog when armed (a
+        # no-op context when KEYSTONE_LIVE_TELEMETRY=0)
+        from ..telemetry.watchdog import request_scope
+
         if getattr(data, "is_dataset", False):
-            g, nid = self.graph.add_node(DatasetOperator(data), [])
+            try:
+                batch = len(data)
+            except TypeError:
+                batch = 1
+            with request_scope(batch, pipeline="fitted_pipeline"):
+                g, nid = self.graph.add_node(DatasetOperator(data), [])
+                g = g.replace_dependency(self.source, nid).remove_source(self.source)
+                return PipelineDataset(GraphExecutor(g, optimize=False), self.sink).get()
+        with request_scope(1, pipeline="fitted_pipeline"):
+            g, nid = self.graph.add_node(DatumOperator(data), [])
             g = g.replace_dependency(self.source, nid).remove_source(self.source)
-            return PipelineDataset(GraphExecutor(g, optimize=False), self.sink).get()
-        g, nid = self.graph.add_node(DatumOperator(data), [])
-        g = g.replace_dependency(self.source, nid).remove_source(self.source)
-        return PipelineDatum(GraphExecutor(g, optimize=False), self.sink).get()
+            return PipelineDatum(GraphExecutor(g, optimize=False), self.sink).get()
 
     def __call__(self, data: Any):
         return self.apply(data)

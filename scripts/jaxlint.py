@@ -191,6 +191,20 @@ system cannot (see ANALYSIS.md for the full catalog):
          geometry through the shared chooser, or suppress with a
          rationale naming the kernel-specific working set.
 
+  KJ018  trace-time-telemetry (under ``workflow/`` and ``nodes/``):
+         a span or metric emission (``span(...)``, ``counter/gauge/
+         histogram(...).inc/observe/...``) lexically inside a fused-
+         program body — a ``fuse()``/``_chunk_loop`` body, or a
+         nested closure of ``_build_program`` (its host prologue is
+         build-time code; only the traced ``chunk_fn``/``per_shard``
+         closures become program body). Those bodies execute at TRACE
+         time: the emission fires once per compile, not once per run,
+         so the recorded "latency" is trace-time, live percentile
+         sketches ingest garbage, and re-runs of the warm program
+         emit nothing at all. Instrument at the dispatch boundary
+         (the executor / instrument layer) instead, or suppress with
+         a rationale naming why the call is host-side.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -277,6 +291,11 @@ RULES = {
              "static KP1003 proof and the runtime chooser share one "
              "formula (chain_vmem_bytes/chain_block_rows); inline "
              "byte caps and pinned block sizes dodge it",
+    "KJ018": "span/metric emission inside a fused-program body "
+             "(fuse()/_chunk_loop, or a _build_program closure): the "
+             "body runs at trace time, so the emission records "
+             "compile-time not run-time and corrupts live latency "
+             "percentiles — instrument at the dispatch boundary",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -1145,6 +1164,79 @@ def _check_dynamic_metric_name(tree: ast.AST, path: str
             "dimension in a span arg")
 
 
+def _kj018_emission_name(call: ast.Call):
+    """The telemetry emission a call expresses — ``span``, a metric
+    factory (``counter``/``gauge``/``histogram``), or a tracer
+    ``counter_sample`` — or None. Attribute forms require a telemetry
+    receiver (``telemetry.*`` / ``metrics.*`` / ``spans.*`` modules, a
+    ``registry()``/``current_tracer()`` call, or a ``tracer`` object)
+    so unrelated APIs sharing a name never false-positive."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        base = func.id.lstrip("_")
+        if base == "span" or base in _METRIC_FACTORIES:
+            return base
+        return None
+    if isinstance(func, ast.Attribute):
+        base = func.attr.lstrip("_")
+        if base != "span" and base != "counter_sample" \
+                and base not in _METRIC_FACTORIES:
+            return None
+        recv = func.value
+        if isinstance(recv, ast.Call):
+            rf = recv.func
+            rname = (rf.id if isinstance(rf, ast.Name)
+                     else rf.attr if isinstance(rf, ast.Attribute)
+                     else "")
+            if rname.lstrip("_") in ("registry", "current_tracer"):
+                return base
+            return None
+        last = (recv.attr if isinstance(recv, ast.Attribute)
+                else recv.id if isinstance(recv, ast.Name)
+                else "")
+        if last.lstrip("_") in ("telemetry", "metrics", "spans", "tracer"):
+            return base
+    return None
+
+
+def _check_trace_time_telemetry(tree: ast.AST, path: str
+                                ) -> Iterator[Finding]:
+    """KJ018 (under ``workflow/``/``nodes/``): a span or metric
+    emission lexically inside a fused-program body. ``fuse()`` and
+    ``_chunk_loop`` bodies are traced wholesale; ``_build_program`` is
+    different — its top level is host build code (a build-time counter
+    there is legitimate), but its nested ``chunk_fn``/``per_shard``
+    closures ARE the traced program body, so only nested defs/lambdas
+    are scanned there. An emission in traced code fires once per
+    COMPILE, not once per run: the recorded latency is trace-time, the
+    live percentile sketches ingest garbage, and warm re-runs emit
+    nothing — the non-obvious twin of KJ002's numpy-under-jit."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if fn.name in ("fuse", "_chunk_loop"):
+            scopes = [fn]
+        elif fn.name == "_build_program":
+            scopes = [n for n in ast.walk(fn)
+                      if isinstance(n, (ast.FunctionDef, ast.Lambda))
+                      and n is not fn]
+        else:
+            continue
+        for scope in scopes:
+            for sub in ast.walk(scope):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _kj018_emission_name(sub)
+                if name:
+                    yield Finding(
+                        path, sub.lineno, "KJ018",
+                        f"`{name}(...)` inside a fused-program body "
+                        "executes at trace time, not per run — the "
+                        "emission records compile-time and corrupts "
+                        "live percentiles; instrument at the dispatch "
+                        "boundary instead")
+
+
 def _attr_name(node: ast.AST) -> str:
     names = []
     while isinstance(node, (ast.Attribute, ast.Subscript)):
@@ -1378,6 +1470,7 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         findings.extend(_check_output_layout_leak(tree, rel))
         findings.extend(_check_literal_precision_cast(tree, rel))
         findings.extend(_check_dynamic_metric_name(tree, rel))
+        findings.extend(_check_trace_time_telemetry(tree, rel))
         findings.extend(_check_transpose_reshape(tree, rel))
         findings.extend(_check_blocking_host_io(tree, rel))
         if not posix.endswith("workflow/env.py/"):

@@ -544,4 +544,74 @@ JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --ledger "$LEDGER_FILE" >/dev
 # a run diffed against itself must report zero regressions (exit 0)
 JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --diff "$LEDGER_FILE" "$LEDGER_FILE"
 
+echo "== live-telemetry smoke (tight SLO breaches on a real apply; flight dump + conformance record) =="
+LIVE_LEDGER="$(mktemp /tmp/keystone_live_smoke.XXXXXX.jsonl)"
+LIVE_FLIGHT="$(mktemp -d /tmp/keystone_live_smoke.XXXXXX)"
+trap 'rm -f "$SHARDING_JSON" "$PLANNER_JSON" "$PRECISION_JSON" "$ROOFLINE_JSON" "$UNIFIED_JSON" "$SERVING_JSON" "$TRACE_TMP" "$DISPATCH_TRACE" "$COMPILE_TRACE" "$MEGA_TRACE" "$LEDGER_TRACE" "$LEDGER_FILE" "$LIVE_LEDGER"; rm -rf "$COMPILE_CACHE" "$MEGA_CACHE" "$LIVE_FLIGHT"' EXIT
+JAX_PLATFORMS=cpu KEYSTONE_LEDGER="$LIVE_LEDGER" \
+KEYSTONE_FLIGHT_DIR="$LIVE_FLIGHT" python - <<'PY'
+# Arm the conformance watchdog with an artificially tight certificate
+# (1 ns bound at every ladder shape), run a real warm apply through
+# `request_scope`, and assert the breach path end-to-end: the breach
+# counter fires, the flight-ring dump the breach triggered parses as a
+# Chrome trace, and the conformance ledger record names the certified
+# bound the observed latency was compared against.
+import numpy as np
+from keystone_tpu import PipelineEnv
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.dispatch_bench import EXAMPLES
+from keystone_tpu.telemetry import ledger, registry
+from keystone_tpu.telemetry.export import load_trace
+from keystone_tpu.telemetry.flight import ensure_flight, reset_flight
+from keystone_tpu.telemetry.streaming import health, reset_live
+from keystone_tpu.telemetry.watchdog import arm_watchdog, disarm_watchdog
+
+TIGHT = 1e-9
+PipelineEnv.reset()
+predictor, train, test = EXAMPLES["MnistRandomFFT"]()
+fitted = predictor.fit()
+X = np.asarray(test.numpy())[:64]
+np.asarray(fitted.apply(Dataset.from_numpy(X)).numpy())  # warm the shape
+
+ensure_flight()
+wd = arm_watchdog({
+    "slo_seconds": TIGHT, "certified": True,
+    "shapes": [{"batch": b, "predicted_seconds": TIGHT}
+               for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
+                         1024, 2048, 4096)],
+}, pipeline="MnistRandomFFT")
+assert wd is not None, "watchdog did not arm from the tight certificate"
+mark = ledger.session_mark()
+np.asarray(fitted.apply(Dataset.from_numpy(X)).numpy())
+
+assert wd.breaches >= 1, f"no breach under a {TIGHT}s bound: {wd.describe()}"
+reg = registry()
+assert reg.counter("serving.slo_breaches").value >= 1
+assert reg.counter("serving.conformance_checks").value >= 1
+recs = [d for d in ledger.session_since(mark) if d["kind"] == "conformance"]
+assert recs, "breach emitted no conformance ledger record"
+rec = recs[0]
+assert rec["predicted"]["bound_seconds"] == TIGHT, rec["predicted"]
+assert rec["chosen"]["observed_seconds"] > TIGHT
+assert rec["alternatives"][0]["cost_seconds"] == TIGHT
+dump = rec["chosen"]["flight_dump"]
+assert dump, "breach did not dump the flight ring"
+trace = load_trace(dump)  # the dump is a valid Chrome trace
+assert trace.get("keystone", {}).get("flight", {}).get("capacity", 0) > 0
+h = health()
+assert h["counters"]["serving.slo_breaches"]["value"] >= 1, h["counters"]
+assert any(r["count"] >= 1 for r in h["latency"]), h["latency"]
+disarm_watchdog()
+reset_live()
+reset_flight()
+PipelineEnv.reset()
+print(f"live-telemetry smoke: {len(recs)} breach record(s), "
+      f"dump {int(trace['keystone']['flight']['spans_held'])} span(s) OK")
+PY
+# the JSONL ledger the breach appended renders through the --ledger CLI,
+# and the breach dump renders through the --flight CLI
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --ledger "$LIVE_LEDGER" >/dev/null
+LIVE_DUMP="$(ls "$LIVE_FLIGHT"/keystone_flight_*.json | head -1)"
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --flight "$LIVE_DUMP" >/dev/null
+
 echo "lint: OK"

@@ -96,6 +96,13 @@ def trace_table(path, top=15):
                   f"{label} {h['total']:.4f}s/{int(h['count'])}"
                   for label, h in (("producer", stall), ("consumer", wait))
                   if h))
+    apply_h = hist.get("serving.apply_seconds")
+    if apply_h and apply_h.get("count"):
+        print("**Live serving latency**: "
+              f"{int(apply_h['count'])} request(s), "
+              f"p50 {apply_h.get('p50', 0.0) * 1e3:.1f} ms / "
+              f"p99 {apply_h.get('p99', 0.0) * 1e3:.1f} ms "
+              "(reservoir percentiles, `serving.apply_seconds`)")
     try:
         from keystone_tpu.analysis.reconcile import (
             format_reconciliation,

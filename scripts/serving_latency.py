@@ -173,14 +173,29 @@ def bench_shapes(name, build, reps, batches, trace_path):
     inside a `trace_run` so the apply executor embeds the KP9xx
     certificate, and the observed records are embedded alongside it —
     the written trace carries both sides of the `reconcile_serving`
-    join."""
+    join.
+
+    The traced applies also arm the live conformance watchdog (the
+    executor hands its embedded certificate to
+    `telemetry.watchdog.maybe_arm_from_certificate`), so every
+    percentile apply below runs under live conformance checking; the
+    returned ``live`` record carries the online story — checks,
+    breaches, and the streaming sketches' per-shape percentiles, the
+    fixed-memory twin of the sample-array percentiles measured here."""
     from keystone_tpu.analysis.memory import resolve_chunk_rows
     from keystone_tpu.telemetry import trace_run
+    from keystone_tpu.telemetry.streaming import health, reset_live
+    from keystone_tpu.telemetry.watchdog import (
+        active_watchdog,
+        disarm_watchdog,
+    )
     from keystone_tpu.utils.batching import _pad_target
     from keystone_tpu.workflow import PipelineEnv
     from keystone_tpu.workflow.executor import drain_warmups
 
     PipelineEnv.reset()
+    disarm_watchdog()
+    reset_live()
     chunk = resolve_chunk_rows(None)
     records = []
     fitted, make_batch, sync = build()
@@ -205,8 +220,32 @@ def bench_shapes(name, build, reps, batches, trace_path):
         for b in batches:
             sync(fitted.apply(make_batch(b, 0)))
         tracer.metadata["serving_observed"] = records
+    # live pass: the traced applies above armed the conformance
+    # watchdog from the certificate the executor embedded; replay a
+    # few warm applies per shape under it and capture the online
+    # story the plane saw — conformance checks, breaches, and the
+    # sketches' percentiles
+    live = {"armed": False}
+    wd = active_watchdog()
+    if wd is not None:
+        live_reps = max(3, min(int(reps), 10))
+        for b in batches:
+            for i in range(live_reps):
+                sync(fitted.apply(make_batch(b, i)))
+        digest = wd.describe()
+        live = {
+            "armed": True,
+            "pipeline": digest.get("pipeline"),
+            "slo_seconds": digest.get("slo_seconds"),
+            "checked": digest.get("checked", 0),
+            "breaches": digest.get("breaches", 0),
+            "shapes": digest.get("shapes", {}),
+            "streaming": health().get("latency", []),
+        }
+    disarm_watchdog()
+    reset_live()
     PipelineEnv.reset()
-    return records
+    return records, live
 
 
 def bench_cifar(reps: int):
@@ -320,10 +359,12 @@ def main():
                       f"{', '.join(sorted(builders))}", file=sys.stderr)
                 return 2
             trace_path = os.path.join(trace_dir, f"{name}.trace.json")
+            per_shape, live = bench_shapes(name, builders[name],
+                                           args.reps, batches, trace_path)
             shapes[name] = {
                 "trace": trace_path,
-                "shapes": bench_shapes(name, builders[name], args.reps,
-                                       batches, trace_path),
+                "shapes": per_shape,
+                "live": live,
             }
         record["examples"] = shapes
 

@@ -348,4 +348,5 @@ def test_bench_tier_error_scan_ignores_informational_payloads():
     # every gating key the child can emit is covered by the scan list
     assert set(bench.TIER_KEYS) == {
         "flagship_bcd_d8192", "flagship_featurize", "flagship_krr",
-        "featurize_overlap", "dispatch_count", "compile_count", "fused"}
+        "featurize_overlap", "dispatch_count", "telemetry_overhead",
+        "compile_count", "fused"}

@@ -1153,3 +1153,93 @@ def test_kj017_negatives_and_suppression(tmp_path):
         "    return (3 << 20) // per_img  # keystone: ignore[KJ017]\n"
     )
     assert jl.lint_file(suppressed) == []
+
+
+def test_kj018_flags_trace_time_telemetry(tmp_path):
+    """KJ018: span/metric emissions lexically inside fused-program
+    bodies (fuse()/_chunk_loop wholesale; _build_program only in its
+    nested traced closures) record trace-time, not run-time."""
+    jl = _jaxlint()
+    bad = tmp_path / "nodes" / "util" / "bad_fused_telemetry.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "from keystone_tpu.telemetry import counter, span\n"
+        "from keystone_tpu.telemetry import counter as _counter\n"
+        "from keystone_tpu import telemetry\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def fuse(ops, x):\n"
+        "    with span('fused', 'node'):\n"            # KJ018 (line 8)
+        "        counter('fused.calls').inc()\n"       # KJ018 (line 9)
+        "        return jnp.dot(x, x)\n"
+        "\n"
+        "\n"
+        "def _chunk_loop(chunks):\n"
+        "    telemetry.span('chunk', 'chunk')\n"       # KJ018 (line 14)
+        "    _counter('chunk.trips').inc()\n"          # KJ018 (line 15)
+        "    return chunks\n"
+        "\n"
+        "\n"
+        "def _build_program(stages):\n"
+        "    counter('precision.casts_baked').inc()\n"  # ok: host prologue
+        "\n"
+        "    def chunk_fn(carry, x):\n"
+        "        span('trip', 'chunk')\n"              # KJ018 (line 23)
+        "        return carry, x\n"
+        "\n"
+        "    return chunk_fn\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ018"] * 5
+    assert sorted(f.line for f in findings) == [8, 9, 14, 15, 23]
+
+    # outside workflow/ and nodes/ the rule does not apply
+    elsewhere = tmp_path / "telemetry" / "ok_fused.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj018_negative_forms(tmp_path):
+    """Emissions OUTSIDE fused bodies — and non-telemetry calls that
+    share a name inside them — stay silent."""
+    jl = _jaxlint()
+    clean = tmp_path / "workflow" / "ok_fused_telemetry.py"
+    clean.parent.mkdir(parents=True)
+    clean.write_text(
+        "from keystone_tpu.telemetry import counter, span\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def execute(graph):\n"
+        "    with span('node_force', 'node'):\n"   # ok: not a fused body
+        "        counter('executor.node_forces').inc()\n"
+        "    return graph\n"
+        "\n"
+        "\n"
+        "def fuse(ops, data, tracker):\n"
+        "    np.histogram(data, bins=4)\n"         # ok: numpy, not metrics
+        "    tracker.span_of_control()\n"          # ok: attr isn't span\n"
+        "    return ops\n"
+    )
+    assert jl.lint_file(clean) == []
+
+
+def test_kj018_suppression(tmp_path):
+    """A genuinely host-side call inside a fused body suppresses per
+    line with the standard comment."""
+    jl = _jaxlint()
+    src = tmp_path / "nodes" / "suppressed_fused.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "from keystone_tpu.telemetry import counter\n"
+        "\n"
+        "\n"
+        "def fuse(ops):\n"
+        "    # host-side: fuse() here builds, it is not traced\n"
+        "    counter('fusion.rewrites').inc()"
+        "  # keystone: ignore[KJ018]\n"
+        "    return ops\n"
+    )
+    assert jl.lint_file(src) == []

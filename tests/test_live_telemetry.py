@@ -1,0 +1,347 @@
+"""Live telemetry plane: flight recorder, streaming sketches, watchdog.
+
+Covers the ISSUE-18 contracts:
+
+  - the flight ring is bounded and tear-free under N concurrent
+    emitter threads racing a snapshot loop;
+  - a snapshot taken mid-span (the ``megafused_program`` regression)
+    exports the open span as incomplete-but-parseable and round-trips
+    through the telemetry CLI;
+  - the conformance watchdog, armed with a KP9xx certificate record,
+    increments ``serving.slo_breaches`` on a breach, dumps the flight
+    ring, and emits a ``kind="conformance"`` ledger record naming the
+    certified bound — which `reconcile_decisions` joins;
+  - the streaming sketches hold fixed memory, stay accurate, and merge;
+  - the metrics Histogram reservoir is bounded with working
+    percentiles;
+  - ``KEYSTONE_LIVE_TELEMETRY=0`` turns the whole plane off.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from keystone_tpu.telemetry import flight, ledger, metrics, streaming, watchdog
+from keystone_tpu.telemetry.export import load_trace, summarize, to_chrome_trace
+from keystone_tpu.telemetry.spans import (
+    Tracer,
+    set_tracer,
+    span,
+    trace_run,
+)
+from keystone_tpu.workflow.env import config_override
+
+
+@pytest.fixture(autouse=True)
+def fresh_plane():
+    """Every test gets a clean registry, ring, sketch table, watchdog,
+    and ledger session — and leaves none behind."""
+    metrics.registry().reset()
+    streaming.reset_live()
+    watchdog.disarm_watchdog()
+    flight.reset_flight()
+    ledger.clear_session()
+    set_tracer(None)
+    yield
+    metrics.registry().reset()
+    streaming.reset_live()
+    watchdog.disarm_watchdog()
+    flight.reset_flight()
+    ledger.clear_session()
+    set_tracer(None)
+
+
+CERT = {
+    "certified": True,
+    "slo_seconds": 0.5,
+    "shapes": [
+        {"batch": 1, "predicted_seconds": 0.1},
+        {"batch": 64, "predicted_seconds": 0.2},
+        {"batch": 256, "predicted_seconds": 0.3},
+    ],
+}
+
+
+# ------------------------------------------------------------- the ring
+
+
+def test_ring_is_bounded_and_evicts_oldest():
+    ring = flight._Ring(4)
+    for i in range(10):
+        ring.append(i)
+    assert len(ring) == 4
+    assert ring.snapshot() == [6, 7, 8, 9]
+    assert ring.dropped == 6
+
+
+def test_flight_ring_bounded_under_concurrent_emitters(tmp_path):
+    """N worker threads emit spans while snapshots run in a loop: no
+    torn records, capacity bound holds, every dump parses."""
+    rec = flight.ensure_flight()
+    assert rec is not None
+    cap = rec.capacity
+    n_threads, per_thread = 8, 300
+    stop = threading.Event()
+    dumps = []
+
+    def emit(k):
+        for i in range(per_thread):
+            rec.record_complete(f"work_{k}", "node", rec.now(), 1e-6,
+                                idx=i)
+
+    def snapshotter():
+        j = 0
+        while not stop.is_set():
+            p = str(tmp_path / f"snap_{j}.json")
+            out = flight.flight_snapshot(p)
+            if out:
+                dumps.append(out)
+            j += 1
+
+    snap = threading.Thread(target=snapshotter)
+    snap.start()
+    workers = [threading.Thread(target=emit, args=(k,))
+               for k in range(n_threads)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    stop.set()
+    snap.join()
+
+    assert len(rec.spans) <= cap
+    held = len(rec.spans) + rec.spans.dropped
+    assert held == n_threads * per_thread
+    assert dumps, "snapshot loop never produced a dump"
+    for p in dumps:
+        trace = load_trace(p)  # every dump is a valid Chrome trace
+        events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert len(events) <= cap + 1  # +1: process_name is ph=M anyway
+        for e in events:
+            # no torn records: every span has its full field set
+            assert {"name", "cat", "ts", "dur", "args"} <= set(e)
+
+
+def test_tee_copies_closed_spans_into_ring():
+    rec = flight.ensure_flight()
+    with trace_run() as tracer:
+        with span("stage_a", "node"):
+            pass
+    names = [s.name for s in rec.spans]
+    assert "stage_a" in names
+    assert "pipeline_run" in names
+    # teed copies, not shared records: mutating the ring's copy must
+    # not touch the source tracer's record
+    src = tracer.spans[0]
+    teed = next(s for s in rec.spans if s.name == src.name)
+    assert teed is not src
+
+
+# ------------------------------------- in-flight spans survive the dump
+
+
+def test_snapshot_mid_span_roundtrips_through_cli(tmp_path, capsys):
+    """The satellite regression: a snapshot racing an open
+    ``megafused_program`` span emits it incomplete-but-parseable, and
+    the telemetry CLI renders the dump."""
+    rec = flight.ensure_flight()
+    t = Tracer()
+    set_tracer(t)
+    open_rec = t.start("megafused_program", "node", plan="p0")
+    path = str(tmp_path / "midspan.json")
+    out = flight.flight_snapshot(path)
+    t.end(open_rec)
+    set_tracer(None)
+    assert out == path
+
+    trace = load_trace(path)
+    mega = [e for e in trace["traceEvents"]
+            if e.get("name") == "megafused_program"]
+    assert mega and mega[0]["args"]["incomplete"] is True
+    assert mega[0]["dur"] >= 0.0
+
+    from keystone_tpu.telemetry.__main__ import main as cli_main
+
+    assert cli_main([path]) == 0
+    assert cli_main(["--flight", path]) == 0
+    rendered = capsys.readouterr().out
+    assert "megafused_program" in rendered
+    assert "in-flight at dump" in rendered
+
+
+def test_atexit_style_flush_emits_open_spans():
+    """`to_chrome_trace` (the KEYSTONE_TRACE atexit flush path) exports
+    in-flight spans instead of dropping them."""
+    t = Tracer()
+    open_rec = t.start("long_apply", "node")
+    trace = to_chrome_trace(t)
+    t.end(open_rec)
+    names = {e["name"]: e for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert "long_apply" in names
+    assert names["long_apply"]["args"]["incomplete"] is True
+    assert "in-flight at dump" in summarize(trace)
+
+
+# ------------------------------------------------------------- watchdog
+
+
+def test_watchdog_bound_lookup_covers_ladder():
+    wd = watchdog.ConformanceWatchdog.from_certificate(CERT, "p")
+    assert wd.bound_for(64) == 0.2    # exact ladder entry
+    assert wd.bound_for(1) == 0.1     # exact ladder entry
+    assert wd.bound_for(2) == 0.2     # smallest certified batch >= 2
+    assert wd.bound_for(512) is None  # out of envelope: no claim made
+
+
+def test_watchdog_breach_counts_dumps_and_ledgers(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    flight.ensure_flight()
+    wd = watchdog.arm_watchdog(CERT, pipeline="demo")
+    assert wd is not None
+    mark = ledger.session_mark()
+
+    assert wd.check(64, 0.05) is False  # within bound
+    assert wd.check(64, 9.0) is True    # breach
+
+    reg = metrics.registry()
+    assert reg.counter("serving.slo_breaches").value == 1
+    assert reg.counter("serving.conformance_checks").value == 2
+
+    records = [d for d in ledger.session_since(mark)
+               if d["kind"] == "conformance"]
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["predicted"]["bound_seconds"] == pytest.approx(0.2)
+    assert rec["chosen"]["observed_seconds"] == pytest.approx(9.0)
+    assert rec["chosen"]["chunk_shape"] == 64
+    assert rec["alternatives"][0]["cost_seconds"] == pytest.approx(0.2)
+    # the dump artifact exists and parses
+    dump = rec["chosen"]["flight_dump"]
+    assert dump and load_trace(dump)
+
+
+def test_conformance_record_joins_in_reconcile(tmp_path, monkeypatch):
+    """`reconcile_decisions` joins the conformance record's bound
+    against the live request spans in the trace."""
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    from keystone_tpu.analysis.reconcile import reconcile_decisions
+
+    flight.ensure_flight()
+    watchdog.arm_watchdog(CERT, pipeline="demo")
+    with trace_run() as tracer:
+        t0 = tracer.now()
+        tracer.record_complete("apply_request", "request", t0, 9.0,
+                               batch=64, chunk_shape=64, pipeline="demo")
+        watchdog.active_watchdog().check(64, 9.0, batch=64)
+        trace = to_chrome_trace(tracer)
+    run = {"trace": trace,
+           "decisions": trace["keystone"]["decisions"],
+           "header": {}}
+    rec = reconcile_decisions(run)
+    rows = [r for r in rec["rows"] if r["kind"] == "conformance"]
+    assert len(rows) == 1
+    assert rows[0]["observed"]["observed_seconds"] == pytest.approx(9.0)
+    assert rows[0]["residuals"]["bound_seconds"] == pytest.approx(0.2 - 9.0)
+
+
+def test_request_scope_feeds_sketches_and_watchdog(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    wd = watchdog.arm_watchdog(
+        {"shapes": [{"batch": 1, "predicted_seconds": 1e-9}],
+         "slo_seconds": 0.001, "certified": True},
+        pipeline="tight")
+    with watchdog.request_scope(1, pipeline="tight"):
+        time.sleep(0.002)
+    assert wd.checked == 1 and wd.breaches == 1
+    assert metrics.registry().counter("serving.requests").value == 1
+    sk = streaming.latency_sketch("tight", 1)
+    assert sk is not None and sk.count == 1
+    # the request span landed in the flight ring
+    rec = flight.flight_recorder()
+    assert any(s.name == "apply_request" for s in rec.spans)
+    h = streaming.health()
+    assert h["requests"] == 1
+    assert h["watchdog"]["breaches"] == 1
+    rendered = streaming.format_health(h)
+    assert "tight" in rendered and "breach" in rendered
+
+
+# ------------------------------------------------------------ streaming
+
+
+def test_sketch_fixed_memory_and_accuracy():
+    sk = streaming.QuantileSketch(max_bins=64)
+    for i in range(50_000):
+        sk.observe((i % 1000) / 1000.0)
+    assert len(sk._bins) <= 64
+    assert sk.count == 50_000
+    assert sk.quantile(0.5) == pytest.approx(0.5, abs=0.05)
+    assert sk.quantile(0.99) == pytest.approx(0.99, abs=0.05)
+    assert sk.min == 0.0 and sk.max == pytest.approx(0.999)
+
+
+def test_sketch_merge():
+    a = streaming.QuantileSketch()
+    b = streaming.QuantileSketch()
+    for i in range(1000):
+        a.observe(i / 1000.0)        # [0, 1)
+        b.observe(1.0 + i / 1000.0)  # [1, 2)
+    a.merge(b)
+    assert a.count == 2000
+    assert len(a._bins) <= a.max_bins
+    assert a.quantile(0.5) == pytest.approx(1.0, abs=0.1)
+    assert a.max == pytest.approx(1.999)
+
+
+def test_histogram_reservoir_bounded_with_percentiles():
+    h = metrics.histogram("t.reservoir")
+    for i in range(10_000):
+        h.observe(i / 10_000.0)
+    assert len(h._reservoir) == metrics.RESERVOIR_SIZE
+    snap = h.snapshot()
+    assert snap["count"] == 10_000              # exact aggregates intact
+    assert snap["total"] == pytest.approx(4999.5, rel=1e-6)
+    assert snap["p50"] == pytest.approx(0.5, abs=0.08)
+    assert snap["p99"] == pytest.approx(0.99, abs=0.05)
+
+
+# ---------------------------------------------------------- kill switch
+
+
+def test_kill_switch_disables_the_whole_plane():
+    with config_override(live_telemetry=False):
+        assert flight.ensure_flight() is None
+        assert flight.flight_snapshot() is None
+        assert watchdog.arm_watchdog(CERT, pipeline="off") is None
+        with watchdog.request_scope(64, pipeline="off") as shape:
+            assert shape is None
+    # nothing moved: no metrics, no sketches, no recorder
+    reg = metrics.registry()
+    assert "serving.requests" not in reg.counters
+    assert streaming.health()["requests"] == 0
+    assert flight.flight_recorder() is None
+
+
+def test_live_config_field_in_ledger_header():
+    header = ledger.run_header()
+    assert "live_telemetry" in header["config"]
+    assert ledger.CONFIG_ENV["live_telemetry"] == "KEYSTONE_LIVE_TELEMETRY"
+    assert "conformance" in ledger.KINDS
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_live_renders_health(capsys):
+    streaming.observe_apply("demo", 64, 0.01)
+    from keystone_tpu.telemetry.__main__ import main as cli_main
+
+    assert cli_main(["--live"]) == 0
+    out = capsys.readouterr().out
+    assert "demo" in out and "p99" in out
+    assert cli_main(["--live", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["latency"][0]["pipeline"] == "demo"
