@@ -893,6 +893,53 @@ def _record_certificate(cert: ServingCertificate,
         pass  # a ledger bug must never break certification
 
 
+def record_runtime_handoff(cert: ServingCertificate,
+                           label: Optional[str], *,
+                           warmed_sites: int = 0,
+                           queue_depth: int = 0,
+                           window_ms: float = 0.0,
+                           coalesce: bool = True) -> None:
+    """One ``serving_handoff`` ledger record per runtime start/swap: the
+    auditable moment a static certificate became a live server. Carries
+    the runtime's actual coalescing knobs and how many fused program
+    sites its warm step submitted, next to the certificate's predicted
+    worst bound — `--explain` can answer "what certificate is this
+    process serving under, and was it warmed?" after the fact."""
+    try:
+        from ..telemetry.ledger import record_decision
+
+        worst = cert.worst_shape
+        record_decision(
+            kind="serving_handoff",
+            rule="ServingRuntime",
+            vertices=[],
+            labels=[label or "<pipeline>"],
+            chosen={
+                "entry": ("coalesced micro-batching" if coalesce
+                          else "per-request dispatch"),
+                "warmed_sites": int(warmed_sites),
+                "queue_depth": int(queue_depth),
+                "window_ms": float(window_ms),
+                "ladder_shapes": [s["batch"] for s in cert.shapes],
+            },
+            alternatives=[
+                {"entry": "per-request dispatch"
+                 if coalesce else "coalesced micro-batching",
+                 "cost_seconds": 0.0},
+            ],
+            predicted={
+                "worst_shape_seconds": (worst or {}).get(
+                    "predicted_seconds", 0.0),
+                "slo_seconds": cert.envelope.slo_seconds,
+                "per_device_peak_bytes": float(
+                    cert.per_device_peak_bytes or 0),
+            },
+            enforced=cert.certified,
+        )
+    except Exception:
+        pass  # the ledger must never take down a serving start
+
+
 # ----------------------------------------------------- example certification
 
 

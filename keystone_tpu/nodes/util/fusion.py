@@ -29,6 +29,18 @@ from ...parallel import mesh as meshlib
 from ...workflow.pipeline import Transformer
 
 
+def _leaf_dtype_name(p) -> str:
+    """Canonical dtype name of one program-key leaf WITHOUT materializing
+    it as a jax array: `jnp.asarray(p).dtype` on a host numpy leaf pays
+    a device put + convert_element_type per call — milliseconds per
+    warm serving dispatch across a plan's weight pytree. Canonicalizing
+    the dtype directly (x64-flag aware) produces the identical key."""
+    dt = getattr(p, "dtype", None)
+    if dt is None:
+        return jnp.asarray(p).dtype.name
+    return jax.dtypes.canonicalize_dtype(dt).name
+
+
 def _stage_batch_fn(stage: Transformer):
     """The stage's whole-batch device function."""
     fn = getattr(stage, "batch_fn", None)
@@ -469,7 +481,7 @@ class FusedBatchTransformer(Transformer):
         return (
             statics,
             treedef,
-            tuple((tuple(p.shape), jnp.asarray(p).dtype.name) for p in flat),
+            tuple((tuple(p.shape), _leaf_dtype_name(p)) for p in flat),
             tuple(array_shape),
             dtype_name,
             padded_count,

@@ -1,0 +1,25 @@
+"""Certified serving runtime: continuous micro-batching onto the pad
+ladder.
+
+The live half of the KP9xx story — a persistent request loop that
+serves traffic *because* a certificate holds. See SERVING.md for the
+architecture and the knob reference (``KEYSTONE_SERVING_COALESCE`` /
+``_QUEUE_DEPTH`` / ``_WINDOW_MS``)."""
+
+from .batcher import MicroBatcher, ShedError
+from .ingress import IngressError, NdarrayIngress, TextIngress, split_fitted_at
+from .registry import AdmissionRefused, TenantRegistry
+from .runtime import CertificationError, ServingRuntime
+
+__all__ = [
+    "AdmissionRefused",
+    "CertificationError",
+    "IngressError",
+    "MicroBatcher",
+    "NdarrayIngress",
+    "ServingRuntime",
+    "ShedError",
+    "TenantRegistry",
+    "TextIngress",
+    "split_fitted_at",
+]

@@ -1,0 +1,265 @@
+"""The persistent request loop behind the KP9xx certificate.
+
+`ServingRuntime` is the process that serves traffic *because* it holds
+a certificate. `start()` is a strict sequence — nothing dispatches
+until every step holds:
+
+  1. **Certify**: run the KP9xx pass (`analysis.serving.serving_pass`)
+     over the fitted apply graph against the envelope. An uncertified
+     pipeline is refused at start (override with
+     ``require_certified=False`` for experiments).
+  2. **Arm**: the conformance watchdog is armed from the certificate
+     record, so every dispatched apply is audited against the per-shape
+     KP903 bound — the runtime's SLO enforcement is PR-18's
+     `request_scope`, for free.
+  3. **Warm**: the certificate's warmup manifest (every fused program
+     site × every pad-ladder shape) is AOT-compiled through the bound
+     executor (`workflow.executor.warm_fitted_manifest`), and start
+     blocks on `drain_warmups` — a started runtime performs zero cold
+     compiles at any in-envelope shape.
+  4. **Handoff**: one ``serving_handoff`` ledger record binds the
+     certificate to this runtime instance (sites warmed, ladder,
+     queue/window knobs) — the auditable moment the static claim
+     became a live server.
+  5. **Serve**: the `MicroBatcher` dispatcher starts; `submit()`
+     coalesces concurrent requests into ladder-shaped batches through
+     `FittedPipeline.apply`, whose `request_scope` feeds the streaming
+     sketches and the watchdog.
+
+Hot-swap (`swap`/`swap_from`): the NEW fitted version is certified and
+its manifest warmed on the calling thread (program caches are global
+and structure-keyed, so warming needs no pause), then one atomic flip
+under the dispatch lock replaces the pipeline — in-flight batches
+finish on the old version, the next dispatch runs the new one, and no
+request is lost or served by a half-swapped state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..telemetry.metrics import counter
+from ..telemetry.watchdog import (
+    _padded_shape,
+    arm_watchdog,
+    disarm_watchdog,
+)
+from ..workflow.env import execution_config
+from .batcher import MicroBatcher, ShedError  # noqa: F401 - re-exported
+from .ingress import IngressError, NdarrayIngress
+
+
+class CertificationError(RuntimeError):
+    """The pipeline failed KP9xx certification — the runtime refuses to
+    serve it (the whole point is serving *because* the certificate
+    holds)."""
+
+
+class ServingRuntime:
+    """One tenant's certified serving loop: ingress → bounded queue →
+    ladder-coalesced dispatch → watchdog-audited apply."""
+
+    def __init__(self, fitted, ingress=None, *,
+                 envelope=None,
+                 name: str = "fitted_pipeline",
+                 element_shape=None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 chunk_rows: Optional[int] = None,
+                 require_certified: bool = True):
+        from ..analysis.serving import ServingEnvelope, envelope_from_env
+
+        if element_shape is None and ingress is not None:
+            element_shape = getattr(ingress, "shape", None)
+        if element_shape is None:
+            raise ValueError(
+                "element_shape is required (or pass an NdarrayIngress "
+                "that declares one) — the certificate is issued at a "
+                "declared ingress element")
+        self.element_shape = tuple(int(s) for s in element_shape)
+        self.ingress = ingress or NdarrayIngress(self.element_shape)
+        self.envelope = (envelope or envelope_from_env()
+                         or ServingEnvelope())
+        self.name = str(name)
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.chunk_rows = chunk_rows
+        self.require_certified = bool(require_certified)
+        self.certificate = None
+        self.warmed_sites = 0
+        self._fitted = fitted
+        self._swap_lock = threading.Lock()
+        self._dispatched_shapes: set = set()
+        self._batcher: Optional[MicroBatcher] = None
+        self._started = False
+
+    # ------------------------------------------------------------ start
+
+    def _certify(self, fitted):
+        """KP9xx over the fitted apply graph at the declared element,
+        propagated at the envelope's WORST ladder count so the KP905
+        residency price covers the largest batch a coalesced dispatch
+        can ever bind."""
+        from ..analysis import DataSpec
+        from ..analysis.propagate import spec_pass
+        from ..analysis.serving import ladder_shapes, serving_pass
+        from ..analysis.specs import shape_struct
+
+        worst = max(ladder_shapes(self.envelope, self.chunk_rows))
+        spec = DataSpec(
+            element=shape_struct(self.element_shape, np.float32),
+            kind="dataset", count=worst)
+        specs, _ = spec_pass(fitted.graph, {fitted.source: spec})
+        cert, diags = serving_pass(
+            fitted.graph, specs, self.envelope,
+            source=fitted.source, sink=fitted.sink,
+            hbm_budget_bytes=self.hbm_budget_bytes,
+            chunk_rows=self.chunk_rows,
+            label=self.name, ingress=self.ingress.describe())
+        if self.require_certified and not cert.certified:
+            from ..analysis.diagnostics import Severity
+
+            errors = [f"{d.rule}: {d.message}" for d in diags
+                      if d.severity >= Severity.ERROR]
+            raise CertificationError(
+                f"pipeline {self.name!r} failed KP9xx certification — "
+                "refusing to serve. " + " | ".join(errors[:3]))
+        return cert
+
+    def _warm(self, fitted, manifest) -> int:
+        from ..workflow.executor import drain_warmups, warm_fitted_manifest
+
+        sample = np.zeros((1,) + self.element_shape, np.float32)
+        warmed = warm_fitted_manifest(fitted, manifest, sample)
+        drain_warmups()
+        return warmed
+
+    def start(self) -> "ServingRuntime":
+        if self._started:
+            return self
+        cert = self._certify(self._fitted)
+        self.certificate = cert
+        record = cert.as_record()
+        # the watchdog audits under the SAME pipeline tag
+        # FittedPipeline.apply scopes with, so sketches and bounds join
+        arm_watchdog(record, pipeline="fitted_pipeline")
+        self.warmed_sites = self._warm(self._fitted, cert.manifest)
+        self._record_handoff(cert)
+        self._batcher = MicroBatcher(
+            self._apply_batch, max_batch=self.envelope.max_batch,
+            name=self.name).start()
+        self._started = True
+        return self
+
+    def _record_handoff(self, cert) -> None:
+        from ..analysis.serving import record_runtime_handoff
+
+        cfg = execution_config()
+        record_runtime_handoff(
+            cert, self.name,
+            warmed_sites=self.warmed_sites,
+            queue_depth=cfg.serving_queue_depth,
+            window_ms=cfg.serving_window_ms,
+            coalesce=cfg.serving_coalesce)
+
+    # --------------------------------------------------------- dispatch
+
+    def _apply_batch(self, stacked: np.ndarray) -> np.ndarray:
+        with self._swap_lock:
+            fitted = self._fitted
+        # Pad the coalesced batch onto the certified ladder HERE: a
+        # top-level Dataset apply runs at its exact leading dim (the
+        # `_pad_target` arithmetic only shapes the staged-batch path),
+        # so a ragged coalesced count (say 11 of max_batch 16) would
+        # otherwise compile an off-ladder program — the cold compile
+        # the certificate promises never happens on a warm server.
+        # Zero rows are row-local no-ops; the riders' rows are sliced
+        # back out below.
+        n = int(stacked.shape[0])
+        target = _padded_shape(n)
+        self._dispatched_shapes.add(target)
+        if target > n:
+            stacked = np.concatenate(
+                [stacked,
+                 np.zeros((target - n,) + stacked.shape[1:],
+                          stacked.dtype)])
+        out = fitted.apply(Dataset.from_numpy(stacked))
+        out = np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+        return out[:n]
+
+    def submit(self, payload: Any, timeout: Optional[float] = 60.0
+               ) -> np.ndarray:
+        """Serve one request: validate at the declared ingress, coalesce
+        onto the ladder, return this request's row of the result.
+        Raises `IngressError` (schema violation), `ShedError` (queue
+        full), or `CertificationError`-adjacent `RuntimeError` when not
+        started."""
+        if not self._started or self._batcher is None:
+            raise RuntimeError(f"runtime {self.name!r} is not started")
+        row = self.ingress.accept(payload)
+        if tuple(row.shape) != self.element_shape:
+            raise IngressError(
+                f"ingress produced shape {tuple(row.shape)}, certified "
+                f"element is {self.element_shape}")
+        return self._batcher.submit(row, timeout=timeout)
+
+    # --------------------------------------------------------- hot swap
+
+    def swap(self, new_fitted) -> None:
+        """Zero-downtime hot-swap: certify the new version, warm its
+        full manifest (background compile threads; traffic keeps
+        flowing on the old version), then atomically flip. In-flight
+        batches complete on the old pipeline."""
+        cert = self._certify(new_fitted)
+        warmed = self._warm(new_fitted, cert.manifest)
+        with self._swap_lock:
+            self._fitted = new_fitted
+            self.certificate = cert
+            self.warmed_sites = warmed
+        arm_watchdog(cert.as_record(), pipeline="fitted_pipeline")
+        self._record_handoff(cert)
+        counter("serving.hot_swaps").inc()
+
+    def swap_from(self, path: str) -> None:
+        """Hot-swap from an on-disk fitted artifact (pickle or orbax —
+        `FittedPipeline.load` auto-detects)."""
+        from ..workflow.pipeline import FittedPipeline
+
+        self.swap(FittedPipeline.load(path))
+
+    # ------------------------------------------------------------- stop
+
+    def stop(self) -> None:
+        if self._batcher is not None:
+            self._batcher.stop()
+            self._batcher = None
+        disarm_watchdog()
+        self._started = False
+
+    def __enter__(self) -> "ServingRuntime":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self) -> Dict[str, Any]:
+        from ..analysis.serving import ladder_shapes
+
+        ladder = ladder_shapes(self.envelope, self.chunk_rows)
+        return {
+            "name": self.name,
+            "started": self._started,
+            "certified": bool(self.certificate
+                              and self.certificate.certified),
+            "warmed_sites": self.warmed_sites,
+            "ladder": list(ladder),
+            "dispatched_shapes": sorted(self._dispatched_shapes),
+            "dispatched_outside_ladder": sorted(
+                self._dispatched_shapes - set(ladder)),
+            "element_shape": list(self.element_shape),
+            "ingress": self.ingress.describe(),
+        }

@@ -81,6 +81,7 @@ CONFIG_ENV = {
     "overlap": "KEYSTONE_OVERLAP",
     "pallas_kernels": "KEYSTONE_CHAIN_KERNELS",
     "live_telemetry": "KEYSTONE_LIVE_TELEMETRY",
+    "serving_coalesce": "KEYSTONE_SERVING_COALESCE",
 }
 
 _LOCK = threading.Lock()

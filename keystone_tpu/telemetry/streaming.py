@@ -232,6 +232,9 @@ def health() -> Dict[str, Any]:
     counters = {name: c.snapshot()
                 for name, c in sorted(reg.counters.items())
                 if name.startswith("serving.")}
+    histograms = {name: hg.snapshot()
+                  for name, hg in sorted(reg.histograms.items())
+                  if name.startswith("serving.")}
     out: Dict[str, Any] = {
         "requests": total,
         "throughput_rps": (total - 1) / window if window > 0 and total > 1
@@ -239,6 +242,7 @@ def health() -> Dict[str, Any]:
         "latency": rows,
         "gauges": gauges,
         "counters": counters,
+        "histograms": histograms,
     }
     from .watchdog import active_watchdog
 
@@ -278,6 +282,23 @@ def format_health(h: Dict[str, Any]) -> str:
     if inflight:
         lines.append(f"in-flight: {int(inflight.get('value', 0))} "
                      f"(peak {int(inflight.get('max', 0))})")
+    depth = gauges.get("serving.queue_depth")
+    shed = counters.get("serving.shed_total", {}).get("value", 0)
+    dispatches = counters.get("serving.dispatches", {}).get("value", 0)
+    if depth or shed or dispatches:
+        lines.append(
+            f"serving runtime: {int(dispatches)} dispatch(es), queue "
+            f"depth {int((depth or {}).get('value', 0))} "
+            f"(peak {int((depth or {}).get('max', 0))}), "
+            f"{int(shed)} shed")
+    coalesced = (h.get("histograms") or {}).get("serving.coalesced_batch")
+    if coalesced and coalesced.get("count"):
+        lines.append(
+            f"coalesced batch: mean {coalesced.get('mean', 0.0):.1f} "
+            f"p50 {coalesced.get('p50', 0.0):.0f} "
+            f"p99 {coalesced.get('p99', 0.0):.0f} "
+            f"max {coalesced.get('max', 0.0):.0f} "
+            f"(over {int(coalesced['count'])} dispatch(es))")
     wd = h.get("watchdog")
     if wd:
         state = "armed" if wd.get("armed") else "disarmed"

@@ -201,6 +201,27 @@ class ExecutionConfig:
     watchdog (``telemetry/flight.py`` / ``streaming.py`` /
     ``watchdog.py``). ``=0`` is bit-for-bit the post-hoc-only behavior:
     no request spans, no sketch updates, no watchdog checks.
+
+    ``serving_coalesce`` (default on; env ``KEYSTONE_SERVING_COALESCE=0``
+    kills, ledger-header recorded) turns on the serving runtime's
+    continuous micro-batching: concurrent single-item requests coalesce
+    through the bounded ingress queue into batches padded onto the
+    certificate's pow-2 pad ladder, so a warm server dispatches ONE
+    pre-compiled program per coalesced batch instead of one per
+    request. ``=0`` is bit-for-bit: every request dispatches alone on
+    its caller thread, exactly a direct ``FittedPipeline.apply``.
+
+    ``serving_queue_depth`` (env ``KEYSTONE_SERVING_QUEUE_DEPTH``,
+    default 256) bounds the serving ingress queue — the load-shed
+    discipline (jaxlint KJ019): a full queue REFUSES the request
+    (``serving.shed_total`` counted, flight ring dumped) instead of
+    growing host memory until latency collapses.
+
+    ``serving_window_ms`` (env ``KEYSTONE_SERVING_WINDOW_MS``, default
+    2.0) is the coalescing window: after the first queued request, the
+    batcher waits at most this long for followers before dispatching.
+    0 dispatches whatever is queued immediately (lowest latency, least
+    coalescing).
     """
 
     overlap: bool = True
@@ -222,6 +243,9 @@ class ExecutionConfig:
     unified_min_savings_seconds: float = 5e-3
     pallas_kernels: bool = True
     live_telemetry: bool = True
+    serving_coalesce: bool = True
+    serving_queue_depth: int = 256
+    serving_window_ms: float = 2.0
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -339,6 +363,12 @@ def execution_config() -> ExecutionConfig:
                 "KEYSTONE_CHAIN_KERNELS", "1").lower() not in _OFF,
             live_telemetry=os.environ.get(
                 "KEYSTONE_LIVE_TELEMETRY", "1").lower() not in _OFF,
+            serving_coalesce=os.environ.get(
+                "KEYSTONE_SERVING_COALESCE", "1").lower() not in _OFF,
+            serving_queue_depth=max(1, int(os.environ.get(
+                "KEYSTONE_SERVING_QUEUE_DEPTH", "256"))),
+            serving_window_ms=max(0.0, float(os.environ.get(
+                "KEYSTONE_SERVING_WINDOW_MS", "2.0"))),
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

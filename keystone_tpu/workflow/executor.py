@@ -37,6 +37,7 @@ programs in flight concurrently. Guarantees:
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 from .env import PipelineEnv, Prefix, execution_config
@@ -56,6 +57,12 @@ _sched_local = threading.local()
 # window and flakily break the warm-run == 0-compiles gates.
 _warm_threads: List[threading.Thread] = []
 _warm_threads_lock = threading.Lock()
+
+# Warm-scan memo per `warm_scope` (see GraphExecutor.__init__): the set
+# of serving-ladder signatures already scanned for a given long-lived
+# owner. Weak keys so a dropped FittedPipeline releases its entry.
+_warm_scope_seen: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_warm_scope_lock = threading.Lock()
 
 
 _exit_drain_registered = False
@@ -99,6 +106,26 @@ def drain_warmups(timeout: float = 60.0) -> None:
             t.join(timeout=max(0.0, deadline - _time.monotonic()))
         if _time.monotonic() >= deadline:
             return
+
+
+def warm_fitted_manifest(fitted, manifest, sample) -> int:
+    """The serving runtime's pre-traffic warm hook: bind ``sample`` (a
+    host batch of the declared ingress element, or a `Dataset`) into a
+    throwaway executor over the fitted apply graph and feed ``manifest``
+    (an `analysis.serving.warmup_manifest()` enumeration) to
+    `warm_manifest`. Program caches are global and structure-keyed, so
+    the programs compiled here are exactly the ones every later
+    `FittedPipeline.apply` — and a hot-swapped successor warming on a
+    background thread — will hit warm. Returns the number of program
+    sites submitted; call `drain_warmups()` to block on the compiles."""
+    from ..data.dataset import Dataset
+    from .operators import DatasetOperator
+
+    data = (sample if getattr(sample, "is_dataset", False)
+            else Dataset.from_numpy(sample))
+    g, nid = fitted.graph.add_node(DatasetOperator(data), [])
+    g = g.replace_dependency(fitted.source, nid).remove_source(fitted.source)
+    return GraphExecutor(g, optimize=False).warm_manifest(manifest)
 
 
 def _submit_warmup(op, element, counts) -> None:
@@ -209,11 +236,19 @@ class GraphExecutor:
         graph: Graph,
         optimize: bool = True,
         plan: Optional[Tuple[Graph, Dict[NodeId, Prefix]]] = None,
+        warm_scope: Optional[object] = None,
     ):
         """``plan`` supplies an already-optimized (graph, prefixes) pair,
-        bypassing the optimizer (used by `Pipeline.fit`)."""
+        bypassing the optimizer (used by `Pipeline.fit`). ``warm_scope``
+        names a long-lived owner (a `FittedPipeline`) whose program set
+        this executor's graph is derived from: the AOT warm scan runs
+        ONCE per scope instead of once per bound executor — the serving
+        request loop builds an executor per dispatch, and re-scanning an
+        already-warm plan costs a thread spawn plus spec_pass traces on
+        every request (milliseconds that dominate a warm apply)."""
         self._raw_graph = graph
         self._optimize = optimize
+        self._warm_scope = warm_scope
         self._optimized: Optional[Tuple[Graph, Dict[NodeId, Prefix]]] = plan
         self._memo: Dict[GraphId, Expression] = {}
         self._structure_checked = False
@@ -435,6 +470,23 @@ class GraphExecutor:
         self._warmed = True
         if not execution_config().aot_warmup:
             return
+        if self._warm_scope is not None:
+            # one scan per scope × ladder signature: program caches are
+            # global and structure-keyed, so the first scan's warmups
+            # cover every later executor bound from the same fitted
+            # graph. A scope applying at a count outside the first
+            # scan's targets compiles that program inline exactly once —
+            # the same end state, minus a background thread per request.
+            sig = tuple(_serving_warm_counts())
+            try:
+                with _warm_scope_lock:
+                    seen = _warm_scope_seen.setdefault(
+                        self._warm_scope, set())
+                    if sig in seen:
+                        return
+                    seen.add(sig)
+            except TypeError:
+                pass  # unweakrefable scope: fall through and scan
 
         def scan_and_warm():
             # the whole scan — including the spec_pass eval_shape traces

@@ -364,11 +364,15 @@ class FittedPipeline(Chainable):
             with request_scope(batch, pipeline="fitted_pipeline"):
                 g, nid = self.graph.add_node(DatasetOperator(data), [])
                 g = g.replace_dependency(self.source, nid).remove_source(self.source)
-                return PipelineDataset(GraphExecutor(g, optimize=False), self.sink).get()
+                return PipelineDataset(
+                    GraphExecutor(g, optimize=False, warm_scope=self),
+                    self.sink).get()
         with request_scope(1, pipeline="fitted_pipeline"):
             g, nid = self.graph.add_node(DatumOperator(data), [])
             g = g.replace_dependency(self.source, nid).remove_source(self.source)
-            return PipelineDatum(GraphExecutor(g, optimize=False), self.sink).get()
+            return PipelineDatum(
+                GraphExecutor(g, optimize=False, warm_scope=self),
+                self.sink).get()
 
     def __call__(self, data: Any):
         return self.apply(data)

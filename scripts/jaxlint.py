@@ -205,6 +205,22 @@ system cannot (see ANALYSIS.md for the full catalog):
          (the executor / instrument layer) instead, or suppress with
          a rationale naming why the call is host-side.
 
+  KJ019  unbounded-request-buffer (under ``serving/`` and
+         ``workflow/``): a ``queue.Queue()`` (or LifoQueue/
+         PriorityQueue) constructed with no maxsize — or a literal
+         maxsize ≤ 0, which the stdlib treats as infinite — and, under
+         ``serving/`` only, a ``SimpleQueue()`` (unbounded by
+         construction) or a bare ``list.append`` onto a receiver named
+         like a request buffer (queue/pending/requests/backlog/inbox/
+         buffer). Every serving queue must be BOUNDED: a full queue is
+         the load-shed signal (`serving.shed_total` + a flight dump),
+         so an unbounded buffer silently converts overload into
+         unbounded memory growth and unbounded queueing delay — the
+         p99 dies long before the OOM does. Size the queue from
+         ``execution_config().serving_queue_depth`` (the
+         ``KEYSTONE_SERVING_QUEUE_DEPTH`` knob), or suppress with a
+         rationale naming why the producer is statically bounded.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -296,6 +312,13 @@ RULES = {
              "body runs at trace time, so the emission records "
              "compile-time not run-time and corrupts live latency "
              "percentiles — instrument at the dispatch boundary",
+    "KJ019": "unbounded request buffer in a serving hot path: a "
+             "queue.Queue() with no (or a non-positive literal) "
+             "maxsize, a SimpleQueue, or a bare list-append request "
+             "buffer — a full BOUNDED queue is the load-shed signal; "
+             "an unbounded one converts overload into unbounded "
+             "memory and queueing delay (size it from "
+             "serving_queue_depth)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -1246,6 +1269,102 @@ def _attr_name(node: ast.AST) -> str:
     return names[-1] if names else "?"
 
 
+_BOUNDED_QUEUE_CLASSES = {"Queue", "LifoQueue", "PriorityQueue"}
+#: receiver names that mark a list as a request buffer (KJ019): the
+#: serving vocabulary for "work waiting to be dispatched".
+_REQUEST_BUFFER_RE = re.compile(
+    r"(queue|pending|request|backlog|inbox|buffer)s?$", re.IGNORECASE)
+
+
+def _kj019_queue_call(call: ast.Call) -> Optional[str]:
+    """The queue class name when ``call`` constructs a stdlib queue
+    (``queue.Queue(...)`` or a bare imported ``Queue(...)``), else
+    None. Receiver-filtered like KJ012: ``multiprocessing.Queue`` et
+    al. resolve through the same names, which is fine — the bounding
+    discipline is identical."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                        ast.Name):
+        name = func.attr
+    else:
+        return None
+    if name in _BOUNDED_QUEUE_CLASSES or name == "SimpleQueue":
+        return name
+    return None
+
+
+def _kj019_unbounded(call: ast.Call) -> bool:
+    """Is this bounded-capable queue construction provably unbounded?
+    No maxsize argument at all, or a literal maxsize ≤ 0 (the stdlib's
+    'infinite' spelling). A non-literal maxsize expression is accepted
+    — the capacity is a decision, which is all the rule demands."""
+    args = list(call.args)
+    maxsize: Optional[ast.AST] = args[0] if args else None
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            maxsize = kw.value
+        elif kw.arg is None:
+            return False  # **kwargs splat: cannot prove
+    if maxsize is None:
+        return True
+    if isinstance(maxsize, ast.Constant) and isinstance(
+            maxsize.value, (int, float)):
+        return maxsize.value <= 0
+    if isinstance(maxsize, ast.UnaryOp) and isinstance(maxsize.op,
+                                                       ast.USub):
+        return True  # a negative literal, however spelled
+    return False
+
+
+def _check_unbounded_request_buffer(tree: ast.AST, path: str,
+                                    serving: bool) -> Iterator[Finding]:
+    """KJ019: unbounded ``queue.Queue()`` constructions (serving/ and
+    workflow/), plus — under serving/ only — ``SimpleQueue()`` and bare
+    list-appends onto request-buffer-named receivers. The load-shed
+    discipline: a serving queue must be able to say no."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            cls = _kj019_queue_call(node)
+            if cls == "SimpleQueue":
+                if serving:
+                    yield Finding(
+                        path, node.lineno, "KJ019",
+                        "`SimpleQueue()` is unbounded by construction "
+                        "— a serving queue must be bounded so a full "
+                        "queue sheds (use queue.Queue(maxsize=execution"
+                        "_config().serving_queue_depth))")
+                continue
+            if cls is not None and _kj019_unbounded(node):
+                yield Finding(
+                    path, node.lineno, "KJ019",
+                    f"`{cls}()` without a positive maxsize is an "
+                    "unbounded request buffer — overload becomes "
+                    "unbounded memory and queueing delay instead of a "
+                    "shed; size it (serving_queue_depth is the "
+                    "sanctioned knob)")
+            continue
+        if not serving:
+            continue
+        if (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "append"):
+            recv = node.value.func.value
+            recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                         else recv.id if isinstance(recv, ast.Name)
+                         else None)
+            if recv_name and _REQUEST_BUFFER_RE.search(
+                    recv_name.lstrip("_")):
+                yield Finding(
+                    path, node.lineno, "KJ019",
+                    f"bare list-append onto `{recv_name}` grows a "
+                    "request buffer without bound — route requests "
+                    "through a bounded queue.Queue so overload sheds "
+                    "instead of accumulating")
+
+
 def _check_missing_donate(tree: ast.AST, path: str) -> Iterator[Finding]:
     for fn in ast.walk(tree):
         if not isinstance(fn, ast.FunctionDef):
@@ -1476,6 +1595,9 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
         if not posix.endswith("workflow/env.py/"):
             # env.py IS the knob's definition + resolution site
             findings.extend(_check_manual_chunk_knob(tree, rel))
+    if "serving/" in posix or "workflow/" in posix:
+        findings.extend(_check_unbounded_request_buffer(
+            tree, rel, serving="serving/" in posix))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
     if "ops/" not in posix:

@@ -29,8 +29,18 @@ NewsgroupsPipeline, MnistRandomFFT, TimitPipeline (the dispatch-bench
 instances); VOC/ImageNet SIFT remain static-only until their loaders
 grow synthetic fixtures.
 
+A third pass (``--runtime``) drives the REAL server loop: a
+`serving.ServingRuntime` is certified, warmed, and started per covered
+example, ``--clients`` concurrent client threads fire requests through
+`submit()`, and the observed side is read back from the streaming
+sketches the coalesced dispatch path fed — so the
+``keystone.serving_observed`` records in the runtime trace are
+bound-vs-observed under real concurrency (queueing + coalescing
+included), not a sequential-apply idealization.
+
 Usage: python scripts/serving_latency.py [--reps 200] [--out -]
            [--max-batch 64] [--trace-dir /tmp] [--examples NAME ...]
+           [--runtime] [--clients 8]
        KEYSTONE_BACKEND=cpu python scripts/serving_latency.py --reps 20
 """
 
@@ -248,6 +258,170 @@ def bench_shapes(name, build, reps, batches, trace_path):
     return records, live
 
 
+# ------------------------------------------------ runtime (real server)
+
+
+def _runtime_builders():
+    """Builders for the ``--runtime`` pass: each returns an UNSTARTED
+    `ServingRuntime` plus the request payload pool its clients draw
+    from. Coverage is the examples with a declarable ingress: the
+    dispatch-bench ndarray instances submit raw element rows, and
+    Newsgroups serves its device tail behind a `TextIngress`
+    (`split_fitted_at` extracts the fitted host front-end)."""
+    from keystone_tpu.serving import (
+        NdarrayIngress,
+        ServingRuntime,
+        TextIngress,
+        split_fitted_at,
+    )
+
+    def _bench_ndarray(name):
+        def build():
+            from keystone_tpu.dispatch_bench import EXAMPLES as BENCH
+
+            predictor, train, test = BENCH[name]()
+            fitted = predictor.fit()
+            X = np.concatenate([np.asarray(test.numpy()),
+                                np.asarray(train.numpy())])
+            rt = ServingRuntime(
+                fitted, NdarrayIngress(X.shape[1:]), name=name)
+            return rt, [np.ascontiguousarray(X[i]) for i in range(len(X))]
+
+        return build
+
+    def newsgroups():
+        fitted, items = _build_newsgroups()
+        host_ops, tail = split_fitted_at(fitted, "NaiveBayesModel")
+        ingress = TextIngress(host_ops)
+        element = ingress.accept(items[0]).shape
+        rt = ServingRuntime(tail, ingress, element_shape=element,
+                            name="NewsgroupsPipeline")
+        return rt, items
+
+    return {
+        "MnistRandomFFT": _bench_ndarray("MnistRandomFFT"),
+        "TimitPipeline": _bench_ndarray("TimitPipeline"),
+        "NewsgroupsPipeline": newsgroups,
+    }
+
+
+def bench_runtime(name, build, reps, clients, trace_path):
+    """One example through the real serving loop: certify + warm + start
+    the runtime, fire ``clients`` concurrent threads × ``reps`` requests
+    each through `submit()`, and read the observed per-shape percentiles
+    back from the streaming sketches the coalesced dispatch path fed
+    (`request_scope` keys them by padded ladder shape). The written
+    trace carries the runtime's OWN certificate as ``keystone.serving``
+    and the sketch percentiles as ``keystone.serving_observed`` — the
+    `reconcile_serving` join under real concurrency."""
+    import threading
+
+    from keystone_tpu.serving import CertificationError
+    from keystone_tpu.telemetry import trace_run
+    from keystone_tpu.telemetry.metrics import metrics_delta, registry
+    from keystone_tpu.telemetry.streaming import latency_sketch, reset_live
+    from keystone_tpu.telemetry.watchdog import (
+        active_watchdog,
+        disarm_watchdog,
+    )
+    from keystone_tpu.workflow import PipelineEnv
+
+    PipelineEnv.reset()
+    disarm_watchdog()
+    reset_live()
+    # fresh per-example coalescing histogram (the registry is
+    # process-cumulative; the batcher re-creates the metric on start)
+    registry().histograms.pop("serving.coalesced_batch", None)
+    rt, payloads = build()
+    result = {"trace": trace_path, "clients": int(clients),
+              "requests": int(clients) * int(reps)}
+    # the client load runs UNTRACED: an armed tracer re-runs the
+    # static-estimate embed per request-bound executor (host work a
+    # serving process would not pay per request) and its per-apply
+    # re-arm resets the watchdog counters — the join artifact is
+    # written separately below, from the runtime's own certificate
+    try:
+        rt.start()
+    except CertificationError as e:
+        disarm_watchdog()
+        result["skipped"] = str(e)
+        return result
+    try:
+        errors = []
+        with metrics_delta() as delta:
+            t0 = time.perf_counter()
+
+            def client(cid):
+                for i in range(reps):
+                    try:
+                        rt.submit(
+                            payloads[(cid + clients * i) % len(payloads)])
+                    except Exception as e:  # shed/failure: record, go on
+                        errors.append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=client, args=(c,),
+                                        daemon=True)
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        wd = active_watchdog()
+        digest = wd.describe() if wd is not None else {}
+        stats = rt.stats()
+        records = []
+        for shape in stats["dispatched_shapes"]:
+            sk = latency_sketch("fitted_pipeline", int(shape))
+            if sk is None or sk.count == 0:
+                continue
+            records.append({
+                "batch": int(shape),
+                "chunk_shape": int(shape),
+                "p50_ms": round(sk.quantile(0.50) * 1e3, 3),
+                "p90_ms": round(sk.quantile(0.90) * 1e3, 3),
+                "p99_ms": round(sk.quantile(0.99) * 1e3, 3),
+                "mean_ms": round(sk.total / sk.count * 1e3, 3),
+                "reps": int(sk.count),
+                "trace": trace_path,
+                "source": "runtime",
+            })
+        # the join artifact: the runtime's OWN certificate (issued at
+        # the declared ingress element, priced at the worst ladder
+        # count) as keystone.serving, the sketch percentiles as
+        # keystone.serving_observed
+        with trace_run(trace_path) as tracer:
+            tracer.metadata["serving"] = rt.certificate.as_record()
+            tracer.metadata["serving_observed"] = records
+            tracer.metadata["serving_runtime"] = {
+                "example": name,
+                "clients": int(clients),
+                "watchdog": digest,
+            }
+    finally:
+        rt.stop()
+    coalesced = registry().histograms.get("serving.coalesced_batch")
+    result.update({
+        "wall_seconds": round(wall, 3),
+        "throughput_rps": (round(clients * reps / wall, 1)
+                           if wall > 0 else None),
+        "dispatches": int(delta.counter("serving.dispatches")),
+        "shed": int(delta.counter("serving.shed_total")),
+        "error_count": len(errors),
+        "errors": errors[:5],
+        "shapes": records,
+        "coalesced_batch": coalesced.snapshot() if coalesced else None,
+        "dispatched_outside_ladder": stats["dispatched_outside_ladder"],
+        "watchdog": {
+            "checked": digest.get("checked", 0),
+            "breaches": digest.get("breaches", 0),
+        },
+    })
+    reset_live()
+    PipelineEnv.reset()
+    return result
+
+
 def bench_cifar(reps: int):
     """Legacy single-datum record (PERF.md serving row)."""
     from keystone_tpu.workflow import PipelineEnv
@@ -303,6 +477,14 @@ def main():
                    help="subset of covered examples (default: all)")
     p.add_argument("--skip-shapes", action="store_true",
                    help="legacy single-datum records only")
+    p.add_argument("--runtime", action="store_true",
+                   help="also drive the real serving loop "
+                        "(serving.ServingRuntime) with concurrent "
+                        "clients per covered example; the runtime trace "
+                        "carries keystone.serving AND keystone."
+                        "serving_observed from the coalesced path")
+    p.add_argument("--clients", type=int, default=8,
+                   help="concurrent client threads for --runtime")
     args = p.parse_args()
     if os.environ.get("KEYSTONE_BACKEND") == "cpu":
         import jax
@@ -330,20 +512,23 @@ def main():
         "newsgroups": bench_newsgroups(args.reps),
     }
 
-    if not args.skip_shapes:
-        # arm the serving envelope for the per-shape section: the
-        # apply-run executor embeds the KP9xx certificate into the
-        # trace this script measures into, and warmup widens to the
-        # ladder (drained before timing). --max-batch is explicit and
-        # must WIN over an inherited env var — otherwise the measured
-        # shapes and the certified ladder desynchronize and the excess
-        # shapes cold-compile inside the timed section
+    trace_dir = None
+    if not args.skip_shapes or args.runtime:
+        # arm the serving envelope for the per-shape and runtime
+        # sections: the apply-run executor embeds the KP9xx certificate
+        # into the trace this script measures into, and warmup widens
+        # to the ladder (drained before timing). --max-batch is
+        # explicit and must WIN over an inherited env var — otherwise
+        # the measured shapes and the certified ladder desynchronize
+        # and the excess shapes cold-compile inside the timed section
         os.environ["KEYSTONE_SLO_MS"] = str(slo_ms)
         os.environ["KEYSTONE_SERVING_MAX_BATCH"] = str(args.max_batch)
         record["slo_ms"] = slo_ms
         trace_dir = args.trace_dir or tempfile.mkdtemp(
             prefix="keystone_serving_")
         os.makedirs(trace_dir, exist_ok=True)
+
+    if not args.skip_shapes:
         batches = []
         b = 1
         while b < args.max_batch:
@@ -367,6 +552,19 @@ def main():
                 "live": live,
             }
         record["examples"] = shapes
+
+    if args.runtime:
+        rbuilders = _runtime_builders()
+        names = [n for n in (args.examples or sorted(rbuilders))
+                 if n in rbuilders]
+        runtime = {}
+        for name in names:
+            trace_path = os.path.join(trace_dir,
+                                      f"{name}.runtime.trace.json")
+            runtime[name] = bench_runtime(
+                name, rbuilders[name], args.reps, args.clients, trace_path)
+        record["runtime"] = runtime
+        record["runtime_covered"] = sorted(rbuilders)
 
     line = json.dumps(record)
     print(line)

@@ -1243,3 +1243,88 @@ def test_kj018_suppression(tmp_path):
         "    return ops\n"
     )
     assert jl.lint_file(src) == []
+
+
+def test_kj019_flags_unbounded_request_buffers(tmp_path):
+    """KJ019: unbounded queue.Queue constructions in serving/ and
+    workflow/, plus SimpleQueue and request-buffer list-appends under
+    serving/ only — every serving queue must be able to shed."""
+    jl = _jaxlint()
+    bad = tmp_path / "serving" / "bad_buffers.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import queue\n"
+        "from queue import Queue, SimpleQueue\n"
+        "\n"
+        "\n"
+        "class Loop:\n"
+        "    def __init__(self):\n"
+        "        self._ingress = queue.Queue()\n"        # KJ019 (line 7)
+        "        self._lifo = queue.LifoQueue(0)\n"      # KJ019 (line 8)
+        "        self._bare = Queue(maxsize=0)\n"        # KJ019 (line 9)
+        "        self._simple = SimpleQueue()\n"         # KJ019 (line 10)
+        "        self._requests = []\n"
+        "\n"
+        "    def submit(self, row):\n"
+        "        self._requests.append(row)\n"           # KJ019 (line 14)
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ019"] * 5
+    assert sorted(f.line for f in findings) == [7, 8, 9, 10, 14]
+
+    # under workflow/ only the unbounded Queue forms apply — the
+    # list-append and SimpleQueue halves are serving-only vocabulary
+    wf = tmp_path / "workflow" / "bad_buffers.py"
+    wf.parent.mkdir(parents=True)
+    wf.write_text(bad.read_text())
+    assert sorted(f.line for f in jl.lint_file(wf)) == [7, 8, 9]
+
+    # outside serving/ and workflow/ the rule does not apply at all
+    elsewhere = tmp_path / "telemetry" / "bad_buffers.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert jl.lint_file(elsewhere) == []
+
+
+def test_kj019_negative_forms(tmp_path):
+    """Bounded queues, non-literal capacities (a decision was made),
+    and appends onto non-buffer names stay silent."""
+    jl = _jaxlint()
+    clean = tmp_path / "serving" / "ok_buffers.py"
+    clean.parent.mkdir(parents=True)
+    clean.write_text(
+        "import queue\n"
+        "from keystone_tpu.workflow.env import execution_config\n"
+        "\n"
+        "\n"
+        "class Loop:\n"
+        "    def __init__(self, depth):\n"
+        "        self._a = queue.Queue(maxsize=depth)\n"
+        "        self._b = queue.Queue(\n"
+        "            execution_config().serving_queue_depth)\n"
+        "        self._c = queue.Queue(256)\n"
+        "        self.batch = []\n"
+        "\n"
+        "    def dispatch(self, item, out):\n"
+        "        self.batch.append(item)\n"  # 'batch' is not a buffer name
+        "        out.append(item)\n"
+    )
+    assert jl.lint_file(clean) == []
+
+
+def test_kj019_suppression(tmp_path):
+    """A statically bounded producer suppresses per line with the
+    standard comment."""
+    jl = _jaxlint()
+    src = tmp_path / "serving" / "suppressed_buffers.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "import queue\n"
+        "\n"
+        "\n"
+        "def make():\n"
+        "    # producer is the single warm thread: statically bounded\n"
+        "    return queue.Queue()"
+        "  # keystone: ignore[KJ019]\n"
+    )
+    assert jl.lint_file(src) == []

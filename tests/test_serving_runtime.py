@@ -1,0 +1,494 @@
+"""Certified serving runtime tests (serving/ — the live KP9xx half).
+
+The acceptance contract: a started runtime serves traffic *because* a
+certificate holds — every dispatched batch shape is on the warmed pad
+ladder (0 cold compiles), results are exactly the direct
+`FittedPipeline.apply` results, overload is shed (counted and
+flight-dumped) instead of buffered, hot-swap loses zero requests, KP905
+refuses over-budget tenants statically, and the
+``KEYSTONE_SERVING_COALESCE=0`` kill switch reproduces per-request
+dispatch bit-for-bit.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis import ServingEnvelope
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.nodes.util import (
+    ClassLabelIndicatorsFromInt,
+    MaxClassifier,
+    VectorCombiner,
+)
+from keystone_tpu.serving import (
+    AdmissionRefused,
+    CertificationError,
+    IngressError,
+    MicroBatcher,
+    NdarrayIngress,
+    ServingRuntime,
+    ShedError,
+    TenantRegistry,
+    TextIngress,
+    split_fitted_at,
+)
+from keystone_tpu.telemetry import ledger
+from keystone_tpu.telemetry.flight import reset_flight
+from keystone_tpu.telemetry.metrics import counter
+from keystone_tpu.telemetry.streaming import reset_live
+from keystone_tpu.telemetry.watchdog import active_watchdog, disarm_watchdog
+from keystone_tpu.workflow import Pipeline, PipelineEnv
+from keystone_tpu.workflow.env import config_override
+
+DIM, N, K = 16, 48, 3
+LADDER = (1, 2, 4, 8)
+
+
+@pytest.fixture(autouse=True)
+def _reset_env(monkeypatch):
+    for var in ("KEYSTONE_SLO_MS", "KEYSTONE_SERVING_MAX_BATCH",
+                "KEYSTONE_SERVING_TENANTS", "KEYSTONE_SERVING_COALESCE",
+                "KEYSTONE_SERVING_QUEUE_DEPTH",
+                "KEYSTONE_SERVING_WINDOW_MS"):
+        monkeypatch.delenv(var, raising=False)
+    PipelineEnv.reset()
+    reset_live()
+    yield
+    disarm_watchdog()
+    reset_flight()
+    reset_live()
+    PipelineEnv.reset()
+
+
+def _fit_predictor(label_seed: int = 0):
+    """The tiny real fitted pipeline from test_serving.py: gather(2 fft
+    branches) → block LS → argmax. ``label_seed`` varies the training
+    labels so hot-swap tests get a genuinely different model."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N, DIM)).astype(np.float32)
+    y = np.random.default_rng(label_seed).integers(0, K, N).astype(np.int32)
+    branches = [
+        RandomSignNode(DIM, seed=i) >> PaddedFFT() >> LinearRectifier(0.0)
+        for i in range(2)
+    ]
+    feat = Pipeline.gather(branches) >> VectorCombiner()
+    train = Dataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromInt(K)(Dataset.from_numpy(y)).get()
+    pred = feat.and_then(
+        BlockLeastSquaresEstimator(32, 1, 1e-2), train, labels
+    ) >> MaxClassifier()
+    return pred.fit(), X
+
+
+@pytest.fixture(scope="module")
+def fitted_and_data():
+    return _fit_predictor()
+
+
+def _direct(fitted, X):
+    return np.asarray(fitted.apply(Dataset.from_numpy(X)).numpy())
+
+
+def _runtime(fitted, max_batch: int = 8, **kw):
+    kw.setdefault("envelope", ServingEnvelope(max_batch=max_batch,
+                                              slo_seconds=1.0))
+    kw.setdefault("name", "test-runtime")
+    return ServingRuntime(fitted, NdarrayIngress((DIM,)), **kw)
+
+
+def _fire(rt, X, indices, timeout=60.0):
+    """Submit rows concurrently; returns (results dict, errors list)."""
+    results, errors = {}, []
+
+    def client(i):
+        try:
+            results[i] = rt.submit(X[i], timeout=timeout)
+        except Exception as e:  # noqa: BLE001 - recorded for asserts
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in indices]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
+def _compile_events(fn):
+    """Run fn, return (number of XLA compile requests, result)."""
+    from jax._src import monitoring
+
+    events = []
+
+    def listener(name, **kw):
+        if name == "/jax/compilation_cache/compile_requests_use_cache":
+            events.append(name)
+
+    monitoring.register_event_listener(listener)
+    try:
+        out = fn()
+    finally:
+        try:
+            monitoring._event_listeners.remove(listener)
+        except ValueError:  # pragma: no cover - listener wrapper changed
+            monitoring.clear_event_listeners()
+    return len(events), out
+
+
+# --------------------------------------------------------- core dispatch
+
+
+def test_concurrent_requests_coalesce_on_ladder_and_match_direct(
+        fitted_and_data):
+    fitted, X = fitted_and_data
+    ref = _direct(fitted, X)
+    rt = _runtime(fitted).start()
+    try:
+        results, errors = _fire(rt, X, range(8))
+        assert not errors, errors
+        for i in range(8):
+            assert np.allclose(results[i], ref[i]), i
+        stats = rt.stats()
+        assert stats["dispatched_shapes"], "nothing dispatched"
+        assert stats["dispatched_outside_ladder"] == [], (
+            "a coalesced dispatch left the certified pad ladder: "
+            f"{stats['dispatched_shapes']} vs ladder {stats['ladder']}")
+    finally:
+        rt.stop()
+
+
+def test_single_request_matches_direct_apply(fitted_and_data):
+    fitted, X = fitted_and_data
+    ref = _direct(fitted, X)
+    rt = _runtime(fitted).start()
+    try:
+        out = rt.submit(X[3])
+        assert np.allclose(out, ref[3])
+        assert rt.stats()["dispatched_outside_ladder"] == []
+    finally:
+        rt.stop()
+
+
+def test_saturated_queue_path_matches_direct_apply(fitted_and_data):
+    """More offered work than one batch can carry: every request still
+    completes with the direct-apply result (backlog drains through
+    successive ladder-shaped dispatches, nothing is reordered across
+    its own row)."""
+    fitted, X = fitted_and_data
+    ref = _direct(fitted, X)
+    rt = _runtime(fitted, max_batch=4).start()
+    try:
+        results, errors = _fire(rt, X, range(32))
+        assert not errors, errors
+        for i in range(32):
+            assert np.allclose(results[i], ref[i]), i
+        assert rt.stats()["dispatched_outside_ladder"] == []
+        assert counter("serving.dispatches").snapshot()["value"] >= 8
+    finally:
+        rt.stop()
+
+
+def test_warm_runtime_serves_full_ladder_with_zero_cold_compiles(
+        fitted_and_data):
+    fitted, X = fitted_and_data
+    rt = _runtime(fitted).start()  # start() warms + drains the manifest
+    try:
+        def serve():
+            for b in LADDER:
+                results, errors = _fire(rt, X, range(b))
+                assert not errors and len(results) == b
+        n_compiles, _ = _compile_events(serve)
+        assert n_compiles == 0, (
+            f"warm runtime performed {n_compiles} cold compile(s) while "
+            f"serving concurrency levels {LADDER} — the warmed-manifest "
+            "claim (0 cold compiles at any in-envelope shape) is broken")
+        assert rt.stats()["dispatched_outside_ladder"] == []
+    finally:
+        rt.stop()
+
+
+def test_ragged_coalesced_batch_pads_onto_ladder_with_zero_compiles(
+        fitted_and_data):
+    """A coalescing window can close on ANY count ≤ max_batch (say 3,
+    or 11 of 16) — the dispatch must pad onto the pow-2 rung and slice
+    the riders back out, because a top-level Dataset apply otherwise
+    runs at its exact leading dim and cold-compiles an off-ladder
+    program the certificate never priced or warmed."""
+    fitted, X = fitted_and_data
+    ref = _direct(fitted, X)
+    rt = _runtime(fitted).start()
+    try:
+        def ragged():
+            return {n: rt._apply_batch(X[:n]) for n in (3, 5, 6, 7)}
+        n_compiles, outs = _compile_events(ragged)
+        assert n_compiles == 0, (
+            f"{n_compiles} cold compile(s) dispatching ragged coalesced "
+            "counts (3, 5, 6, 7) on a warm runtime — ragged batches must "
+            "pad onto the warmed ladder, not compile their own programs")
+        for n, out in outs.items():
+            assert out.shape[0] == n, (n, out.shape)
+            assert np.allclose(out, ref[:n]), n
+        stats = rt.stats()
+        assert stats["dispatched_outside_ladder"] == []
+        assert set(stats["dispatched_shapes"]) <= {4, 8}
+    finally:
+        rt.stop()
+
+
+# ------------------------------------------------------------- hot swap
+
+
+def test_hot_swap_mid_traffic_loses_nothing_and_flips_atomically():
+    fitted_a, X = _fit_predictor(label_seed=0)
+    fitted_b, _ = _fit_predictor(label_seed=99)
+    ref_a = _direct(fitted_a, X)
+    ref_b = _direct(fitted_b, X)
+    assert not np.allclose(ref_a, ref_b), "swap fixture models identical"
+    rt = _runtime(fitted_a).start()
+    try:
+        stop_traffic = threading.Event()
+        outcomes, errors = [], []
+
+        def client_loop(i):
+            while not stop_traffic.is_set():
+                try:
+                    out = rt.submit(X[i % N])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+                ok_a = np.allclose(out, ref_a[i % N])
+                ok_b = np.allclose(out, ref_b[i % N])
+                outcomes.append((ok_a, ok_b))
+                i += 4
+
+        threads = [threading.Thread(target=client_loop, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        rt.swap(fitted_b)  # certifies + warms B, then one atomic flip
+        time.sleep(0.3)
+        stop_traffic.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, f"hot swap dropped requests: {errors[:3]}"
+        assert outcomes
+        # every response matches exactly one of the two versions — no
+        # torn batch ever mixed weights
+        assert all(ok_a or ok_b for ok_a, ok_b in outcomes)
+        # after the flip, fresh requests are served by B
+        post = rt.submit(X[5])
+        assert np.allclose(post, ref_b[5])
+        assert rt.certificate is not None and rt.certificate.certified
+        assert counter("serving.hot_swaps").snapshot()["value"] >= 1
+    finally:
+        rt.stop()
+
+
+# ----------------------------------------------------- admission (KP905)
+
+
+def test_registry_refuses_over_budget_tenant_statically(fitted_and_data):
+    fitted, _ = fitted_and_data
+    rt = _runtime(fitted)
+    registry = TenantRegistry(hbm_budget_bytes=1000)
+    registry.admit("tenant-a", rt, per_device_peak_bytes=600)
+    mark = ledger.session_mark()
+    with pytest.raises(AdmissionRefused, match="KP905"):
+        registry.admit("tenant-b", rt, per_device_peak_bytes=600)
+    assert registry.tenants() == ["tenant-a"]
+    assert registry.resident_bytes() == 600
+    records = [r for r in ledger.session_since(mark)
+               if r["kind"] == "serving_admission"]
+    assert records and records[-1]["chosen"]["entry"] == "refuse"
+    # evicting the resident tenant frees the budget
+    registry.evict("tenant-a")
+    registry.admit("tenant-b", rt, per_device_peak_bytes=600)
+    assert registry.tenants() == ["tenant-b"]
+
+
+def test_runtime_certificate_carries_priced_residency(fitted_and_data):
+    fitted, _ = fitted_and_data
+    rt = _runtime(fitted).start()
+    try:
+        assert rt.certificate.per_device_peak_bytes
+        registry = TenantRegistry(hbm_budget_bytes=1 << 40)
+        registry.admit("priced", rt)  # peak defaults from the cert
+        assert registry.resident_bytes() == \
+            rt.certificate.per_device_peak_bytes
+    finally:
+        rt.stop()
+
+
+# ----------------------------------------------------------- load shed
+
+
+def test_shed_increments_counter_and_dumps_flight_ring(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("KEYSTONE_FLIGHT_DIR", str(tmp_path))
+    release = threading.Event()
+
+    def slow_apply(batch):
+        release.wait(10.0)
+        return batch
+
+    with config_override(serving_queue_depth=1, serving_window_ms=0.0):
+        mb = MicroBatcher(slow_apply, max_batch=1).start()
+    before = counter("serving.shed_total").snapshot()["value"]
+    try:
+        row = np.zeros(4, np.float32)
+        threads = []
+        shed = []
+
+        def client():
+            try:
+                mb.submit(row, timeout=20.0)
+            except ShedError as e:
+                shed.append(e)
+
+        for _ in range(8):
+            t = threading.Thread(target=client)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 5.0
+        while not shed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        release.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert shed, "no request was shed with a depth-1 queue"
+        after = counter("serving.shed_total").snapshot()["value"]
+        assert after - before >= len(shed)
+        dumps = list(tmp_path.glob("keystone_flight_*_shed.json"))
+        assert dumps, "shed did not dump the flight ring"
+    finally:
+        release.set()
+        mb.stop()
+
+
+# --------------------------------------------------------- kill switch
+
+
+def test_coalesce_kill_switch_reverts_to_per_request_bit_for_bit(
+        fitted_and_data):
+    fitted, X = fitted_and_data
+    with config_override(serving_coalesce=False):
+        rt = _runtime(fitted).start()
+        try:
+            assert rt._batcher._thread is None, (
+                "kill switch must not start a dispatcher thread")
+            for i in range(4):
+                out = rt.submit(X[i])
+                ref = np.asarray(
+                    fitted.apply(
+                        Dataset.from_numpy(X[i:i + 1])).numpy())[0]
+                assert np.array_equal(np.asarray(out), ref), (
+                    f"kill-switch result for row {i} is not bit-for-bit "
+                    "the direct per-request apply")
+            assert rt.stats()["dispatched_shapes"] == [1]
+        finally:
+            rt.stop()
+
+
+# -------------------------------------------------------------- ingress
+
+
+def test_ingress_refuses_off_schema_requests(fitted_and_data):
+    fitted, X = fitted_and_data
+    rt = _runtime(fitted).start()
+    try:
+        with pytest.raises(IngressError, match="declared ingress"):
+            rt.submit(np.zeros(DIM + 1, np.float32))
+        with pytest.raises(IngressError):
+            rt.submit(np.zeros((2, DIM), np.float32))
+        # a castable dtype is accepted, not refused
+        out = rt.submit(X[0].astype(np.float64))
+        assert out is not None
+    finally:
+        rt.stop()
+
+
+def test_uncertified_pipeline_is_refused_at_start(fitted_and_data):
+    fitted, _ = fitted_and_data
+    rt = _runtime(fitted, envelope=ServingEnvelope(
+        max_batch=8, slo_seconds=1e-9))  # KP903 cannot hold
+    with pytest.raises(CertificationError, match="KP903"):
+        rt.start()
+    assert active_watchdog() is None
+
+
+# ------------------------------------------------- text ingress (split)
+
+
+def test_text_ingress_serves_newsgroups_device_tail():
+    from keystone_tpu.pipelines.text_pipelines import (
+        build_newsgroups_predictor,
+        synthetic_corpus,
+    )
+
+    labels, docs = synthetic_corpus(64, 3, vocab_size=120, doc_len=30)
+    fitted = build_newsgroups_predictor(
+        docs, labels, 3, ngram_orders=(1,), common_features=500).fit()
+    doc_list = list(docs)
+    direct = [int(np.asarray(fitted.apply(d))) for d in doc_list[:6]]
+
+    host_ops, tail = split_fitted_at(fitted, "NaiveBayesModel")
+    assert [op.label for op in host_ops] == [
+        "Trim", "LowerCase", "Tokenizer", "NGramsFeaturizer",
+        "TermFrequency", "SparseFeatureVectorizer"]
+    ingress = TextIngress(host_ops)
+    row = ingress.accept(doc_list[0])
+    rt = ServingRuntime(
+        tail, ingress, element_shape=row.shape,
+        envelope=ServingEnvelope(max_batch=8, slo_seconds=1.0),
+        name="newsgroups").start()
+    try:
+        assert rt.certificate.certified, (
+            "the Newsgroups device tail must certify clean — the KP901 "
+            "suppression promised exactly this split")
+        results, errors = _fire(rt, doc_list, range(6))
+        assert not errors, errors
+        for i in range(6):
+            assert int(np.asarray(results[i])) == direct[i]
+        assert rt.stats()["dispatched_outside_ladder"] == []
+        with pytest.raises(IngressError, match="document string"):
+            rt.submit(123)
+    finally:
+        rt.stop()
+
+
+def test_split_refuses_missing_boundary(fitted_and_data):
+    fitted, _ = fitted_and_data
+    with pytest.raises(ValueError, match="not on the apply path"):
+        split_fitted_at(fitted, "NoSuchStage")
+
+
+# ------------------------------------------------------ handoff record
+
+
+def test_start_emits_certificate_handoff_record(fitted_and_data):
+    fitted, _ = fitted_and_data
+    mark = ledger.session_mark()
+    rt = _runtime(fitted).start()
+    try:
+        records = [r for r in ledger.session_since(mark)
+                   if r["kind"] == "serving_handoff"]
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["labels"] == ["test-runtime"]
+        assert rec["chosen"]["entry"] == "coalesced micro-batching"
+        assert rec["chosen"]["ladder_shapes"] == list(LADDER)
+        assert rec["chosen"]["warmed_sites"] == rt.warmed_sites >= 1
+        assert rec["predicted"]["worst_shape_seconds"] > 0
+        # the watchdog armed from the same certificate
+        wd = active_watchdog()
+        assert wd is not None
+        assert set(wd.bounds) == set(LADDER)
+    finally:
+        rt.stop()
+    assert active_watchdog() is None, "stop() must disarm the watchdog"
