@@ -91,6 +91,8 @@ def run_child(args, timeout_s: float):
             "--overlap-chunk", str(args.overlap_chunk)]
     if args.skip_overlap_tier:
         cmd += ["--skip-overlap-tier"]
+    if args.skip_ooc_tier:
+        cmd += ["--skip-ooc-tier"]
     if args.skip_dispatch_tier:
         cmd += ["--skip-dispatch-tier"]
     if args.skip_telemetry_tier:
@@ -188,16 +190,17 @@ def emit(record):
 # krr_tier-ranked checkpoint holding every measured tier).
 PROGRESS_RANK = {"headline": 1, "staged": 2, "flagship": 3,
                  "featurize_tier": 4, "krr_tier": 5, "overlap_tier": 6,
-                 "dispatch_tier": 7, "telemetry_tier": 8,
-                 "serving_tier": 9, "compile_tier": 10, "complete": 11}
+                 "ooc_tier": 7, "dispatch_tier": 8, "telemetry_tier": 9,
+                 "serving_tier": 10, "compile_tier": 11, "complete": 12}
 
 # The tier payload keys a child detail may carry. finalize_record's
 # error scan is restricted to exactly these: a future informational
 # payload that happens to contain an "error" field (e.g. a north_star
 # sub-dict) must not silently block persistence.
 TIER_KEYS = ("flagship_bcd_d8192", "flagship_featurize", "flagship_krr",
-             "featurize_overlap", "dispatch_count", "telemetry_overhead",
-             "serving_qps", "compile_count", "fused")
+             "featurize_overlap", "out_of_core", "dispatch_count",
+             "telemetry_overhead", "serving_qps", "compile_count",
+             "fused")
 
 
 def progress_rank(detail) -> int:
@@ -226,6 +229,53 @@ def result_record(detail, extra=None):
     return rec
 
 
+def _ledger_diff_verdict(detail):
+    """Run-over-run decision-ledger diff: when the last-good record
+    names a ledger artifact that still exists, diff it against THIS
+    run's ledger (`telemetry --diff` machinery) and return the verdict
+    — regression count, the removed/drifted decision names, and the
+    kill-switch env vars the diff suspects. None when either side
+    lacks a readable ledger. Purely informational: a perf record's
+    numbers stand on their own, the verdict tells the reader WHICH
+    optimizer decision changed underneath them."""
+    try:
+        cur = detail.get("ledger_artifact")
+        if not cur or not os.path.exists(cur):
+            return None
+        with open(LAST_GOOD) as f:
+            prev_rec = json.load(f)
+        prev = (prev_rec.get("detail") or {}).get("ledger_artifact")
+        if not prev or not os.path.exists(prev) \
+                or os.path.abspath(prev) == os.path.abspath(cur):
+            return None
+        from keystone_tpu.telemetry.ledger import diff_runs, read_ledger
+
+        diff = diff_runs(read_ledger(prev), read_ledger(cur))
+        return {
+            "baseline_ledger": prev,
+            "current_ledger": cur,
+            "regressions": int(diff["regressions"]),
+            "decisions_removed": [
+                f"{d['kind']}[{d['labels']}]"
+                for d in diff["decisions_removed"]],
+            "decisions_added": [
+                f"{d['kind']}[{d['labels']}]"
+                for d in diff["decisions_added"]],
+            "prediction_drift": [
+                f"{d['kind']}[{d['labels']}].{d['metric']}: "
+                f"{d['a']} -> {d['b']}"
+                for d in diff["prediction_drift"]],
+            "config_flips": [
+                f"{c['env']}: {c['a']} -> {c['b']}"
+                for c in diff["config_flips"]],
+            "suspect_kill_switches": sorted({
+                d["suspect_env"] for d in diff["decisions_removed"]
+                if d.get("suspect_env")}),
+        }
+    except Exception:
+        return None
+
+
 def finalize_record(detail):
     """Gate a child measurement: returns (record, persist_as_last_good).
 
@@ -237,6 +287,9 @@ def finalize_record(detail):
     deterministically broken tier must not silently poison the fallback
     record while monitoring reads a clean exit."""
     rec = result_record(detail)
+    verdict = _ledger_diff_verdict(detail)
+    if verdict is not None:
+        rec["ledger_diff"] = verdict
     if not detail.get("accuracy_in_band", True):
         band = detail.get("accuracy_band") or [None]
         bound = (band[0] if detail.get("synthetic", True)
@@ -310,6 +363,7 @@ def main():
     p.add_argument("--overlap-n", type=int, default=16_384)
     p.add_argument("--overlap-chunk", type=int, default=2048)
     p.add_argument("--skip-overlap-tier", action="store_true")
+    p.add_argument("--skip-ooc-tier", action="store_true")
     p.add_argument("--skip-dispatch-tier", action="store_true")
     p.add_argument("--skip-telemetry-tier", action="store_true")
     p.add_argument("--skip-serving-tier", action="store_true")
@@ -774,6 +828,215 @@ def _flagship_overlap(n, chunk, num_filters, patch=6, block=512, iters=2,
                       "overlapped = double-buffered dispatch + deferred "
                       "in-order drains"),
     }
+
+
+def _out_of_core_bench(n=81_920, dim=128, k=8, shard_rows=8192,
+                       window=1024, lam=1e-3):
+    """Out-of-core featurize→solve tier (planner-governed host spill
+    PR): a synthetic dataset 8× a synthetic HBM budget streams through
+    the windowed spill prefetcher — shards load on demand, each window
+    pads onto the PR-5 pow-2 ladder, normal-equation accumulators
+    (AᵀA, Aᵀb — tiny) stay device-resident, and the full design matrix
+    is NEVER materialized on device. Gates: observed peak device
+    residency ≤ the budget during the windowed pass; the windowed
+    solution is allclose to the unconstrained (fully materialized) arm
+    at window-multiple AND ragged counts with exact index coverage;
+    the warm re-run performs 0 cold compiles (every window shape is a
+    ladder shape already compiled); and the unified planner, asked to
+    plan under a budget the device cache busts, prices the spill
+    alternative (feasible) against the device cache (INF) — the
+    KEYSTONE_OOC_SPILL=0 arm scores no spill entry and keeps an empty
+    spill set. Overlapped-vs-serial reload wall-clock is recorded
+    (`overlap_beats_serial`); host-only meshes report it without
+    gating — the pipelining win is a device-transfer property."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.loaders import synthetic_out_of_core
+    from keystone_tpu.telemetry import compiles_snapshot
+    from keystone_tpu.telemetry.compile_events import (
+        install_compile_listeners,
+    )
+    from keystone_tpu.utils.batching import stream_spill_windows
+    from keystone_tpu.workflow.env import overlap_override
+    from keystone_tpu.workflow.executor import drain_warmups
+
+    install_compile_listeners()
+    dataset_bytes = n * dim * 4
+    budget = dataset_bytes // 8
+
+    rng = np.random.default_rng(17)
+    W = jnp.asarray(
+        rng.standard_normal((dim, dim)).astype(np.float32) * 0.05)
+    theta = jnp.asarray(rng.standard_normal((dim, k)).astype(np.float32))
+    eye = jnp.eye(dim, dtype=jnp.float32)
+
+    @jax.jit
+    def accum(ata, atb, xb):
+        f = jnp.maximum(xb @ W, 0.0)
+        # zero pad rows featurize to zero rows: they add nothing to
+        # either accumulator, so padded windows need no masking
+        return ata + f.T @ f, atb + f.T @ (xb @ theta)
+
+    @jax.jit
+    def solve(ata, atb):
+        return jnp.linalg.solve(ata + lam * eye, atb)
+
+    def solve_windowed(source, count, track_peak=False):
+        ata = jnp.zeros((dim, dim), jnp.float32)
+        atb = jnp.zeros((dim, k), jnp.float32)
+        seen = []
+        peak = 0
+        for idxs, win in stream_spill_windows(source.row_loader, count,
+                                              window=window):
+            ata, atb = accum(ata, atb, win)
+            seen.extend(idxs)
+            if track_peak:
+                ata.block_until_ready()
+                live = sum(int(a.nbytes) for a in jax.live_arrays())
+                peak = max(peak, live)
+        out = solve(ata, atb)
+        return np.asarray(out), seen, peak
+
+    def solve_resident(source, count):
+        x = jnp.asarray(source.numpy())
+        f = jnp.maximum(x @ W, 0.0)
+        out = jnp.linalg.solve(f.T @ f + lam * eye, f.T @ (x @ theta))
+        return np.asarray(out)
+
+    # --- the big out-of-core pass: 8× the budget, windowed, gated
+    big = synthetic_out_of_core(n, dim, shard_rows=shard_rows, seed=17)
+    with overlap_override(True):
+        theta_big, seen, _ = solve_windowed(big, n)  # cold/compile
+        drain_warmups()
+        before = compiles_snapshot()
+        t0 = time.perf_counter()
+        theta_big, seen, peak = solve_windowed(big, n, track_peak=True)
+        t_warm = time.perf_counter() - t0
+        drain_warmups()
+        after = compiles_snapshot()
+    warm_cold_compiles = (after["programs_compiled"]
+                          - before["programs_compiled"])
+    coverage_ok = (sorted(seen) == list(range(n)))
+
+    # --- serial vs overlapped reload wall-clock (same windowed pass)
+    with overlap_override(False):
+        solve_windowed(big, n)  # warm the serial path
+        t_serial = min(
+            _timed(lambda: solve_windowed(big, n)) for _ in range(2))
+    with overlap_override(True):
+        t_overlap = min(
+            _timed(lambda: solve_windowed(big, n)) for _ in range(2))
+
+    # --- allclose vs the unconstrained arm at multiple AND ragged
+    # counts (small enough to materialize honestly)
+    allclose = {}
+    for count in (4 * window, 4 * window + 1, 3 * window - 413):
+        src = synthetic_out_of_core(count, dim, shard_rows=4096,
+                                    seed=29 + count)
+        got, idxs, _ = solve_windowed(src, count)
+        want = solve_resident(src, count)
+        allclose[str(count)] = bool(
+            sorted(idxs) == list(range(count))
+            and np.allclose(got, want, rtol=2e-4, atol=2e-4))
+
+    # --- the planner's spill axis: under a budget the device cache
+    # busts, the spill placement prices feasible where device prices
+    # INF; with the axis off nothing spills (the kill-switch shape)
+    planner = _ooc_planner_probe()
+
+    problems = []
+    if peak > budget:
+        problems.append(
+            f"windowed pass peak device residency {peak} bytes exceeds "
+            f"the {budget}-byte budget (dataset {dataset_bytes} bytes)")
+    if not coverage_ok:
+        problems.append("windowed index coverage != range(n)")
+    if warm_cold_compiles:
+        problems.append(
+            f"warm windowed re-run performed {warm_cold_compiles} cold "
+            "compile(s)")
+    if not all(allclose.values()):
+        problems.append(f"windowed vs resident allclose failed: "
+                        f"{allclose}")
+    if planner.get("error"):
+        problems.append(planner["error"])
+    res = {
+        "n": n, "dim": dim, "k": k, "window": window,
+        "shard_rows": shard_rows,
+        "dataset_bytes": dataset_bytes,
+        "hbm_budget_bytes": budget,
+        "dataset_over_budget": round(dataset_bytes / budget, 2),
+        "peak_device_bytes": int(peak),
+        "peak_under_budget": bool(peak <= budget),
+        "warm_seconds": round(t_warm, 4),
+        "warm_cold_compiles": int(warm_cold_compiles),
+        "rows_per_sec_warm": round(n / t_warm, 1),
+        "serial_seconds": round(t_serial, 4),
+        "overlapped_seconds": round(t_overlap, 4),
+        "overlap_speedup": round(t_serial / t_overlap, 3),
+        "overlap_beats_serial": bool(t_overlap < t_serial),
+        "allclose_vs_resident": allclose,
+        "planner": planner,
+        "structure": ("synthetic_out_of_core shards -> "
+                      "stream_spill_windows (pad ladder, double-buffered"
+                      " host->device reload) -> jit normal-equation "
+                      "accumulate -> device solve; design matrix never "
+                      "device-materialized"),
+    }
+    if problems:
+        res["error"] = "; ".join(problems)
+    return res
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def _ooc_planner_probe():
+    """Pure spec arithmetic: ask the unified planner for a plan whose
+    only way to keep a demanded-twice value is the host spill tier, and
+    check the ledger-bound menu prices BOTH placements — device cache
+    INF (busts the budget), host spill feasible — while the
+    KEYSTONE_OOC_SPILL=0 arm scores no spill entry at all."""
+    from keystone_tpu.analysis import as_source_spec
+    from keystone_tpu.analysis.examples import build_example
+    from keystone_tpu.analysis.plan_ir import plan_unified
+    from keystone_tpu.analysis.propagate import spec_pass
+
+    pipeline, source_spec = build_example("MnistRandomFFT")
+    specs, _ = spec_pass(
+        pipeline.graph, {pipeline.source: as_source_spec(source_spec)})
+    budget = 32 << 10
+    on = plan_unified(pipeline.graph, specs, hbm_budget_bytes=budget,
+                      allow_spill=True, include_boundary_policies=False)
+    off = plan_unified(pipeline.graph, specs, hbm_budget_bytes=budget,
+                       allow_spill=False, include_boundary_policies=False)
+    spill_entries = [c for c in (on.scored_candidates if on else [])
+                     if str(c.get("entry", "")).startswith("spill_")]
+    off_spill_entries = [c for c in (off.scored_candidates if off else [])
+                        if str(c.get("entry", "")).startswith("spill_")]
+    out = {
+        "budget_bytes": budget,
+        "spill_alternatives_scored": len(spill_entries),
+        "spill_alternatives_feasible": sum(
+            1 for c in spill_entries if c.get("feasible")),
+        "chosen_spills": len(getattr(on.chosen, "spills", ()) if on
+                             else ()),
+        "kill_switch_spill_entries": len(off_spill_entries),
+        "kill_switch_chosen_spills": len(
+            getattr(off.chosen, "spills", ()) if off else ()),
+    }
+    if not spill_entries:
+        out["error"] = ("planner scored no spill alternatives under a "
+                        "cache-busting budget")
+    elif off_spill_entries or out["kill_switch_chosen_spills"]:
+        out["error"] = ("KEYSTONE_OOC_SPILL=0 arm still scored or chose "
+                        "spill placements")
+    return out
 
 
 def _telemetry_overhead(name="MnistRandomFFT", batch=64, reps=30):
@@ -1438,6 +1701,20 @@ def child_main(args):
                 num_filters=config.num_filters))
     detail.update({"progress": "overlap_tier",
                    "featurize_overlap": overlap})
+    print("BENCH_DETAIL " + json.dumps(detail), flush=True)
+
+    # Out-of-core tier: featurize→solve over a synthetic dataset 8× a
+    # synthetic HBM budget through the windowed spill prefetcher —
+    # peak device residency gated under the budget, windowed solution
+    # allclose to the materialized arm at multiple AND ragged counts,
+    # warm re-run at 0 cold compiles, and the unified planner pricing
+    # the host-spill placement against the INF device cache.
+    ooc_tier = None
+    if not args.skip_ooc_tier:
+        ooc_tier = run_tier(
+            "out_of_core", "ooc_tier", "ooc_tier_done", "warm_seconds",
+            _out_of_core_bench)
+    detail.update({"progress": "ooc_tier", "out_of_core": ooc_tier})
     print("BENCH_DETAIL " + json.dumps(detail), flush=True)
 
     # Dispatch-count tier: programs-per-run for the example pipelines

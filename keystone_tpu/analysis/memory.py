@@ -171,6 +171,25 @@ def memory_pass(
             est.resident[vid] = None
             continue
         resident = full
+        # Host-tier residency: a host-placed CacheMarker output and an
+        # out-of-core / spilled source live in host RAM — on device only
+        # a bounded window (double-buffered chunk) is ever resident,
+        # regardless of the overlap setting (the windowed reload path
+        # streams even serially). This is the static model of the spill
+        # tier the unified planner prices.
+        host_tier = getattr(op, "placement", None) == "host"
+        if not host_tier:
+            ds = getattr(op, "dataset", None)
+            host_tier = bool(getattr(ds, "is_out_of_core", False)
+                             or getattr(ds, "is_spilled", False))
+        if host_tier and isinstance(spec, DataSpec):
+            per_elem = element_nbytes(spec.element)
+            if per_elem is not None:
+                window_bytes = per_elem * chunk_rows * 2
+                if window_bytes < full:
+                    resident = window_bytes
+            est.resident[vid] = resident
+            continue
         if overlap and isinstance(spec, DataSpec) and spec.kind == "dataset" \
                 and (spec.streaming or _may_stream(op)):
             per_elem = element_nbytes(spec.element)

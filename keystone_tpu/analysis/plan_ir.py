@@ -147,6 +147,13 @@ class Assignment:
     #: per-fused-program chain-megakernel on/off (the kernel-vs-XLA
     #: axis over the KP801 fused-trail candidates)
     kernels: Tuple[Tuple[Any, bool], ...] = ()
+    #: cache points placed on the HOST (⊆ caches): the spill tier.
+    #: A spilled cache pins window-residency on device instead of its
+    #: full bytes and pays reload seconds (bytes over the calibrated
+    #: host↔device bandwidth + the dispatch floor per window trip) —
+    #: how a tight KP600 budget becomes satisfiable instead of pruning
+    #: every cache entry to INF.
+    spills: FrozenSet = frozenset()
 
     def fam(self) -> Dict[Any, str]:
         return dict(self.families)
@@ -162,7 +169,8 @@ class Assignment:
 
 
 def _assign(families: Dict, policies: Dict, trails: Dict, chunk: int,
-            caches, kernels: Optional[Dict] = None) -> Assignment:
+            caches, kernels: Optional[Dict] = None,
+            spills=frozenset()) -> Assignment:
     return Assignment(
         families=tuple(sorted(families.items(),
                               key=lambda kv: getattr(kv[0], "id", -1))),
@@ -174,6 +182,7 @@ def _assign(families: Dict, policies: Dict, trails: Dict, chunk: int,
         caches=frozenset(caches),
         kernels=tuple(sorted((kernels or {}).items(),
                              key=lambda kv: getattr(kv[0], "id", -1))),
+        spills=frozenset(spills),
     )
 
 
@@ -192,7 +201,8 @@ class _UnifiedModel:
                  hbm_budget_bytes: Optional[int], chunk_default: int,
                  machine: Machine,
                  include_boundary_policies: bool = True,
-                 precision_floor_bytes: int = 0):
+                 precision_floor_bytes: int = 0,
+                 allow_spill: bool = False):
         from ..workflow.autocache import AutoCacheRule, get_runs
 
         self.graph = graph
@@ -202,6 +212,11 @@ class _UnifiedModel:
         self.chunk_default = int(chunk_default)
         self.machine = machine
         self.precision_floor_bytes = int(precision_floor_bytes)
+        #: spill axis gate (KEYSTONE_OOC_SPILL): when False no spill
+        #: toggle is ever scored, Assignment.spills stays empty, and the
+        #: scorer's spill branches are dead — bit-for-bit the PR-19 plan
+        self.allow_spill = bool(allow_spill)
+        self._host_bw: Optional[float] = None
         self._get_runs = get_runs
         order, _ = toposort(graph)
         self.order = [v for v in order if not isinstance(v, SinkId)]
@@ -303,6 +318,21 @@ class _UnifiedModel:
 
     # ------------------------------------------------------------ pieces
 
+    def host_bandwidth(self) -> float:
+        """Calibrated host↔device bytes/second — the spill tier's
+        reload price denominator. Resolved lazily (only when a spilled
+        assignment is actually scored) so the KEYSTONE_OOC_SPILL=0
+        path never touches the calibration machinery."""
+        if self._host_bw is None:
+            bw = 0.0
+            try:
+                from ..nodes.learning.calibrate import host_bandwidth
+                bw = float(host_bandwidth())
+            except Exception:
+                bw = 0.0
+            self._host_bw = bw if bw > 0 else 1.0e10
+        return self._host_bw
+
     def vbytes(self, vid, policy: str) -> Optional[int]:
         key = (vid, policy)
         if key not in self._nbytes_cache:
@@ -402,15 +432,42 @@ class _UnifiedModel:
         bw = self.machine.peak_bw
 
         # cache residency is pinned for the whole run: it must fit the
-        # per-device budget alongside the plan (hard constraint)
+        # per-device budget alongside the plan (hard constraint). A
+        # HOST-placed cache (the spill tier) pins only its windowed
+        # double-buffer residency — full bytes live in host RAM and
+        # re-enter through the PR-1 overlap prefetcher — which is what
+        # turns a busted budget into a satisfiable constraint.
         if self.budget:
             pinned = 0
             for vid in a.caches:
                 shards = family_shards(families.get(vid), self.mesh)
-                pinned += (self.vbytes(vid, policies.get(vid, POLICY_F32))
-                           or 0) // max(1, shards)
+                nb = (self.vbytes(vid, policies.get(vid, POLICY_F32))
+                      or 0)
+                if vid in a.spills:
+                    count = max(1, self._count(vid))
+                    nb = int(2 * (nb / count) * chunk)
+                pinned += nb // max(1, shards)
             if pinned > self.budget:
                 return _INF
+
+        # spill reload seconds: each spilled cache pays one eviction
+        # (device→host) plus, per consuming re-run, one full windowed
+        # reload (host→device) over the calibrated host bandwidth and
+        # the dispatch floor per window trip — the priced disadvantage
+        # that keeps device placement winning whenever it fits.
+        if a.spills:
+            host_bw = self.host_bandwidth()
+            for vid in a.spills:
+                if vid not in a.caches:
+                    continue
+                nb = (self.vbytes(vid, policies.get(vid, POLICY_F32))
+                      or 0)
+                count = max(1, self._count(vid))
+                trips = max(1, math.ceil(count / chunk))
+                reruns = max(1, runs.get(vid, 1))
+                total += nb / host_bw  # evict once
+                total += reruns * (nb / host_bw
+                                   + trips * DISPATCH_OVERHEAD_S)
 
         for vid, st in self.roof.stages.items():
             pol_v = policies.get(vid, POLICY_F32)
@@ -731,6 +788,40 @@ class _UnifiedModel:
             if gain_cand is None:
                 break
             best, best_obj = gain_cand, best_obj - gain_best
+        # spill-placement toggles (the out-of-core axis): per cache
+        # candidate, flip device↔host placement. Where a device cache
+        # busts the KP600 budget (scored INF in the greedy loop above),
+        # the host-placed variant prices window residency + reload
+        # seconds instead — a tight budget becomes satisfiable, and the
+        # INF/feasible pair IS the ledger's priced alternative set.
+        if self.allow_spill:
+            for vid in self.cache_candidates:
+                caches = set(best.caches)
+                spills = set(best.spills)
+                if vid in spills:
+                    spills.discard(vid)  # back to device placement
+                else:
+                    caches.add(vid)
+                    spills.add(vid)
+                flipped = replace(best, caches=frozenset(caches),
+                                  spills=frozenset(spills))
+                # the spill and window decisions are coupled: a spilled
+                # cache pins O(window) residency, so the toggle is
+                # priced at its best rung — scoring it only at the
+                # incumbent chunk would report INF for spills a smaller
+                # window makes feasible
+                cands = [flipped] + [replace(flipped, chunk=c)
+                                     for c in ladder
+                                     if c != flipped.chunk]
+                try_(f"spill_{getattr(vid, 'id', vid)}",
+                     min(cands, key=self.score))
+            if best.spills:
+                # a spilled cache changes the chunk economics (reload
+                # trips vs window residency): re-walk the ladder once
+                for chunk in ladder:
+                    if chunk != best.chunk:
+                        try_(f"chunk_{chunk}",
+                             replace(best, chunk=chunk))
         # family/policy coordinate sweeps
         fam_menu = dict(self.pmodel.menus) if self.pmodel else {}
         pol_menu = dict(self.prmodel.menus) if self.prmodel else {}
@@ -795,6 +886,12 @@ class UnifiedPlan:
     #: fused program the joint plan lowers to a chain megakernel — the
     #: `UnifiedPlannerRule` kernel-enforcement payload
     kernel_choices: Dict[Any, Dict[str, Any]] = field(default_factory=dict)
+    #: vid -> {bytes, window_trips, reload_seconds} for every spilled
+    #: cache point — the ledger's predicted side of the spill decision
+    #: (`reconcile_decisions` joins it against the observed
+    #: spill.reload_stall_s histogram and spill_window spans)
+    spill_predictions: Dict[Any, Dict[str, Any]] = field(
+        default_factory=dict)
     unpriced_stages: int = 0
 
     @property
@@ -818,6 +915,14 @@ class UnifiedPlan:
         return sorted(self.chosen.caches,
                       key=lambda v: getattr(v, "id", -1))
 
+    @property
+    def spill_vertices(self) -> List:
+        """Cache points the joint plan places on the HOST (⊆
+        cache_vertices) — the `UnifiedPlannerRule` spill-enforcement
+        payload (`CacheMarker(placement="host")`)."""
+        return sorted(self.chosen.spills,
+                      key=lambda v: getattr(v, "id", -1))
+
     def changed_kinds(self) -> List[str]:
         """Which decision kinds deviate from the sequential
         composition — what `UnifiedPlannerRule` must enforce (and
@@ -835,6 +940,8 @@ class UnifiedPlan:
             out.append("cache")
         if self.chosen.kernels != self.sequential_assignment.kernels:
             out.append("kernel")
+        if self.chosen.spills != self.sequential_assignment.spills:
+            out.append("spill")
         return out
 
     def rows(self, graph: Graph) -> List[Dict[str, Any]]:
@@ -846,6 +953,7 @@ class UnifiedPlan:
         trails = self.chosen.trl()
         seq_trails = self.sequential_assignment.trl()
         caches = set(self.chosen.caches)
+        spills = set(self.chosen.spills)
         kernels = self.chosen.krn()
         rows = []
         for vid in order:
@@ -865,6 +973,7 @@ class UnifiedPlan:
                 "trail": trails.get(vid),
                 "sequential_trail": seq_trails.get(vid),
                 "cached": vid in caches,
+                "spilled": vid in spills,
                 "kernel": bool(kernels.get(vid)),
                 "changed": (fams.get(vid) != seq_fams.get(vid)
                             or pols.get(vid) != seq_pols.get(vid)
@@ -881,7 +990,8 @@ def format_plan(plan: UnifiedPlan, graph: Graph) -> str:
         f"≈{plan.sequential_seconds:.3e}s "
         f"({'strict win' if plan.improved else 'no win: sequential plan'}"
         f", chunk {plan.default_chunk_size} → {plan.chunk_size}, "
-        f"{len(plan.cache_vertices)} cache point(s))"
+        f"{len(plan.cache_vertices)} cache point(s), "
+        f"{len(plan.spill_vertices)} spilled to host)"
     ]
     header = (f"{'stage':<36} {'family':<22} {'policy':<14} "
               f"{'cache':>5} {'kern':>5}")
@@ -897,7 +1007,7 @@ def format_plan(plan: UnifiedPlan, graph: Graph) -> str:
         body.append(
             f"{mark}{(r['label'] + '@' + str(r['vertex']))[:35]:<35} "
             f"{fam[:22]:<22} {pol[:14]:<14} "
-            f"{'yes' if r['cached'] else '':>5} "
+            f"{('host' if r.get('spilled') else 'yes') if r['cached'] else '':>5} "
             f"{'yes' if r.get('kernel') else '':>5}")
     if len(body) > 1:
         lines.extend(body)
@@ -919,6 +1029,7 @@ def plan_unified(
     include_boundary_policies: bool = True,
     precision_floor_bytes: int = 0,
     ladder: Tuple[int, ...] = CHUNK_LADDER,
+    allow_spill: Optional[bool] = None,
 ) -> Optional[UnifiedPlan]:
     """Solve the joint decision IR for one graph.
 
@@ -936,12 +1047,17 @@ def plan_unified(
     machine = machine or default_machine()
     from ..workflow.env import execution_config
 
-    chunk_default = int(chunk_default
-                        or execution_config().chunk_size)
+    cfg = execution_config()
+    chunk_default = int(chunk_default or cfg.chunk_size)
+    if allow_spill is None:
+        # KEYSTONE_OOC_SPILL=0 is the bit-for-bit kill switch: no spill
+        # toggle is scored and the chosen plan matches PR 19 exactly
+        allow_spill = bool(getattr(cfg, "ooc_spill", False))
     model = _UnifiedModel(
         graph, specs, mesh, hbm_budget_bytes, chunk_default, machine,
         include_boundary_policies=include_boundary_policies,
-        precision_floor_bytes=precision_floor_bytes)
+        precision_floor_bytes=precision_floor_bytes,
+        allow_spill=allow_spill)
     if not model.roof.stages:
         return None
     has_axis = bool(model.cache_candidates or model.program_trails
@@ -1019,6 +1135,20 @@ def plan_unified(
         for vid, on in best.krn().items()
         if on and vid in model.kernel_candidates
     }
+    spill_predictions: Dict[Any, Dict[str, Any]] = {}
+    if best.spills:
+        host_bw = model.host_bandwidth()
+        pols = best.pol()
+        for vid in best.spills:
+            nb = model.vbytes(vid, pols.get(vid, POLICY_F32)) or 0
+            count = max(1, model._count(vid))
+            trips = max(1, math.ceil(count / max(1, best.chunk)))
+            spill_predictions[vid] = {
+                "bytes": int(nb),
+                "window_trips": int(trips),
+                "reload_seconds": float(
+                    2 * nb / host_bw + trips * DISPATCH_OVERHEAD_S),
+            }
     boundary_precision = None
     if model.pplan is not None and model.prmodel is not None:
         from .precision import PrecisionPlan
@@ -1046,5 +1176,6 @@ def plan_unified(
         program_precision=program_precision,
         boundary_precision=boundary_precision,
         kernel_choices=kernel_choices,
+        spill_predictions=spill_predictions,
         unpriced_stages=model.unpriced_stages,
     )

@@ -653,6 +653,12 @@ def plan_sharding(
             frozen[cur] = fam
             parent = chain_parent.get(cur)
             fam = back.get(cur, {}).get(fam) if parent is not None else None
+            if parent is not None and fam is None:
+                # all-INF chain under a KP600 budget (every transition
+                # priced infeasible, so no backpointer was recorded):
+                # keep the default family rather than poisoning the
+                # assignment with None — score() still prices it INF
+                fam = default_families[parent]
             cur = parent
 
     for vid in model.order:

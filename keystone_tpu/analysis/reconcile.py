@@ -278,6 +278,31 @@ def reconcile_decisions(run: Dict[str, Any]) -> Dict[str, Any]:
                                   .get("kernels") or []))
                     if pred_k:
                         residuals["kernel_seconds"] = pred_k - obs_sec
+        elif kind == "spill":
+            # the spill decision observes the windowed reload machinery
+            # it priced: `spill_window` spans (one per host→device
+            # window trip), the spill byte counters, and the measured
+            # reload-stall histogram — residual is the planner's
+            # predicted reload seconds minus the observed stall total
+            spill_spans = [
+                e for e in trace.get("traceEvents", [])
+                if e.get("ph") == "X" and e.get("name") == "spill_window"
+            ]
+            if spill_spans:
+                observed["window_trips"] = len(spill_spans)
+            for metric, cname in (("bytes_out", "spill.bytes_out"),
+                                  ("bytes_in", "spill.bytes_in")):
+                v = _counter_value(trace, cname)
+                if v is not None:
+                    observed[metric] = v
+            hist = (trace.get("keystone", {}).get("metrics", {})
+                    .get("histograms", {}).get("spill.reload_stall_s"))
+            if hist and hist.get("count"):
+                observed["reload_stall_s"] = float(hist["total"])
+                if "reload_seconds" in pred and pred["reload_seconds"]:
+                    residuals["reload_seconds"] = (
+                        float(pred["reload_seconds"])
+                        - float(hist["total"]))
         elif kind == "conformance":
             # the watchdog's breach record joins against the live
             # request spans at the SAME padded shape: observed is the

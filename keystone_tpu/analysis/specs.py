@@ -225,6 +225,21 @@ def spec_of(value: Any) -> Any:
 
     if isinstance(value, SpecDataset):
         return value.spec
+    if getattr(value, "is_out_of_core", False) or getattr(value, "is_spilled", False):
+        # Host-resident out-of-core forms: element shape from one probed
+        # row (a single-shard touch for OutOfCoreDataset, free for
+        # SpilledDataset), marked off-device so placement/memory passes
+        # never charge the full payload against HBM.
+        element = UNKNOWN
+        try:
+            row = value.row_loader(0, 1)
+            element = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype),
+                row)
+        except Exception:
+            pass
+        return DataSpec(element=element, count=value.count, kind="dataset",
+                        on_device=False)
     if isinstance(value, Dataset):
         element = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype), value.data

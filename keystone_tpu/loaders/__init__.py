@@ -1,6 +1,11 @@
 from .csv_loader import LabeledData, csv_data_loader
 from .cifar_loader import cifar_loader, synthetic_cifar
 from .image_loaders import imagenet_loader, load_images_from_tar, voc_loader
+from .ooc_loader import (
+    out_of_core_from_shards,
+    out_of_core_npy_loader,
+    synthetic_out_of_core,
+)
 from .text_loaders import (
     TextLabeledData,
     amazon_reviews_loader,

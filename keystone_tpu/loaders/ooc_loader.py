@@ -1,0 +1,86 @@
+"""Out-of-core source constructors (arXiv 1610.09451 §5: pipelines over
+datasets far larger than any node's memory).
+
+Each constructor returns a `data.dataset.OutOfCoreDataset` — per-shard
+loader callbacks that materialize NOTHING up front. Rows enter the
+device through the windowed prefetcher (`utils.batching.
+stream_spill_windows`) at O(window) residency; the planner's spill tier
+decides the window and whether intermediate caches live on the host.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import OutOfCoreDataset
+
+
+def out_of_core_from_shards(
+    loaders: Sequence[Callable[[], np.ndarray]],
+    counts: Sequence[int],
+    mesh=None,
+    name: str = "ooc",
+) -> OutOfCoreDataset:
+    """The general form: one zero-arg loader callback per shard plus its
+    declared row count (counts must be known up front so the window plan
+    and the planner's live-set model never force a load)."""
+    return OutOfCoreDataset(loaders, counts, mesh=mesh, name=name)
+
+
+def out_of_core_npy_loader(
+    pattern: str, mesh=None, name: str = "npy",
+) -> OutOfCoreDataset:
+    """Sharded ``.npy`` files matching a glob, sorted by path — the
+    on-disk analog of the reference's per-partition HDFS files. Row
+    counts come from the npy headers (shape metadata only; `np.load`
+    with ``mmap_mode`` reads no data pages), so construction touches no
+    payload bytes."""
+    paths = sorted(_glob.glob(pattern))
+    if not paths:
+        raise FileNotFoundError(f"no shards match {pattern!r}")
+    counts = []
+    for p in paths:
+        counts.append(int(np.load(p, mmap_mode="r").shape[0]))
+
+    def make_loader(path: str) -> Callable[[], np.ndarray]:
+        return lambda: np.load(path)
+
+    return OutOfCoreDataset([make_loader(p) for p in paths], counts,
+                            mesh=mesh, name=name)
+
+
+def synthetic_out_of_core(
+    count: int,
+    dim: int,
+    shard_rows: int = 4096,
+    dtype=np.float32,
+    seed: int = 0,
+    mesh=None,
+    fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+) -> OutOfCoreDataset:
+    """Deterministic synthetic source for benches and tests: shard i is
+    generated on demand from ``seed + i`` (so 'loading' costs generation,
+    not disk, and two walks see identical rows). ``fn`` post-processes
+    each generated shard (e.g. to derive labels)."""
+    if count <= 0 or shard_rows <= 0:
+        raise ValueError("count and shard_rows must be positive")
+    counts = []
+    lo = 0
+    while lo < count:
+        counts.append(min(shard_rows, count - lo))
+        lo += counts[-1]
+
+    def make_loader(i: int, rows: int) -> Callable[[], np.ndarray]:
+        def load() -> np.ndarray:
+            rng = np.random.default_rng(seed + i)
+            arr = rng.standard_normal((rows, dim)).astype(dtype)
+            return fn(arr) if fn is not None else arr
+
+        return load
+
+    return OutOfCoreDataset(
+        [make_loader(i, c) for i, c in enumerate(counts)], counts,
+        mesh=mesh, name="synthetic")

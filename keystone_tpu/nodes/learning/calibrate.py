@@ -43,6 +43,12 @@ class CostWeights:
     #: disagree.
     peak_flops: float = 0.0  # FLOP/s
     peak_bw: float = 0.0     # HBM B/s
+    #: sustained host↔device transfer bandwidth (B/s) — the out-of-core
+    #: spill tier's reload price (`analysis.plan_ir`: reload bytes /
+    #: host_bw + one dispatch floor per window trip). 0.0 means
+    #: "unmeasured": `host_bandwidth()` resolves it to the platform
+    #: analytic default, so every existing constructor keeps working.
+    host_bw: float = 0.0     # host↔device B/s
 
     def __post_init__(self):
         if not self.peak_flops and self.cpu_weight > 0:
@@ -161,7 +167,30 @@ def calibrate_cost_weights(
                    name="network")
         network_weight = t / ici_bytes
 
-    return CostWeights(cpu_weight, mem_weight, network_weight)
+    return CostWeights(cpu_weight, mem_weight, network_weight,
+                       host_bw=_probe_host_bw(mem_mb))
+
+
+def _probe_host_bw(mem_mb: int = 64, reps: int = 3) -> float:
+    """Sustained host→device transfer bandwidth (B/s): min-of-reps
+    `device_put` of a fresh host buffer, fenced by `block_until_ready`.
+    Min (not median) because page faults and allocator warmup only ever
+    slow a transfer down — the best rep is the sustainable rate the
+    spill tier's reload price should use. Returns 0.0 (= "unmeasured",
+    resolved analytically by `host_bandwidth()`) if the probe fails."""
+    try:
+        n = mem_mb * (1 << 20) // 4
+        src = np.ones((n,), np.float32)
+        nbytes = 4.0 * n
+        best = float("inf")
+        for _ in range(reps + 1):  # first rep warms the transfer path
+            src += 1.0  # fresh values: a memoizing transport cannot reuse
+            t0 = time.perf_counter()
+            jax.device_put(src).block_until_ready()  # keystone: ignore[KJ005] — the transfer IS the measured work
+            best = min(best, time.perf_counter() - t0)
+        return nbytes / best if best > 0 else 0.0
+    except Exception:
+        return 0.0
 
 
 def default_weights() -> CostWeights:
@@ -187,6 +216,7 @@ def write_calibration(path: str, weights: CostWeights,
         "network_weight": float(weights.network_weight),
         "peak_flops": float(weights.peak_flops),
         "peak_bw": float(weights.peak_bw),
+        "host_bw": float(weights.host_bw),
         "provenance": prov,
     }
     with open(path, "w") as f:
@@ -225,3 +255,49 @@ def machine_rates() -> "tuple[float, float]":
     if analytic and cost_model._live_platform_no_init() == "cpu":
         return CPU_PEAK_FLOPS, CPU_PEAK_BW
     return 1.0 / cw, 1.0 / mw
+
+
+#: Analytic host↔device transfer bandwidths (B/s) for the spill tier's
+#: reload price when no measured calibration applies. CPU backend: a
+#: "transfer" is a host memcpy (~8 GB/s, same order as the DDR stream
+#: above but cheaper than a full read+write pass). TPU: PCIe-class
+#: pageable host→device (~10 GB/s) — deliberately ~80× below the v5e
+#: HBM stream rate, which is exactly why spilling must be PRICED, not
+#: free: a reload trip costs real seconds the planner has to win back
+#: in residency.
+CPU_HOST_BW = 8.0e9
+ANALYTIC_HOST_BW = 1.0e10
+
+
+def host_bandwidth() -> float:
+    """Sustained host↔device bandwidth (B/s) — the `machine_rates()`
+    companion the out-of-core spill tier prices reloads with, resolved
+    the same way: a measured calibration file whose platform matches
+    the live backend wins (its ``host_bw`` entry, when the probe
+    recorded one); otherwise the platform analytic constant above.
+    Kept a separate accessor (not a third `machine_rates()` element)
+    because that tuple's arity is a published contract of the roofline
+    layer. Never initializes a JAX backend."""
+    import json
+    import os
+
+    mode = os.environ.get("KEYSTONE_COST_CALIBRATION", "")
+    if mode != "analytic":
+        path = mode if mode not in ("", "force") else os.path.join(
+            os.path.dirname(cost_model.__file__), "tpu_calibration.json")
+        try:
+            with open(path) as f:
+                cal = json.load(f)
+            prov = cal.get("provenance")
+            cal_platform = (prov.get("platform")
+                            if isinstance(prov, dict) else None)
+            live = cost_model._live_platform_no_init()
+            if float(cal.get("host_bw", 0.0)) > 0 and (
+                    mode == "force"
+                    or (live is not None and live == cal_platform)):
+                return float(cal["host_bw"])
+        except Exception:
+            pass  # unreadable/absent file: analytic, like machine_rates
+    if cost_model._live_platform_no_init() == "cpu":
+        return CPU_HOST_BW
+    return ANALYTIC_HOST_BW

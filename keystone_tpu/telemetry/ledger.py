@@ -64,7 +64,7 @@ LEDGER_VERSION = 1
 #: runtime watchdog's record kind: a live apply that breached its KP903
 #: certified bound (bound vs observed vs flight-dump artifact).
 KINDS = ("fusion", "megafusion", "placement", "precision", "chunk",
-         "cache", "kernel", "conformance")
+         "cache", "kernel", "spill", "conformance")
 
 #: the config fields a run header snapshots, with the env var that
 #: flips each — the channel by which ``--diff`` names a kill-switch
@@ -82,6 +82,7 @@ CONFIG_ENV = {
     "pallas_kernels": "KEYSTONE_CHAIN_KERNELS",
     "live_telemetry": "KEYSTONE_LIVE_TELEMETRY",
     "serving_coalesce": "KEYSTONE_SERVING_COALESCE",
+    "ooc_spill": "KEYSTONE_OOC_SPILL",
 }
 
 _LOCK = threading.Lock()
@@ -622,6 +623,7 @@ _KIND_FIELDS = {
     "chunk": ("unified_planner",),
     "cache": ("unified_planner",),
     "kernel": ("pallas_kernels", "unified_planner"),
+    "spill": ("ooc_spill", "unified_planner"),
     "conformance": ("live_telemetry",),
 }
 

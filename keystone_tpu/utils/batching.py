@@ -535,6 +535,137 @@ def map_host_batched_stream(
     return _stream_serial(items, plan, batch_fn)
 
 
+# --------------------------------------------------------------------------
+# Windowed host→device prefetcher (the out-of-core spill tier's reload
+# path). A host-resident source — a planner-spilled cache
+# (`data.dataset.SpilledDataset`) or an on-demand sharded source
+# (`data.dataset.OutOfCoreDataset`) — re-enters the device in bounded
+# row WINDOWS on the same pow-2 pad ladder chunk dispatch uses, so warm
+# runs compile one program per window shape and device residency stays
+# O(window), never O(count). Overlapped (the default), the load+upload
+# of window k+1 rides `prefetch_iterator`'s producer thread while the
+# consumer computes on window k — the PR-1 double buffer, pointed at
+# reload traffic. Telemetry: ``spill.bytes_in`` counts re-entered bytes,
+# ``spill.reload_stall_s`` observes the consumer's blocking wait per
+# window (the observed side `analysis.reconcile` joins against the
+# planner's predicted reload seconds), ``spill.window_trips`` counts
+# reload dispatch trips.
+
+
+def _window_plan(
+    count: int, window: Optional[int], pad: bool = True
+) -> List[Tuple[int, int, int]]:
+    """``[(lo, hi, pad_to)]`` row windows covering ``range(count)``
+    exactly once, in order. The ragged final window pads on the same
+    ladder as chunk dispatch (`_pad_target`): up to the window size when
+    the source fills at least one whole window, up a pow-2 ladder for
+    tiny sources — so a warm reload pass adds 0 cold compiles no matter
+    the count."""
+    window = window or count
+    plan: List[Tuple[int, int, int]] = []
+    lo = 0
+    while lo < count:
+        hi = min(count, lo + window)
+        pad_to = _pad_target(hi - lo, window, count) if pad else hi - lo
+        plan.append((lo, hi, pad_to))
+        lo = hi
+    return plan
+
+
+def _pad_rows(arr: np.ndarray, pad_to: int) -> np.ndarray:
+    n = arr.shape[0]
+    if pad_to > n:
+        widths = [(0, pad_to - n)] + [(0, 0)] * (arr.ndim - 1)
+        arr = np.pad(arr, widths)
+    return arr
+
+
+def _stage_spill_window(load, lo: int, hi: int, pad_to: int):
+    """Load rows [lo, hi) from the host source, pad each leaf up to
+    ``pad_to`` on the leading axis, and upload — the producer-side work
+    the overlapped path runs one window ahead. ``load`` may return one
+    array or any pytree of arrays sharing the leading dim."""
+    import jax
+
+    host = load(lo, hi)
+    leaves, treedef = jax.tree_util.tree_flatten(host)
+    nbytes = 0.0
+    staged = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        nbytes += float(arr.nbytes)
+        staged.append(_device_put_host(_pad_rows(arr, pad_to)))
+    counter("spill.bytes_in").inc(nbytes)
+    return list(range(lo, hi)), jax.tree_util.tree_unflatten(treedef, staged)
+
+
+def stream_spill_windows(
+    load: Callable,
+    count: int,
+    window=USE_CONFIG_CHUNK,
+) -> Iterator[Tuple[List[int], object]]:
+    """Yield ``(indices, device_window)`` over a host-resident source of
+    ``count`` rows, ``window`` rows at a time (default: the resolved
+    chunk size — the unified planner's window decision reaches reloads
+    through the same `resolved_chunk_size` seam as chunk dispatch).
+
+    ``load(lo, hi)`` returns host rows [lo, hi) (array or pytree).
+    ``indices`` always cover exactly ``range(count)`` across the yielded
+    windows, in order; the device window's leading axis is padded to the
+    pow-2 ladder target, so consumers must slice their result to
+    ``len(indices)`` rows (or use `map_spill_windows`, which does).
+    With the overlap engine on and more than one window, staging of
+    window k+1 overlaps the consumer's compute on window k."""
+    from ..workflow.env import execution_config
+
+    window = _resolve_chunk(window)
+    cfg = execution_config()
+    plan = _window_plan(count, window, pad=cfg.pad_chunks)
+    stall = histogram("spill.reload_stall_s")
+    trips = counter("spill.window_trips")
+
+    def gen():
+        for i, (lo, hi, pad_to) in enumerate(plan):
+            with span("spill_window", cat="chunk", idx=i, rows=hi - lo):
+                yield _stage_spill_window(load, lo, hi, pad_to)
+
+    it = (prefetch_iterator(gen(), cfg.prefetch_depth)
+          if cfg.overlap and len(plan) > 1 else gen())
+    try:
+        while True:
+            t0 = perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            # the consumer-side reload stall: ~the full load+upload on
+            # the serial path, ~0 when the producer thread stayed ahead
+            stall.observe(perf_counter() - t0)
+            trips.inc()
+            yield item
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()  # early exit cancels the producer thread
+
+
+def map_spill_windows(
+    load: Callable,
+    count: int,
+    fn: Callable,
+    window=USE_CONFIG_CHUNK,
+) -> Iterator[Tuple[List[int], List]]:
+    """Apply ``fn`` to each reloaded device window, yielding the
+    standard ``(indices, results)`` chunk contract: per-row results in
+    source order, phantom padded rows sliced off before anything
+    downstream sees them — the PR-5 pad-exactness contract extended to
+    windows."""
+    for idxs, win in stream_spill_windows(load, count, window):
+        record_dispatch()  # one program per reloaded window
+        out = fn(win)
+        yield _split_result(out, idxs)
+
+
 def map_host_batched(
     items: Sequence,
     batch_fn: Callable,

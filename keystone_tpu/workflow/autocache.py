@@ -36,22 +36,51 @@ logger = logging.getLogger(__name__)
 
 class CacheMarker(TransformerOperator):
     """Identity node that materializes + prefix-memoizes its input
-    (≈ Cacher, nodes/util/Cacher.scala:15-25)."""
+    (≈ Cacher, nodes/util/Cacher.scala:15-25).
+
+    ``placement`` is the spill tier the unified planner's cache axis
+    chooses per cache point: ``"device"`` (the classic Cacher — pin the
+    value in HBM) or ``"host"`` (pull it off the device into a
+    `data.dataset.SpilledDataset`, freeing the HBM it pinned; counted as
+    ``spill.bytes_out``). Host-placed caches re-enter the device in
+    bounded windows through the overlap double-buffer — chunk-capable
+    consumers stream them via `Transformer.batch_transform_stream`, and
+    whole-batch consumers `rehydrate()` — so under a tight
+    ``hbm_budget_bytes`` the planner trades reload seconds for
+    residency instead of declaring the plan infeasible."""
 
     saveable = True
+    #: identity over rows — distributes over chunks, so a host cache can
+    #: sit inside a chunk stream without forcing materialization
+    chunkable = True
+    #: value-preserving plumbing: the precision analyzer looks through
+    precision_passthrough = True
 
-    def __init__(self, name: str = ""):
+    def __init__(self, name: str = "", placement: str = "device"):
+        if placement not in ("device", "host"):
+            raise ValueError(f"unknown cache placement {placement!r}")
         self.name = name
+        self.placement = placement
 
     @property
     def label(self) -> str:
+        if self.placement == "host":
+            return f"Cache[host:{self.name}]"
         return f"Cache[{self.name}]"
 
     def single_transform(self, inputs):
         return inputs[0]
 
     def batch_transform(self, inputs):
+        from ..data.dataset import Dataset, SpilledDataset
+
         data = inputs[0]
+        if self.placement == "host":
+            if isinstance(data, Dataset):
+                return SpilledDataset.spill(data, name=self.name)
+            # already host-resident (SpilledDataset / HostDataset /
+            # out-of-core source): nothing to evict
+            return data
         return data.cache() if hasattr(data, "cache") else data
 
 
@@ -261,10 +290,12 @@ class AutoCacheRule(Rule):
         return out
 
     @staticmethod
-    def _insert_cache(graph: Graph, node: NodeId) -> Graph:
+    def _insert_cache(graph: Graph, node: NodeId,
+                      placement: str = "device") -> Graph:
         """Splice a CacheMarker between ``node`` and all its users."""
         op = graph.get_operator(node)
-        g, cache_id = graph.add_node(CacheMarker(op.label), [node])
+        g, cache_id = graph.add_node(
+            CacheMarker(op.label, placement=placement), [node])
         # Rewire users of node (except the new cache node) to the cache.
         dd = {
             m: tuple(cache_id if (d == node and m != cache_id) else d for d in deps)

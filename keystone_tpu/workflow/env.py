@@ -222,6 +222,19 @@ class ExecutionConfig:
     batcher waits at most this long for followers before dispatching.
     0 dispatches whatever is queued immediately (lowest latency, least
     coalescing).
+
+    ``ooc_spill`` (default on; env ``KEYSTONE_OOC_SPILL=0`` kills,
+    ledger-header recorded so ``--diff`` can name the flip) turns on the
+    out-of-core spill tier of the unified plan optimizer: cache points
+    may be placed on the HOST (`CacheMarker(placement="host")`), priced
+    by the calibrated host↔device bandwidth (reload bytes / host_bw +
+    one dispatch floor per window trip) and charged at window-residency
+    instead of full-residency by the KP2xx/KP600 live-set model — so a
+    plan whose pinned caches bust ``hbm_budget_bytes`` can become
+    *feasible* by spilling instead of being rejected. ``=0`` is
+    bit-for-bit the device-only menu: no spill entries are priced, no
+    host placements are enforced, and the chosen plan is exactly what
+    the PR-19 optimizer produced.
     """
 
     overlap: bool = True
@@ -246,6 +259,7 @@ class ExecutionConfig:
     serving_coalesce: bool = True
     serving_queue_depth: int = 256
     serving_window_ms: float = 2.0
+    ooc_spill: bool = True
 
 
 _exec_config: Optional[ExecutionConfig] = None
@@ -369,6 +383,8 @@ def execution_config() -> ExecutionConfig:
                 "KEYSTONE_SERVING_QUEUE_DEPTH", "256"))),
             serving_window_ms=max(0.0, float(os.environ.get(
                 "KEYSTONE_SERVING_WINDOW_MS", "2.0"))),
+            ooc_spill=os.environ.get(
+                "KEYSTONE_OOC_SPILL", "1").lower() not in _OFF,
         )
         _sync_compile_cache(_exec_config)
     return _exec_config

@@ -312,7 +312,21 @@ class EstimatorOperator(Operator):
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         deps = list(deps)
-        return TransformerExpression(lambda: self.fit_datasets([d.get for d in deps]))
+
+        def _training_input(d: Expression):
+            # whole-batch training consumer of a host-tier value: the
+            # sanctioned full re-entry (mirrors Transformer.apply_batch;
+            # solvers that can stream windows consume the spilled form
+            # directly and never land here)
+            v = d.get
+            if getattr(v, "is_spilled", False):
+                v = v.rehydrate()
+            elif getattr(v, "is_out_of_core", False):
+                v = v.materialize()
+            return v
+
+        return TransformerExpression(
+            lambda: self.fit_datasets([_training_input(d) for d in deps]))
 
 
 def fitted_elem_fn(transformer: "TransformerOperator"):

@@ -463,14 +463,35 @@ class UnifiedPlannerRule(Rule):
         if "chunk" in kinds:
             self._record(uplan, "chunk", [], graph)
             set_planned_chunk_size(uplan.chunk_size)
+        spilled = set(getattr(uplan.chosen, "spills", frozenset()))
         if "cache" in kinds:
             from .autocache import AutoCacheRule
 
-            self._record(uplan, "cache", uplan.cache_vertices, graph)
-            for vid in sorted(uplan.cache_vertices,
+            # spilled vids live in `caches` too — they are enforced by
+            # the spill branch below as host-placed markers, never
+            # double-inserted here as device caches
+            device_caches = [v for v in uplan.cache_vertices
+                             if v not in spilled]
+            if device_caches:
+                self._record(uplan, "cache", device_caches, graph)
+            for vid in sorted(device_caches,
                               key=lambda v: -getattr(v, "id", -1)):
                 if vid in graph.operators:
                     graph = AutoCacheRule._insert_cache(graph, vid)
+        if "spill" in kinds and getattr(cfg, "ooc_spill", True):
+            # the spill tier: a host-placed CacheMarker materializes the
+            # value as numpy on host and re-enters the device through
+            # the windowed prefetcher. KEYSTONE_OOC_SPILL=0 never gets
+            # here (plan_unified scores no spill toggles), but the gate
+            # is belt-and-braces against a hand-built plan.
+            from .autocache import AutoCacheRule
+
+            self._record(uplan, "spill", uplan.spill_vertices, graph)
+            for vid in sorted(uplan.spill_vertices,
+                              key=lambda v: -getattr(v, "id", -1)):
+                if vid in graph.operators:
+                    graph = AutoCacheRule._insert_cache(
+                        graph, vid, placement="host")
         if own_tags:
             # ownership survives tag-free deviations (a reverted
             # sequential placement, a trail turned off, dataset-only
@@ -503,6 +524,15 @@ class UnifiedPlannerRule(Rule):
             if kind == "cache":
                 chosen["cache_points"] = [getattr(v, "id", -1)
                                           for v in present]
+            if kind == "spill":
+                chosen["spill_points"] = [getattr(v, "id", -1)
+                                          for v in present]
+                chosen["placement"] = "host"
+                preds = getattr(uplan, "spill_predictions", {}) or {}
+                chosen["spills"] = [
+                    dict(preds.get(v, {}), vertex=getattr(v, "id", -1))
+                    for v in present
+                ]
             if kind == "kernel":
                 chosen["kernels"] = [
                     {
@@ -526,6 +556,7 @@ class UnifiedPlannerRule(Rule):
             prefixes = {"chunk": ("chunk_",), "cache": ("cache_",),
                         "precision": ("trail_",),
                         "kernel": ("kernel_",),
+                        "spill": ("spill_", "cache_"),
                         "placement": ()}.get(kind, ())
             alternatives = [
                 c for c in uplan.scored_candidates
@@ -533,6 +564,18 @@ class UnifiedPlannerRule(Rule):
                 or (prefixes
                     and str(c.get("entry", "")).startswith(prefixes))
             ]
+            predicted = {
+                "predicted_seconds": float(uplan.joint_seconds),
+                "sequential_seconds": float(uplan.sequential_seconds),
+                "seconds_saved": float(uplan.savings_seconds),
+            }
+            if kind == "spill":
+                preds = getattr(uplan, "spill_predictions", {}) or {}
+                reload_s = sum(
+                    float(p.get("reload_seconds") or 0.0)
+                    for v, p in preds.items() if v in present)
+                if reload_s:
+                    predicted["reload_seconds"] = reload_s
             ledger.record_decision(
                 kind=kind,
                 rule="UnifiedPlannerRule",
@@ -540,12 +583,7 @@ class UnifiedPlannerRule(Rule):
                 labels=[_label(graph, v) for v in present],
                 chosen=chosen,
                 alternatives=alternatives,
-                predicted={
-                    "predicted_seconds": float(uplan.joint_seconds),
-                    "sequential_seconds": float(
-                        uplan.sequential_seconds),
-                    "seconds_saved": float(uplan.savings_seconds),
-                },
+                predicted=predicted,
             )
         except Exception:
             logger.debug("unified decision not recorded", exc_info=True)

@@ -467,11 +467,43 @@ class Transformer(TransformerOperator, Chainable):
 
         if isinstance(inputs[0], HostDataset):
             return self.apply_batch_stream(inputs[0])
+        if self.chunkable and (getattr(inputs[0], "is_out_of_core", False)
+                               or getattr(inputs[0], "is_spilled", False)):
+            # Out-of-core tier: a host-resident source (planner-spilled
+            # cache or on-demand sharded loader) re-enters the device in
+            # bounded windows instead of materializing — residency stays
+            # O(window) through every chunkable stage downstream.
+            return self._windowed_batch_stream(inputs[0])
         return None
+
+    def _windowed_batch_stream(self, source):
+        """Per-window batch path over an out-of-core source: stage each
+        pow-2 row window (reload overlapped with compute by
+        `stream_spill_windows`), run this stage's fused batch path on
+        it, and yield the standard ``(indices, results)`` chunk contract
+        with phantom padded rows sliced off."""
+        from ..data.dataset import Dataset
+        from ..utils.batching import _split_result, stream_spill_windows
+
+        for idxs, win in stream_spill_windows(source.row_loader,
+                                              source.count):
+            import jax
+
+            n = jax.tree_util.tree_leaves(win)[0].shape[0]
+            ds = Dataset(win, count=n, mesh=source.mesh, _placed=True)
+            out = self.apply_batch(ds)
+            yield _split_result(getattr(out, "data", out), idxs)
 
     def apply_batch(self, data: Any) -> Any:
         from ..data.dataset import Dataset, HostDataset
 
+        if getattr(data, "is_spilled", False):
+            # whole-batch consumer of a spilled value: the sanctioned
+            # full re-entry (chunk-capable consumers never land here —
+            # they stream windows via batch_transform_stream)
+            data = data.rehydrate()
+        elif getattr(data, "is_out_of_core", False):
+            data = data.materialize()
         if isinstance(data, Dataset):
             # One stable jitted vmap per transformer instance: repeated
             # batch applies hit the jit cache instead of retracing (the

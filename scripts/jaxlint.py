@@ -221,6 +221,21 @@ system cannot (see ANALYSIS.md for the full catalog):
          ``KEYSTONE_SERVING_QUEUE_DEPTH`` knob), or suppress with a
          rationale naming why the producer is statically bounded.
 
+  KJ020  ooc-whole-dataset-drain (under ``data/`` and ``workflow/``): a
+         whole-dataset materialization of an out-of-core source — a
+         name bound from ``OutOfCoreDataset(...)``,
+         ``SpilledDataset(...)``, or an ``out_of_core_*``/
+         ``synthetic_out_of_core`` loader fed to ``np.asarray``/
+         ``np.array``/``np.stack``/``np.concatenate`` or drained via
+         ``list()``/``tuple()``. The entire point of the spill tier is
+         bounded device residency through the windowed prefetcher
+         (``window_iter()``/``map_windowed()``); an ad-hoc full drain
+         reintroduces the dataset-sized allocation the planner promised
+         away. The sanctioned full drains are the methods the classes
+         themselves expose (``materialize()``/``rehydrate()``/
+         ``numpy()``) at call sites that own that decision — suppress
+         with a rationale when a full drain is genuinely intended.
+
 Suppression: append ``# keystone: ignore[KJ001]`` (comma-separate for
 several rules) to the flagged line, or to the ``def`` line for KJ003.
 
@@ -319,6 +334,13 @@ RULES = {
              "an unbounded one converts overload into unbounded "
              "memory and queueing delay (size it from "
              "serving_queue_depth)",
+    "KJ020": "whole-dataset drain of an out-of-core source: an "
+             "OutOfCoreDataset/SpilledDataset-bound name fed to "
+             "np.asarray/np.array/np.stack/np.concatenate or "
+             "list()/tuple() — stream it through "
+             "window_iter()/map_windowed() (or call the class's own "
+             "materialize()/rehydrate() where a full drain is the "
+             "sanctioned decision)",
 }
 
 _IGNORE_RE = re.compile(r"#\s*keystone:\s*ignore\[([A-Z0-9,\s]+)\]")
@@ -1564,6 +1586,64 @@ def _check_hardcoded_kernel_geometry(tree: ast.AST,
 # ----------------------------------------------------------------- driver
 
 
+#: constructors/loaders whose result is an out-of-core (host-tier)
+#: dataset — the names KJ020 tracks assignments from
+_OOC_CONSTRUCTORS = {"OutOfCoreDataset", "SpilledDataset",
+                     "out_of_core_from_shards", "out_of_core_npy_loader",
+                     "synthetic_out_of_core"}
+
+#: numpy-level whole-array drains (np.<attr> / numpy.<attr>)
+_OOC_NP_DRAINS = {"asarray", "array", "stack", "concatenate"}
+
+
+def _check_ooc_whole_drain(tree: ast.AST, path: str) -> Iterator[Finding]:
+    """KJ020 (under ``data/``/``workflow/``): whole-dataset
+    materialization of an out-of-core source. Names bound from the
+    out-of-core constructors/loaders are tracked per module; feeding a
+    tracked name to a numpy whole-array drain or ``list()``/``tuple()``
+    defeats the bounded-residency contract the windowed prefetcher
+    provides. The classes' own ``materialize()``/``rehydrate()``/
+    ``numpy()`` methods are not flagged — they ARE the sanctioned,
+    greppable full-drain decision points."""
+    tracked: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        func = node.value.func
+        name = (func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None)
+        if name in _OOC_CONSTRUCTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tracked.add(tgt.id)
+    if not tracked:
+        return
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        func = call.func
+        drain = None
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _OOC_NP_DRAINS \
+                and _attr_root(func) in {"np", "numpy"}:
+            drain = f"np.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in {"list", "tuple"}:
+            drain = func.id
+        if drain is None:
+            continue
+        hit = next((a.id for a in call.args
+                    if isinstance(a, ast.Name) and a.id in tracked), None)
+        if hit is None:
+            continue
+        yield Finding(
+            path, call.lineno, "KJ020",
+            f"{drain}({hit}) drains an out-of-core dataset whole — "
+            "stream it (window_iter()/map_windowed()) or make the full "
+            f"drain explicit ({hit}.materialize()/.numpy())")
+
+
 def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
     src = path.read_text()
     try:
@@ -1600,6 +1680,8 @@ def lint_file(path: Path, repo_root: Optional[Path] = None) -> List[Finding]:
             tree, rel, serving="serving/" in posix))
     if "parallel/" in posix or "data/" in posix:
         findings.extend(_check_bare_device_put(tree, rel))
+    if "data/" in posix or "workflow/" in posix:
+        findings.extend(_check_ooc_whole_drain(tree, rel))
     if "ops/" not in posix:
         findings.extend(_check_pallas_outside_ops(tree, rel))
     else:

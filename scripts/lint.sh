@@ -718,4 +718,134 @@ PY
 JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --ledger "$SERVING_SMOKE_LEDGER" >/dev/null
 rm -f "$SERVING_SMOKE_LEDGER"
 
+echo "== out-of-core smoke (dataset 8x budget: windowed peak under budget, warm 0-cold, spill decision in ledger) =="
+OOC_LEDGER="$(mktemp /tmp/keystone_ooc_smoke.XXXXXX.jsonl)"
+OOC_CACHE="$(mktemp -d /tmp/keystone_ooc_cache.XXXXXX)"
+JAX_PLATFORMS=cpu KEYSTONE_LEDGER="$OOC_LEDGER" \
+KEYSTONE_COMPILE_CACHE="$OOC_CACHE" python - <<'PY'
+# Two halves of the out-of-core contract. (1) Streaming: a synthetic
+# dataset 8x a synthetic HBM budget streams through the windowed spill
+# prefetcher into normal-equation accumulators — the warm second pass
+# performs 0 cold compiles (every window pads onto an already-compiled
+# ladder rung), observed live device bytes stay under the budget, and
+# index coverage is exact. (2) Planning: the unified planner, given a
+# budget every device cache busts, enforces a HOST-placed CacheMarker
+# end-to-end and appends a kind="spill" ledger record whose
+# alternatives price the infeasible device cache (INF) against the
+# feasible host spill; the kill-switch arm enforces no host placement
+# and keeps an empty spill set.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu import PipelineEnv
+from keystone_tpu.data.dataset import Dataset
+from keystone_tpu.loaders import synthetic_out_of_core
+from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.nodes.util import ClassLabelIndicatorsFromInt, MaxClassifier
+from keystone_tpu.telemetry import compiles_snapshot, ledger
+from keystone_tpu.telemetry.compile_events import install_compile_listeners
+from keystone_tpu.utils.batching import stream_spill_windows
+from keystone_tpu.workflow.autocache import CacheMarker
+from keystone_tpu.workflow.env import config_override
+from keystone_tpu.workflow.executor import drain_warmups
+
+PipelineEnv.reset()
+install_compile_listeners()
+
+# -- (1) windowed streaming under an 8x-too-small budget -----------------
+n, dim, window = 32768, 64, 512
+budget = n * dim * 4 // 8
+source = synthetic_out_of_core(n, dim, shard_rows=4096)
+W = jnp.asarray(np.random.default_rng(7)
+                .standard_normal((dim, dim)).astype(np.float32) * 0.05)
+
+@jax.jit
+def accum(ata, xb):
+    f = jnp.maximum(xb @ W, 0.0)
+    return ata + f.T @ f
+
+def windowed_pass(track_peak=False):
+    ata = jnp.zeros((dim, dim), jnp.float32)
+    seen, peak = [], 0
+    for idxs, win in stream_spill_windows(source.row_loader, n,
+                                          window=window):
+        ata = accum(ata, win)
+        seen.extend(int(i) for i in idxs)
+        if track_peak:
+            jax.block_until_ready(ata)
+            peak = max(peak, sum(int(a.nbytes) for a in jax.live_arrays()))
+    return ata, seen, peak
+
+windowed_pass()          # cold pass: compiles the ladder rungs
+drain_warmups()
+first = compiles_snapshot()
+ata, seen, peak = windowed_pass(track_peak=True)
+drain_warmups()
+second = compiles_snapshot()
+new_cold = second["programs_compiled"] - first["programs_compiled"]
+assert new_cold == 0, (
+    f"warm windowed pass performed {new_cold} cold compile(s): "
+    f"{first} -> {second}")
+assert sorted(seen) == list(range(n)), (
+    f"window index coverage broken: {len(seen)} indices for {n} rows")
+assert peak <= budget, (
+    f"windowed pass peaked at {peak} device bytes against a "
+    f"{budget}-byte budget (dataset is {n * dim * 4})")
+
+# -- (2) planner-enforced host spill + ledger record ---------------------
+def predictor(data, labels_ds, fdim=64, classes=4):
+    featurizer = (RandomSignNode(fdim).to_pipeline()
+                  >> PaddedFFT() >> LinearRectifier(0.0))
+    labels = ClassLabelIndicatorsFromInt(classes)(labels_ds)
+    return featurizer.and_then(
+        BlockLeastSquaresEstimator(32, num_iter=1, lam=1e-3),
+        data, labels) >> MaxClassifier()
+
+rng = np.random.default_rng(11)
+X = rng.standard_normal((16384, 64)).astype(np.float32)
+y = rng.integers(0, 4, size=16384).astype(np.int32)
+
+def markers_under(spill_budget, **cfg):
+    PipelineEnv.reset()
+    with config_override(unified_min_savings_seconds=0.0,
+                         hbm_budget_bytes=spill_budget, **cfg):
+        applied = predictor(Dataset.from_numpy(X),
+                            Dataset.from_numpy(y))(Dataset.from_numpy(X))
+        g = applied.executor.optimized_graph
+        return [(v.id, g.get_operator(v).placement) for v in g.operators
+                if isinstance(g.get_operator(v), CacheMarker)]
+
+mark = ledger.session_mark()
+spill_markers = markers_under(64 << 10)
+assert any(p == "host" for _, p in spill_markers), (
+    f"64KiB budget enforced no host placement: {spill_markers}")
+spills = [d for d in ledger.session_since(mark) if d["kind"] == "spill"]
+assert spills, "spill enforcement appended no kind='spill' ledger record"
+rec = spills[0]
+assert rec["chosen"]["placement"] == "host", rec["chosen"]
+assert rec["chosen"]["spills"][0]["reload_seconds"] > 0, rec["chosen"]
+alts = rec["alternatives"]
+assert any(a["entry"].startswith("cache_") and not a["feasible"]
+           for a in alts), (
+    "spill record prices no infeasible device-cache alternative", alts)
+assert any(a["entry"].startswith("spill_") and a["feasible"]
+           for a in alts), (
+    "spill record prices no feasible spill alternative", alts)
+
+kill_markers = markers_under(64 << 10, ooc_spill=False)
+assert not any(p == "host" for _, p in kill_markers), (
+    f"KEYSTONE_OOC_SPILL=0 arm still placed a host cache: {kill_markers}")
+
+PipelineEnv.reset()
+print(f"out-of-core smoke: {n * dim * 4 >> 20}MiB dataset / "
+      f"{budget >> 10}KiB budget, peak {peak >> 10}KiB, warm +0 cold, "
+      f"host marker {spill_markers} with {len(alts)} priced "
+      f"alternative(s); kill switch clean OK")
+PY
+# the spill record the enforcement appended renders through --ledger
+JAX_PLATFORMS=cpu python -m keystone_tpu.telemetry --ledger "$OOC_LEDGER" >/dev/null
+rm -f "$OOC_LEDGER"; rm -rf "$OOC_CACHE"
+
 echo "lint: OK"

@@ -349,4 +349,4 @@ def test_bench_tier_error_scan_ignores_informational_payloads():
     assert set(bench.TIER_KEYS) == {
         "flagship_bcd_d8192", "flagship_featurize", "flagship_krr",
         "featurize_overlap", "dispatch_count", "telemetry_overhead",
-        "serving_qps", "compile_count", "fused"}
+        "serving_qps", "out_of_core", "compile_count", "fused"}

@@ -1328,3 +1328,59 @@ def test_kj019_suppression(tmp_path):
         "  # keystone: ignore[KJ019]\n"
     )
     assert jl.lint_file(src) == []
+
+
+def test_kj020_flags_whole_dataset_drains(tmp_path):
+    """KJ020: numpy whole-array drains and list()/tuple() over names
+    bound from the out-of-core constructors are flagged under data/ and
+    workflow/; the sanctioned .materialize()/.numpy() methods and
+    untracked names are not."""
+    jl = _jaxlint()
+    bad = tmp_path / "data" / "bad_drain.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "import numpy as np\n"
+        "from keystone_tpu.data.dataset import OutOfCoreDataset\n"
+        "from keystone_tpu.loaders import synthetic_out_of_core\n"
+        "\n"
+        "\n"
+        "def build(loaders, counts, other):\n"
+        "    src = OutOfCoreDataset(loaders, counts)\n"
+        "    big = synthetic_out_of_core(1 << 20, 128)\n"
+        "    a = np.asarray(src)\n"                    # KJ020
+        "    b = np.concatenate(big)\n"                # KJ020
+        "    c = list(src)\n"                          # KJ020
+        "    d = src.materialize()\n"                  # sanctioned
+        "    e = big.numpy()\n"                        # sanctioned
+        "    f = np.asarray(other)\n"                  # untracked: ok
+        "    return a, b, c, d, e, f\n"
+    )
+    findings = jl.lint_file(bad)
+    assert [f.rule for f in findings] == ["KJ020"] * 3, findings
+    assert sorted(f.line for f in findings) == [9, 10, 11]
+
+    # outside data/ and workflow/, the rule does not apply
+    elsewhere = tmp_path / "loaders" / "ok_drain.py"
+    elsewhere.parent.mkdir(parents=True)
+    elsewhere.write_text(bad.read_text())
+    assert "KJ020" not in {f.rule for f in jl.lint_file(elsewhere)}
+
+
+def test_kj020_suppression(tmp_path):
+    """An explicitly-unconstrained full drain suppresses per line with
+    the standard comment."""
+    jl = _jaxlint()
+    src = tmp_path / "workflow" / "sanctioned_drain.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(
+        "import numpy as np\n"
+        "from keystone_tpu.data.dataset import SpilledDataset\n"
+        "\n"
+        "\n"
+        "def reference_arm(host, count):\n"
+        "    spilled = SpilledDataset(host, count)\n"
+        "    # the bench's unconstrained reference arm drains whole\n"
+        "    return np.asarray(spilled)"
+        "  # keystone: ignore[KJ020]\n"
+    )
+    assert jl.lint_file(src) == []
